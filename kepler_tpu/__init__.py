@@ -1,0 +1,29 @@
+"""kepler-tpu: a TPU-native power-attribution framework.
+
+A ground-up re-design of Kepler's capability surface (reference:
+``sthaha/kepler``, a single-node Go Prometheus exporter that reads Intel RAPL
+energy counters and attributes power to processes/containers/VMs/pods by
+CPU-time-delta ratios) as a TPU-first framework:
+
+- Host Python does I/O (sysfs RAPL counters, /proc scans, Kubernetes watch).
+- The attribution core is a pure, jittable tensor function (``kepler_tpu.ops``)
+  evaluated on TPU — a single fused gather + outer-product instead of the
+  reference's per-workload scalar loop (reference
+  ``internal/monitor/process.go:123-145``).
+- Learned power models (linear / MLP, the kepler-model-server capability) run
+  batched alongside ratio attribution (``kepler_tpu.models``).
+- A cluster aggregator shards ``[nodes x pods x features]`` batches across a
+  ``jax.sharding.Mesh`` (``kepler_tpu.parallel``) so one TPU attributes an
+  entire fleet.
+
+Layer map (mirrors reference SURVEY §1, re-expressed TPU-first)::
+
+    RAPL sysfs ──> device ──┐
+    /proc ───────> resource ─┼─> monitor (jitted attribution) ─> exporters ─> server
+    K8s API ─────> k8s.pod ──┘
+    wired by: config + service lifecycle
+"""
+
+from kepler_tpu.version import __version__
+
+__all__ = ["__version__"]

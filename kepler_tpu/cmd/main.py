@@ -1,0 +1,134 @@
+"""CLI entry point.
+
+Reference parity: ``cmd/kepler/main.go:27-65`` — parse flags+config, build
+the service graph, sequential Init (rollback on failure), concurrent Run
+(first exit cancels all), graceful shutdown on SIGINT/SIGTERM.
+
+Run as ``python -m kepler_tpu.cmd.main [flags]`` or via the ``kepler-tpu``
+console script.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Sequence
+
+from kepler_tpu import version
+from kepler_tpu.config import Config, parse_args_and_config
+from kepler_tpu.device.fake import FakeCPUMeter
+from kepler_tpu.device.rapl import RaplPowerMeter
+from kepler_tpu.exporter.prometheus import (
+    PrometheusExporter,
+    create_collectors,
+)
+from kepler_tpu.exporter.stdout import StdoutExporter
+from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.resource.informer import ResourceInformer
+from kepler_tpu.server.debug import DebugService
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import (
+    CancelContext,
+    SignalHandler,
+    init_services,
+    run_services,
+)
+from kepler_tpu.utils.logger import new_logger
+
+log = logging.getLogger("kepler.main")
+
+
+def create_cpu_meter(cfg: Config):
+    """reference createCPUMeter (main.go:227-241)."""
+    if cfg.dev.fake_cpu_meter.enabled:
+        return FakeCPUMeter(zones=cfg.dev.fake_cpu_meter.zones)
+    return RaplPowerMeter(sysfs_path=cfg.host.sysfs,
+                          zone_filter=cfg.rapl.zones)
+
+
+def create_services(cfg: Config) -> list:
+    """reference createServices (main.go:124-225)."""
+    meter = create_cpu_meter(cfg)
+
+    pod_lookup = None
+    if cfg.kube.enabled:
+        from kepler_tpu.k8s.pod import PodInformer
+        pod_lookup = PodInformer(
+            node_name=cfg.kube.node_name, kubeconfig=cfg.kube.config)
+
+    resources = ResourceInformer(procfs_path=cfg.host.procfs,
+                                 pod_lookup=pod_lookup)
+    monitor = PowerMonitor(
+        meter,
+        resources,
+        interval=cfg.monitor.interval,
+        staleness=cfg.monitor.staleness,
+        max_terminated=cfg.monitor.max_terminated,
+        min_terminated_energy_uj=(
+            cfg.monitor.min_terminated_energy_threshold * 1e6),
+        workload_bucket=cfg.tpu.workload_bucket,
+    )
+    server = APIServer(listen_addresses=cfg.web.listen_addresses)
+    services: list = []
+    if pod_lookup is not None:
+        services.append(pod_lookup)
+    services += [resources, monitor, server]
+    if cfg.exporter.prometheus.enabled:
+        collectors = create_collectors(
+            monitor,
+            node_name=cfg.kube.node_name,
+            metrics_level=cfg.exporter.prometheus.metrics_level,
+            procfs=cfg.host.procfs,
+        )
+        services.append(PrometheusExporter(
+            server, collectors,
+            debug_collectors=cfg.exporter.prometheus.debug_collectors))
+    if cfg.debug.pprof.enabled:
+        services.append(DebugService(server))
+    if cfg.exporter.stdout.enabled:
+        services.append(StdoutExporter(monitor))
+    if cfg.aggregator.enabled or cfg.aggregator.endpoint:
+        # wired by kepler_tpu.parallel (cluster aggregator role); loud until
+        # the service graph grows that arm
+        log.warning("aggregator config present but the aggregator service "
+                    "is started via kepler_tpu.cmd.aggregator")
+    return services
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    try:
+        cfg = parse_args_and_config(argv)
+    except (ValueError, OSError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    # stdout exporter owns stdout; logs move to stderr (main.go:34-38)
+    stream = sys.stderr if cfg.exporter.stdout.enabled else sys.stdout
+    new_logger(cfg.log.level, cfg.log.format, stream=stream)
+    info = version.info()
+    log.info("kepler-tpu %s (%s, %s)", info.version, info.python_version,
+             info.platform)
+
+    try:
+        services = create_services(cfg)
+    except Exception as err:
+        log.error("failed to create services: %s", err)
+        return 1
+    signal_handler = SignalHandler()
+    services.append(signal_handler)
+    try:
+        init_services(services)
+    except Exception as err:
+        log.error("initialization failed: %s", err)
+        return 1
+    ctx = CancelContext()
+    try:
+        run_services(ctx, services)
+    except Exception as err:
+        log.error("run failed: %s", err)
+        return 1
+    log.info("Graceful shutdown completed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

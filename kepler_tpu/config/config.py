@@ -1,0 +1,450 @@
+"""Application configuration: defaults < YAML file < CLI flags.
+
+Reference parity: ``config/config.go`` — three-layer precedence where only
+*explicitly passed* flags override the YAML file (``config.go:285-395``),
+YAML loading with unknown-key detection, sanitization, validation with
+skippable host/kube checks (``config.go:418-509``), and a mergo-style
+fragment-merge builder for tests (``config/builder.go:34-57``).
+
+Dev-only settings (fake meter) are YAML-only, never flags
+(``config.go:104,189``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import io
+import os
+from dataclasses import dataclass, field
+from typing import Any, IO, Mapping, Sequence
+
+import yaml
+
+from kepler_tpu.config.level import Level, parse_level
+
+
+def _parse_duration(v: Any) -> float:
+    """Parse a duration into seconds.
+
+    Accepts numbers (seconds) or Go-style strings like "5s", "500ms", "1m30s"
+    (the reference YAML uses Go duration syntax, e.g. ``monitor.interval: 5s``).
+    """
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return float(v)
+    if not isinstance(v, str):
+        raise ValueError(f"invalid duration: {v!r}")
+    s = v.strip()
+    if not s:
+        raise ValueError("empty duration")
+    units = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+    total = 0.0
+    num = ""
+    i = 0
+    matched = False
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c in ".+-":
+            num += c
+            i += 1
+            continue
+        unit = ""
+        while i < len(s) and s[i].isalpha():
+            unit += s[i]
+            i += 1
+        if unit not in units or not num:
+            raise ValueError(f"invalid duration: {v!r}")
+        total += float(num) * units[unit]
+        num = ""
+        matched = True
+    if num:  # trailing bare number, e.g. "5" → seconds
+        total += float(num)
+        matched = True
+    if not matched:
+        raise ValueError(f"invalid duration: {v!r}")
+    return total
+
+
+def format_duration(seconds: float) -> str:
+    """Render seconds as a compact Go-style duration string."""
+    if seconds >= 1:
+        return f"{seconds:g}s"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:g}ms"
+    return f"{seconds * 1e6:g}us"
+
+
+# ---------------------------------------------------------------------------
+# Config sections (reference config.go:21-108)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LogConfig:
+    level: str = "info"
+    format: str = "text"  # text | json
+
+
+@dataclass
+class HostConfig:
+    sysfs: str = "/sys"
+    procfs: str = "/proc"
+
+
+@dataclass
+class RaplConfig:
+    zones: list[str] = field(default_factory=list)  # empty = all zones
+
+
+@dataclass
+class MonitorConfig:
+    interval: float = 5.0  # seconds (reference default 5s, config.go:207)
+    staleness: float = 0.5  # seconds (reference default 500ms)
+    # <0 unlimited, 0 disabled, >0 top-N by energy (config.go:51-56)
+    max_terminated: int = 500
+    # joules; only terminated workloads above this are tracked (config.go:58-63)
+    min_terminated_energy_threshold: float = 10.0
+
+
+@dataclass
+class StdoutExporterConfig:
+    enabled: bool = False
+
+
+@dataclass
+class PrometheusExporterConfig:
+    enabled: bool = True
+    debug_collectors: list[str] = field(default_factory=lambda: ["go"])
+    metrics_level: Level = Level.all()
+
+
+@dataclass
+class ExporterConfig:
+    stdout: StdoutExporterConfig = field(default_factory=StdoutExporterConfig)
+    prometheus: PrometheusExporterConfig = field(
+        default_factory=PrometheusExporterConfig
+    )
+
+
+@dataclass
+class PprofConfig:
+    enabled: bool = False
+
+
+@dataclass
+class DebugConfig:
+    pprof: PprofConfig = field(default_factory=PprofConfig)
+
+
+@dataclass
+class WebConfig:
+    config_file: str = ""
+    listen_addresses: list[str] = field(default_factory=lambda: [":28282"])
+
+
+@dataclass
+class KubeConfig:
+    enabled: bool = False
+    config: str = ""  # kubeconfig path; empty = in-cluster
+    node_name: str = ""
+
+
+@dataclass
+class FakeCpuMeterConfig:
+    enabled: bool = False
+    zones: list[str] = field(default_factory=list)
+
+
+@dataclass
+class TPUConfig:
+    """TPU-specific settings — new in this framework (no reference analog).
+
+    Controls where attribution math runs and how fleet batches are shaped.
+    """
+
+    platform: str = "auto"  # auto | tpu | cpu — jax platform for attribution
+    # Pad workload axis to the next multiple of this to bound recompilation
+    # (bucketed batch shapes; SURVEY §7 hard part (a)).
+    workload_bucket: int = 256
+    node_bucket: int = 8  # fleet aggregator node-axis bucket
+    mesh_shape: list[int] = field(default_factory=list)  # [] = all devices, 1D
+    mesh_axes: list[str] = field(default_factory=lambda: ["node"])
+
+
+@dataclass
+class DevConfig:
+    fake_cpu_meter: FakeCpuMeterConfig = field(default_factory=FakeCpuMeterConfig)
+
+
+@dataclass
+class AggregatorConfig:
+    """Cluster aggregator role — new in this framework.
+
+    The reference has no inter-node plane (SURVEY §2 checklist); this framework
+    adds an optional gRPC aggregator that batches many nodes' feature rows into
+    one TPU attribution call.
+    """
+
+    enabled: bool = False
+    listen_address: str = ":28283"
+    # node-agent side: where to stream feature rows ("" = standalone mode)
+    endpoint: str = ""
+
+
+@dataclass
+class Config:
+    log: LogConfig = field(default_factory=LogConfig)
+    host: HostConfig = field(default_factory=HostConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
+    rapl: RaplConfig = field(default_factory=RaplConfig)
+    exporter: ExporterConfig = field(default_factory=ExporterConfig)
+    web: WebConfig = field(default_factory=WebConfig)
+    debug: DebugConfig = field(default_factory=DebugConfig)
+    kube: KubeConfig = field(default_factory=KubeConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+    aggregator: AggregatorConfig = field(default_factory=AggregatorConfig)
+    dev: DevConfig = field(default_factory=DevConfig)
+
+    # ---- validation (reference config.go:418-509) ----
+
+    SKIP_HOST_VALIDATION = "host"
+    SKIP_KUBE_VALIDATION = "kube"
+
+    def validate(self, skip: Sequence[str] = ()) -> None:
+        errs: list[str] = []
+        if self.log.level not in ("debug", "info", "warn", "error"):
+            errs.append(f"invalid log level: {self.log.level!r}")
+        if self.log.format not in ("text", "json"):
+            errs.append(f"invalid log format: {self.log.format!r}")
+        if self.SKIP_HOST_VALIDATION not in skip:
+            if not os.path.isdir(self.host.sysfs):
+                errs.append(f"host.sysfs {self.host.sysfs!r} is not a directory")
+            if not os.path.isdir(self.host.procfs):
+                errs.append(f"host.procfs {self.host.procfs!r} is not a directory")
+        if self.monitor.interval < 0:
+            errs.append("monitor.interval must be >= 0")
+        if self.monitor.staleness < 0:
+            errs.append("monitor.staleness must be >= 0")
+        if self.monitor.min_terminated_energy_threshold < 0:
+            errs.append("monitor.minTerminatedEnergyThreshold must be >= 0")
+        if self.kube.enabled and self.SKIP_KUBE_VALIDATION not in skip:
+            if not self.kube.node_name:
+                errs.append("kube.nodeName must be set when kube.enabled")
+            if self.kube.config and not os.path.isfile(self.kube.config):
+                errs.append(f"kube.config {self.kube.config!r} does not exist")
+        if self.tpu.workload_bucket <= 0:
+            errs.append("tpu.workload_bucket must be > 0")
+        if self.tpu.node_bucket <= 0:
+            errs.append("tpu.node_bucket must be > 0")
+        if errs:
+            raise ValueError("invalid configuration: " + "; ".join(errs))
+
+
+# ---------------------------------------------------------------------------
+# YAML loading (reference config.go:241-278)
+# ---------------------------------------------------------------------------
+
+# YAML key → (section attr, field attr) spelling map for keys whose YAML name
+# differs from the Python attribute (mirrors reference yaml tags).
+_YAML_KEYS: dict[str, str] = {
+    "configFile": "config_file",
+    "listenAddresses": "listen_addresses",
+    "maxTerminated": "max_terminated",
+    "minTerminatedEnergyThreshold": "min_terminated_energy_threshold",
+    "debugCollectors": "debug_collectors",
+    "metricsLevel": "metrics_level",
+    "nodeName": "node_name",
+    "fake-cpu-meter": "fake_cpu_meter",
+    "listenAddress": "listen_address",
+    "workloadBucket": "workload_bucket",
+    "nodeBucket": "node_bucket",
+    "meshShape": "mesh_shape",
+    "meshAxes": "mesh_axes",
+}
+
+_DURATION_FIELDS = {"interval", "staleness"}
+
+
+def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
+    for raw_key, value in data.items():
+        attr = _YAML_KEYS.get(raw_key, raw_key)
+        where = f"{path}.{raw_key}" if path else raw_key
+        if not dataclasses.is_dataclass(obj) or not hasattr(obj, attr):
+            raise ValueError(f"unknown config key: {where!r}")
+        current = getattr(obj, attr)
+        if dataclasses.is_dataclass(current):
+            if value is None:
+                continue
+            if not isinstance(value, Mapping):
+                raise ValueError(f"config key {where!r} expects a mapping")
+            _apply_mapping(current, value, where)
+        elif attr == "metrics_level":
+            if isinstance(value, str):
+                value = [value]
+            setattr(obj, attr, parse_level(value))
+        elif attr in _DURATION_FIELDS:
+            setattr(obj, attr, _parse_duration(value))
+        elif isinstance(current, bool):
+            if not isinstance(value, bool):
+                raise ValueError(f"config key {where!r} expects a bool")
+            setattr(obj, attr, value)
+        elif isinstance(current, float) and isinstance(value, (int, float)):
+            setattr(obj, attr, float(value))
+        elif isinstance(current, list):
+            if value is None:
+                setattr(obj, attr, [])
+            elif isinstance(value, list):
+                setattr(obj, attr, list(value))
+            else:
+                raise ValueError(f"config key {where!r} expects a list")
+        else:
+            setattr(obj, attr, value)
+
+
+def load(stream: IO[str] | str) -> Config:
+    """Load configuration from a YAML stream/string over defaults."""
+    cfg = default_config()
+    text = stream if isinstance(stream, str) else stream.read()
+    data = yaml.safe_load(io.StringIO(text)) or {}
+    if not isinstance(data, Mapping):
+        raise ValueError("config root must be a mapping")
+    _apply_mapping(cfg, data)
+    return cfg
+
+
+def from_file(path: str) -> Config:
+    """Load configuration from a YAML file path (reference ``FromFile``)."""
+    with open(path, "r", encoding="utf-8") as f:
+        cfg = load(f)
+    return cfg
+
+
+def default_config() -> Config:
+    return Config()
+
+
+# ---------------------------------------------------------------------------
+# Flag registration + precedence (reference config.go:285-395)
+# ---------------------------------------------------------------------------
+
+_FLAG_SENTINEL = object()
+
+
+def register_flags(parser: argparse.ArgumentParser) -> None:
+    """Register CLI flags. Defaults are sentinels so we can tell 'explicitly
+    passed' from 'defaulted' — only explicit flags override YAML
+    (reference flag-set tracking, config.go:330-394)."""
+    add = parser.add_argument
+    add("--config.file", dest="config_file", default=None, help="YAML config path")
+    add("--log.level", dest="log_level", default=None,
+        choices=["debug", "info", "warn", "error"])
+    add("--log.format", dest="log_format", default=None, choices=["text", "json"])
+    add("--host.sysfs", dest="host_sysfs", default=None)
+    add("--host.procfs", dest="host_procfs", default=None)
+    add("--monitor.interval", dest="monitor_interval", default=None,
+        help="refresh interval, e.g. 5s")
+    add("--monitor.max-terminated", dest="monitor_max_terminated", default=None,
+        type=int)
+    add("--debug.pprof", dest="debug_pprof", default=None,
+        action=argparse.BooleanOptionalAction)
+    add("--web.config-file", dest="web_config_file", default=None)
+    add("--web.listen-address", dest="web_listen_address", default=None,
+        action="append", help="repeatable listen address")
+    add("--exporter.stdout", dest="exporter_stdout", default=None,
+        action=argparse.BooleanOptionalAction)
+    add("--exporter.prometheus", dest="exporter_prometheus", default=None,
+        action=argparse.BooleanOptionalAction)
+    add("--metrics", dest="metrics", default=None, action="append",
+        help="cumulative metrics level: node|process|container|vm|pod|all")
+    add("--kube.enable", dest="kube_enable", default=None,
+        action=argparse.BooleanOptionalAction)
+    add("--kube.config", dest="kube_config", default=None)
+    add("--kube.node-name", dest="kube_node_name", default=None)
+    add("--aggregator.enable", dest="aggregator_enable", default=None,
+        action=argparse.BooleanOptionalAction)
+    add("--aggregator.listen-address", dest="aggregator_listen", default=None)
+    add("--aggregator.endpoint", dest="aggregator_endpoint", default=None)
+    add("--tpu.platform", dest="tpu_platform", default=None,
+        choices=["auto", "tpu", "cpu"])
+
+
+def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
+    """Overlay explicitly-passed flags onto cfg (highest precedence)."""
+    def set_if(attr_path: tuple[str, str], value: Any, transform=None) -> None:
+        if value is None:
+            return
+        section, attr = attr_path
+        setattr(getattr(cfg, section), attr,
+                transform(value) if transform else value)
+
+    set_if(("log", "level"), args.log_level)
+    set_if(("log", "format"), args.log_format)
+    set_if(("host", "sysfs"), args.host_sysfs)
+    set_if(("host", "procfs"), args.host_procfs)
+    set_if(("monitor", "interval"), args.monitor_interval, _parse_duration)
+    set_if(("monitor", "max_terminated"), args.monitor_max_terminated)
+    if args.debug_pprof is not None:
+        cfg.debug.pprof.enabled = args.debug_pprof
+    set_if(("web", "config_file"), args.web_config_file)
+    if args.web_listen_address:
+        cfg.web.listen_addresses = list(args.web_listen_address)
+    if args.exporter_stdout is not None:
+        cfg.exporter.stdout.enabled = args.exporter_stdout
+    if args.exporter_prometheus is not None:
+        cfg.exporter.prometheus.enabled = args.exporter_prometheus
+    if args.metrics:
+        cfg.exporter.prometheus.metrics_level = parse_level(args.metrics)
+    set_if(("kube", "enabled"), args.kube_enable)
+    set_if(("kube", "config"), args.kube_config)
+    set_if(("kube", "node_name"), args.kube_node_name)
+    set_if(("aggregator", "enabled"), args.aggregator_enable)
+    set_if(("aggregator", "listen_address"), args.aggregator_listen)
+    set_if(("aggregator", "endpoint"), args.aggregator_endpoint)
+    set_if(("tpu", "platform"), args.tpu_platform)
+    return cfg
+
+
+def parse_args_and_config(
+    argv: Sequence[str] | None = None,
+    skip_validation: Sequence[str] = (),
+) -> Config:
+    """Full precedence chain: defaults < --config.file YAML < explicit flags.
+
+    Reference ``cmd/kepler/main.go:80-122`` parseArgsAndConfig.
+    """
+    parser = argparse.ArgumentParser(prog="kepler-tpu")
+    register_flags(parser)
+    args = parser.parse_args(argv)
+    cfg = from_file(args.config_file) if args.config_file else default_config()
+    cfg = apply_flags(cfg, args)
+    cfg.validate(skip=skip_validation)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# Builder: merge YAML fragments (reference config/builder.go:34-57)
+# ---------------------------------------------------------------------------
+
+
+class Builder:
+    """Accumulates YAML fragments and merges them over defaults, last wins.
+
+    Used by tests to compose configs piecemeal, like the reference's
+    mergo-based builder.
+    """
+
+    def __init__(self) -> None:
+        self._fragments: list[str] = []
+
+    def use(self, yaml_fragment: str) -> "Builder":
+        self._fragments.append(yaml_fragment)
+        return self
+
+    def build(self) -> Config:
+        cfg = default_config()
+        for frag in self._fragments:
+            data = yaml.safe_load(io.StringIO(frag)) or {}
+            if not isinstance(data, Mapping):
+                raise ValueError("config fragment root must be a mapping")
+            _apply_mapping(cfg, data)
+        return cfg

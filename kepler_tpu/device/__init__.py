@@ -1,0 +1,26 @@
+"""Device layer: hardware energy meters (reference ``internal/device/``)."""
+
+from kepler_tpu.device.aggregated import AggregatedZone
+from kepler_tpu.device.energy import Energy, Power
+from kepler_tpu.device.fake import FakeCPUMeter, FakeEnergyZone
+from kepler_tpu.device.meter import (
+    CPUPowerMeter,
+    EnergyZone,
+    ZONE_PRIORITY,
+    zone_rank,
+)
+from kepler_tpu.device.rapl import RaplPowerMeter, SysfsRaplZone
+
+__all__ = [
+    "AggregatedZone",
+    "CPUPowerMeter",
+    "Energy",
+    "EnergyZone",
+    "FakeCPUMeter",
+    "FakeEnergyZone",
+    "Power",
+    "RaplPowerMeter",
+    "SysfsRaplZone",
+    "ZONE_PRIORITY",
+    "zone_rank",
+]

@@ -1,0 +1,71 @@
+"""AggregatedZone: N same-named zones (multi-socket) as one logical zone.
+
+Reference parity: ``internal/device/energy_zone.go:47-155`` — per-subzone
+wraparound handling, combined ``max_energy`` with overflow clamp, and a lock
+so concurrent readers see consistent state.
+
+The aggregate counter is the *sum of per-zone deltas* accumulated since the
+first read — each subzone's wrap is detected and corrected independently
+(a subzone wrapping must not make the aggregate jump backwards).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from kepler_tpu.device.energy import Energy
+from kepler_tpu.device.meter import EnergyZone
+
+_UINT64_MAX = 2**64 - 1
+
+
+class AggregatedZone:
+    def __init__(self, zones: Sequence[EnergyZone]) -> None:
+        if not zones:
+            raise ValueError("AggregatedZone requires at least one zone")
+        self._zones = list(zones)
+        self._name = zones[0].name()
+        self._lock = threading.Lock()
+        self._last: dict[int, int] = {}  # per-zone previous raw reading
+        self._total: int = 0  # accumulated aggregate µJ
+
+    def name(self) -> str:
+        return self._name
+
+    def index(self) -> int:
+        return -1  # aggregated zones have no single hardware index
+
+    def path(self) -> str:
+        return ""
+
+    def max_energy(self) -> Energy:
+        total = 0
+        for z in self._zones:
+            total += int(z.max_energy())
+            if total > _UINT64_MAX:  # overflow clamp (energy_zone.go:152)
+                return Energy(_UINT64_MAX)
+        return Energy(total)
+
+    def energy(self) -> Energy:
+        with self._lock:
+            for i, z in enumerate(self._zones):
+                current = int(z.energy())
+                if i in self._last:
+                    prev = self._last[i]
+                    if current >= prev:
+                        delta = current - prev
+                    else:  # wraparound of this subzone
+                        delta = (int(z.max_energy()) - prev) + current
+                    self._total += delta
+                else:
+                    # First read seeds the aggregate at the sum of current
+                    # readings so restarts resume from hardware counters.
+                    self._total += current
+                self._last[i] = current
+            # The aggregate itself wraps at combined max_energy so downstream
+            # wraparound math (ops.deltas) stays uniform across zone kinds.
+            max_e = int(self.max_energy())
+            if max_e and self._total >= max_e:
+                self._total %= max_e
+            return Energy(self._total)

@@ -1,0 +1,5 @@
+"""Exporters (reference ``internal/exporter/``)."""
+
+from kepler_tpu.exporter.stdout import StdoutExporter
+
+__all__ = ["StdoutExporter"]

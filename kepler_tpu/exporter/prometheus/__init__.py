@@ -1,0 +1,19 @@
+"""Prometheus exporter (reference ``internal/exporter/prometheus/``)."""
+
+from kepler_tpu.exporter.prometheus.collector import PowerCollector
+from kepler_tpu.exporter.prometheus.exporter import (
+    PrometheusExporter,
+    create_collectors,
+)
+from kepler_tpu.exporter.prometheus.info_collectors import (
+    BuildInfoCollector,
+    CPUInfoCollector,
+)
+
+__all__ = [
+    "BuildInfoCollector",
+    "CPUInfoCollector",
+    "PowerCollector",
+    "PrometheusExporter",
+    "create_collectors",
+]

@@ -1,0 +1,176 @@
+"""Prometheus power collector.
+
+Reference parity: ``internal/exporter/prometheus/collector/power_collector.go``
+— one ``collect()`` takes exactly one ``Snapshot()`` so all series in a scrape
+are consistent (:215); metric families/labels match ``docs/user/metrics.md``;
+a readiness gate waits for the monitor's first refresh (:142-152); the
+metrics-level bitmask selects which families are emitted.
+
+Metric families (names/labels identical to the reference):
+  kepler_node_cpu_joules_total{zone,path}                + active/idle variants
+  kepler_node_cpu_watts{zone,path}                       + active/idle variants
+  kepler_node_cpu_usage_ratio
+  kepler_process_cpu_joules_total{pid,comm,exe,type,state,container_id,vm_id,zone}
+  kepler_process_cpu_watts{...}, kepler_process_cpu_seconds_total{...}
+  kepler_container_cpu_joules_total{container_id,container_name,runtime,state,zone,pod_id}
+  kepler_vm_cpu_joules_total{vm_id,vm_name,hypervisor,state,zone}
+  kepler_pod_cpu_joules_total{pod_id,pod_name,pod_namespace,state,zone}
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+
+from kepler_tpu.config.level import Level
+from kepler_tpu.device.energy import JOULE, WATT
+from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.monitor.snapshot import WorkloadTable
+
+log = logging.getLogger("kepler.exporter.prometheus")
+
+_META_LABEL_SETS = {
+    "process": ("pid", "comm", "exe", "type", "container_id", "vm_id"),
+    "container": ("container_id", "container_name", "runtime", "pod_id"),
+    "vm": ("vm_id", "vm_name", "hypervisor"),
+    "pod": ("pod_id", "pod_name", "pod_namespace"),
+}
+
+
+class PowerCollector:
+    """Custom collector; registered into the exporter's registry."""
+
+    def __init__(
+        self,
+        monitor: PowerMonitor,
+        node_name: str = "",
+        metrics_level: Level = Level.all(),
+        ready_timeout: float = 0.0,
+    ) -> None:
+        self._monitor = monitor
+        self._node_name = node_name
+        self._level = metrics_level
+        self._ready_timeout = ready_timeout
+
+    def _is_ready(self) -> bool:
+        return self._monitor.data_channel().wait(self._ready_timeout)
+
+    def collect(self):
+        if not self._is_ready():
+            log.debug("collector not ready: no snapshot yet")
+            return
+        snap = self._monitor.snapshot()  # ONE snapshot per scrape
+        const = {"node_name": self._node_name} if self._node_name else {}
+
+        if Level.NODE in self._level:
+            yield from self._node_metrics(snap, const)
+            ratio = GaugeMetricFamily(
+                "kepler_node_cpu_usage_ratio",
+                "CPU usage ratio of a node (active/total)",
+                labels=list(const))
+            yield self._with_const(ratio, [], snap.node.usage_ratio, const)
+        kind_level = {
+            "process": (Level.PROCESS, snap.processes,
+                        snap.terminated_processes),
+            "container": (Level.CONTAINER, snap.containers,
+                          snap.terminated_containers),
+            "vm": (Level.VM, snap.virtual_machines,
+                   snap.terminated_virtual_machines),
+            "pod": (Level.POD, snap.pods, snap.terminated_pods),
+        }
+        zone_names = snap.node.zone_names
+        for kind, (level, running, terminated) in kind_level.items():
+            if level not in self._level:
+                continue
+            yield from self._workload_metrics(
+                kind, zone_names, running, terminated, const)
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _with_const(family, labels: list[str], value: float,
+                    const: dict[str, str]):
+        family.add_metric(labels + list(const.values()), value)
+        return family
+
+    def _node_metrics(self, snap, const: dict[str, str]):
+        node = snap.node
+        variants = (
+            ("joules_total", CounterMetricFamily, "Energy consumption of cpu",
+             (node.energy_uj, node.active_uj, node.idle_uj), 1 / JOULE),
+            ("watts", GaugeMetricFamily, "Power consumption of cpu",
+             (node.power_uw, node.active_power_uw, node.idle_power_uw),
+             1 / WATT),
+        )
+        const_keys = list(const)
+        for suffix, ctor, desc, (total, active, idle), scale in variants:
+            for state, values in (("", total), ("active_", active),
+                                  ("idle_", idle)):
+                name = f"kepler_node_cpu_{state}{suffix}"
+                family = ctor(
+                    name,
+                    f"{desc}{' in ' + state.rstrip('_') + ' state' if state else ''}"
+                    " at node level",
+                    labels=["zone", "path"] + const_keys)
+                for z, zone in enumerate(node.zone_names):
+                    family.add_metric(
+                        [zone, ""] + list(const.values()),
+                        float(values[z]) * scale)
+                yield family
+
+    def _workload_metrics(self, kind: str, zone_names,
+                          running: WorkloadTable, terminated: WorkloadTable,
+                          const: dict[str, str]):
+        label_names = list(_META_LABEL_SETS[kind])
+        full_labels = label_names + ["state", "zone"] + list(const)
+        joules = CounterMetricFamily(
+            f"kepler_{kind}_cpu_joules_total",
+            f"Energy consumption of cpu at {kind} level in joules",
+            labels=full_labels)
+        watts = GaugeMetricFamily(
+            f"kepler_{kind}_cpu_watts",
+            f"Power consumption of cpu at {kind} level in watts",
+            labels=full_labels)
+        seconds = None
+        if kind == "process":
+            seconds = CounterMetricFamily(
+                "kepler_process_cpu_seconds_total",
+                "Total user and system time of the process in seconds",
+                labels=label_names + ["state"] + list(const))
+        for state, table in (("running", running), ("terminated", terminated)):
+            for i, wid in enumerate(table.ids):
+                meta = table.meta[i]
+                values = self._label_values(kind, wid, meta, label_names)
+                for z, zone in enumerate(zone_names):
+                    lv = values + [state, zone] + list(const.values())
+                    joules.add_metric(lv, float(table.energy_uj[i, z]) / JOULE)
+                    watts.add_metric(lv, float(table.power_uw[i, z]) / WATT)
+                if seconds is not None and "_cpu_total_seconds" in meta:
+                    seconds.add_metric(
+                        values + [state] + list(const.values()),
+                        float(meta["_cpu_total_seconds"]))
+        yield joules
+        yield watts
+        if seconds is not None:
+            yield seconds
+
+    @staticmethod
+    def _label_values(kind: str, wid: str, meta, label_names: Iterable[str]
+                      ) -> list[str]:
+        id_label = {"process": "pid", "container": "container_id",
+                    "vm": "vm_id", "pod": "pod_id"}[kind]
+        alias = {"pod_name": "pod_name", "pod_namespace": "namespace",
+                 "vm_name": "vm_name"}
+        out = []
+        for name in label_names:
+            if name == id_label:
+                out.append(wid)
+            elif name in meta:
+                out.append(str(meta[name]))
+            elif name in alias and alias[name] in meta:
+                out.append(str(meta[alias[name]]))
+            else:
+                out.append("")
+        return out

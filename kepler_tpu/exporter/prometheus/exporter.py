@@ -1,0 +1,90 @@
+"""Prometheus exporter service.
+
+Reference parity: ``internal/exporter/prometheus/prometheus.go`` — owns its
+own registry (no global default-registry pollution), optional debug
+collectors ("go" → Python runtime collectors here), registers ``/metrics``
+on the shared API server with OpenMetrics-capable exposition.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from prometheus_client import CollectorRegistry
+from prometheus_client.exposition import CONTENT_TYPE_LATEST, generate_latest
+
+from kepler_tpu.config.level import Level
+from kepler_tpu.exporter.prometheus.collector import PowerCollector
+from kepler_tpu.exporter.prometheus.info_collectors import (
+    BuildInfoCollector,
+    CPUInfoCollector,
+)
+from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.server.http import APIServer
+
+log = logging.getLogger("kepler.exporter.prometheus")
+
+
+def create_collectors(
+    monitor: PowerMonitor,
+    node_name: str = "",
+    metrics_level: Level = Level.all(),
+    procfs: str = "/proc",
+    ready_timeout: float = 0.0,
+) -> list:
+    """Standard collector set (reference CreateCollectors :139-158)."""
+    return [
+        PowerCollector(monitor, node_name=node_name,
+                       metrics_level=metrics_level,
+                       ready_timeout=ready_timeout),
+        BuildInfoCollector(),
+        CPUInfoCollector(procfs=procfs),
+    ]
+
+
+class PrometheusExporter:
+    def __init__(
+        self,
+        server: APIServer,
+        collectors: Sequence[object],
+        debug_collectors: Sequence[str] = ("go",),
+    ) -> None:
+        self._server = server
+        self._collectors = list(collectors)
+        self._debug = list(debug_collectors)
+        self._registry = CollectorRegistry()
+
+    def name(self) -> str:
+        return "prometheus-exporter"
+
+    def init(self) -> None:
+        for c in self._collectors:
+            self._registry.register(c)  # type: ignore[arg-type]
+        if "go" in self._debug or "process" in self._debug:
+            # Python-runtime analog of the Go runtime collectors
+            try:
+                from prometheus_client import (
+                    GC_COLLECTOR,
+                    PLATFORM_COLLECTOR,
+                    PROCESS_COLLECTOR,
+                )
+                for c in (GC_COLLECTOR, PLATFORM_COLLECTOR,
+                          PROCESS_COLLECTOR):
+                    try:
+                        self._registry.register(c)
+                    except ValueError:
+                        pass  # already registered into this registry
+            except ImportError:  # pragma: no cover
+                log.debug("runtime collectors unavailable")
+        self._server.register(
+            "/metrics", "Metrics", "Prometheus metrics", self._handle)
+        log.info("prometheus exporter ready at /metrics")
+
+    def _handle(self, _request) -> tuple[int, dict[str, str], bytes]:
+        payload = generate_latest(self._registry)
+        return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
+
+    @property
+    def registry(self) -> CollectorRegistry:
+        return self._registry

@@ -1,0 +1,1 @@
+"""Kubernetes integration (reference ``internal/k8s/``)."""

@@ -1,0 +1,261 @@
+"""Pod metadata informer.
+
+Reference parity: ``internal/k8s/pod/pod.go`` — a cached, node-filtered view
+of the K8s API: pods are watched with a ``spec.nodeName=<this node>`` field
+selector (:139-144), indexed by every containerID including init and
+ephemeral containers (:155-196, container IDs stripped of their
+``scheme://`` prefix :198), giving O(1)
+``lookup_by_container_id → (pod_id, pod_name, namespace, container_name)``.
+
+Implementation: a dependency-free Kubernetes REST client (stdlib urllib +
+ssl) — the runtime image carries no ``kubernetes`` package. LIST seeds the
+cache; WATCH (chunked JSON stream with resourceVersion resume) keeps it warm;
+a periodic full re-list guards against missed events. Credentials come from
+an explicit kubeconfig path or the in-cluster service-account token.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import ssl
+import tempfile
+import threading
+import urllib.error
+import urllib.request
+from typing import Mapping
+
+import yaml
+
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.k8s.pod")
+
+_IN_CLUSTER_TOKEN = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+_IN_CLUSTER_CA = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+
+def _strip_scheme(container_id: str) -> str:
+    """``containerd://abc…`` → ``abc…`` (reference extractContainerID :198)."""
+    _, sep, rest = container_id.partition("://")
+    return rest if sep else container_id
+
+
+class KubeClient:
+    """Minimal authenticated GET against the API server."""
+
+    def __init__(self, kubeconfig: str = "") -> None:
+        self.base_url = ""
+        self._token = ""
+        self._ssl_ctx: ssl.SSLContext | None = None
+        if kubeconfig:
+            self._from_kubeconfig(kubeconfig)
+        else:
+            self._from_in_cluster()
+
+    def _from_kubeconfig(self, path: str) -> None:
+        with open(path, encoding="utf-8") as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = cfg.get("current-context", "")
+        contexts = {c["name"]: c["context"] for c in cfg.get("contexts", [])}
+        clusters = {c["name"]: c["cluster"] for c in cfg.get("clusters", [])}
+        users = {u["name"]: u["user"] for u in cfg.get("users", [])}
+        ctx = contexts.get(ctx_name) or next(iter(contexts.values()), None)
+        if ctx is None:
+            raise ValueError(f"kubeconfig {path} has no usable context")
+        cluster = clusters[ctx["cluster"]]
+        user = users.get(ctx.get("user", ""), {})
+        self.base_url = cluster["server"].rstrip("/")
+        self._ssl_ctx = self._build_ssl(cluster, user)
+        if "token" in user:
+            self._token = user["token"]
+
+    def _build_ssl(self, cluster: Mapping, user: Mapping) -> ssl.SSLContext:
+        ctx = ssl.create_default_context()
+        if cluster.get("insecure-skip-tls-verify"):
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        ca_data = cluster.get("certificate-authority-data")
+        ca_file = cluster.get("certificate-authority")
+        if ca_data:
+            ctx.load_verify_locations(
+                cadata=base64.b64decode(ca_data).decode())
+        elif ca_file:
+            ctx.load_verify_locations(cafile=ca_file)
+        cert_data = user.get("client-certificate-data")
+        key_data = user.get("client-key-data")
+        if cert_data and key_data:
+            # stdlib ssl needs files for client certs
+            cert_f = tempfile.NamedTemporaryFile(
+                mode="wb", suffix=".pem", delete=False)
+            cert_f.write(base64.b64decode(cert_data))
+            cert_f.write(b"\n")
+            cert_f.write(base64.b64decode(key_data))
+            cert_f.close()
+            ctx.load_cert_chain(cert_f.name)
+        elif user.get("client-certificate") and user.get("client-key"):
+            ctx.load_cert_chain(user["client-certificate"],
+                                user["client-key"])
+        return ctx
+
+    def _from_in_cluster(self) -> None:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host or not os.path.exists(_IN_CLUSTER_TOKEN):
+            raise RuntimeError(
+                "not running in a cluster and no kubeconfig provided")
+        self.base_url = f"https://{host}:{port}"
+        with open(_IN_CLUSTER_TOKEN, encoding="ascii") as f:
+            self._token = f.read().strip()
+        ctx = ssl.create_default_context()
+        if os.path.exists(_IN_CLUSTER_CA):
+            ctx.load_verify_locations(cafile=_IN_CLUSTER_CA)
+        self._ssl_ctx = ctx
+
+    def get(self, path: str, timeout: float = 30.0):
+        """GET returning a file-like response (caller reads/streams)."""
+        req = urllib.request.Request(self.base_url + path)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        return urllib.request.urlopen(
+            req, timeout=timeout, context=self._ssl_ctx)
+
+
+class PodInformer:
+    """Node-filtered pod cache with containerID index."""
+
+    def __init__(
+        self,
+        node_name: str,
+        kubeconfig: str = "",
+        resync_interval: float = 300.0,
+        client: KubeClient | None = None,
+    ) -> None:
+        self._node_name = node_name
+        self._kubeconfig = kubeconfig
+        self._resync = resync_interval
+        self._client = client
+        self._lock = threading.Lock()
+        # containerID → (pod_id, pod_name, namespace, container_name)
+        self._index: dict[str, tuple[str, str, str, str]] = {}
+        # pod uid → set of containerIDs (for delete handling)
+        self._pod_containers: dict[str, set[str]] = {}
+        self._resource_version = ""
+
+    def name(self) -> str:
+        return "pod-informer"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self) -> None:
+        if self._client is None:
+            self._client = KubeClient(self._kubeconfig)
+        self.relist()
+        log.info("pod informer primed: %d containers on node %s",
+                 len(self._index), self._node_name)
+
+    def run(self, ctx: CancelContext) -> None:
+        """Watch + periodic re-list (controller-runtime cache analog)."""
+        while not ctx.cancelled():
+            try:
+                self._watch(ctx)
+            except Exception as err:
+                log.warning("pod watch interrupted: %s", err)
+            if ctx.wait(min(5.0, self._resync)):
+                return
+            try:
+                self.relist()
+            except Exception as err:
+                log.warning("pod re-list failed: %s", err)
+
+    # -- cache maintenance -------------------------------------------------
+
+    def _pods_path(self, watch: bool = False) -> str:
+        sel = f"spec.nodeName%3D{self._node_name}"
+        path = f"/api/v1/pods?fieldSelector={sel}"
+        if watch:
+            path += f"&watch=true&resourceVersion={self._resource_version}"
+        return path
+
+    def relist(self) -> None:
+        assert self._client is not None
+        with self._client.get(self._pods_path()) as resp:
+            data = json.load(resp)
+        with self._lock:
+            self._index.clear()
+            self._pod_containers.clear()
+            for pod in data.get("items", []):
+                self._upsert_locked(pod)
+            self._resource_version = data.get("metadata", {}).get(
+                "resourceVersion", "")
+
+    def _watch(self, ctx: CancelContext) -> None:
+        assert self._client is not None
+        with self._client.get(self._pods_path(watch=True),
+                              timeout=60.0) as resp:
+            buf = b""
+            while not ctx.cancelled():
+                chunk = resp.readline()
+                if not chunk:
+                    return  # stream closed; caller re-lists
+                buf += chunk
+                if not buf.endswith(b"\n"):
+                    continue
+                try:
+                    event = json.loads(buf)
+                except json.JSONDecodeError:
+                    continue  # partial frame
+                finally:
+                    buf = b""
+                self._apply_event(event)
+
+    def _apply_event(self, event: Mapping) -> None:
+        kind = event.get("type")
+        pod = event.get("object", {})
+        rv = pod.get("metadata", {}).get("resourceVersion")
+        with self._lock:
+            if rv:
+                self._resource_version = rv
+            if kind in ("ADDED", "MODIFIED"):
+                self._remove_locked(pod)
+                self._upsert_locked(pod)
+            elif kind == "DELETED":
+                self._remove_locked(pod)
+
+    def _upsert_locked(self, pod: Mapping) -> None:
+        meta = pod.get("metadata", {})
+        uid = meta.get("uid", "")
+        pod_name = meta.get("name", "")
+        namespace = meta.get("namespace", "")
+        status = pod.get("status", {})
+        ids: set[str] = set()
+        # regular + init + ephemeral containers (reference indexerFunc
+        # :167-196)
+        for key in ("containerStatuses", "initContainerStatuses",
+                    "ephemeralContainerStatuses"):
+            for cs in status.get(key, []) or []:
+                cid = _strip_scheme(cs.get("containerID", "") or "")
+                if not cid:
+                    continue
+                ids.add(cid)
+                self._index[cid] = (uid, pod_name, namespace,
+                                    cs.get("name", ""))
+        if ids:
+            self._pod_containers[uid] = ids
+
+    def _remove_locked(self, pod: Mapping) -> None:
+        uid = pod.get("metadata", {}).get("uid", "")
+        for cid in self._pod_containers.pop(uid, ()):
+            self._index.pop(cid, None)
+
+    # -- query API ---------------------------------------------------------
+
+    def lookup_by_container_id(
+        self, container_id: str
+    ) -> tuple[str, str, str, str] | None:
+        """O(1) containerID → pod metadata (reference LookupByContainerID
+        :209-239)."""
+        with self._lock:
+            return self._index.get(_strip_scheme(container_id))

@@ -1,0 +1,20 @@
+"""Monitor layer: attribution service + snapshots (reference
+``internal/monitor/``)."""
+
+from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.monitor.snapshot import (
+    NodeUsage,
+    Snapshot,
+    WorkloadRow,
+    WorkloadTable,
+)
+from kepler_tpu.monitor.terminated import TerminatedTracker
+
+__all__ = [
+    "NodeUsage",
+    "PowerMonitor",
+    "Snapshot",
+    "TerminatedTracker",
+    "WorkloadRow",
+    "WorkloadTable",
+]

@@ -1,0 +1,375 @@
+"""PowerMonitor: the attribution service.
+
+Reference parity: ``internal/monitor/monitor.go`` — owns the refresh loop;
+``snapshot()`` API with staleness check + singleflight dedup (:265-302
+double-check pattern); atomic snapshot publication; ``data_channel`` signal
+for exporter readiness; ``exported`` flag gating terminated-workload
+clearing; self-rescheduling timer (:229-251).
+
+Per refresh (reference refreshSnapshot :317-356 → calculate*Power):
+1. host: read each zone's counter, exact wraparound delta (``ops.deltas``);
+   failed zones are masked out this window (node.go:39-44 analog);
+2. host: ``resources.refresh()`` → dense ``FeatureBatch``;
+3. device: ONE jitted ``ops.attribute`` call computes the node active/idle
+   split and every workload's energy/power share — the reference's four
+   per-kind loops fused into a single [W,Z] outer product, padded to a
+   bucketed shape so ragged workload counts don't recompile;
+4. host: scatter window deltas into cumulative f64 accumulators, build the
+   immutable ``Snapshot``; move terminated workloads into top-k trackers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from typing import Callable, Mapping, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from kepler_tpu.device.meter import CPUPowerMeter, EnergyZone
+from kepler_tpu.monitor.snapshot import NodeUsage, Snapshot, WorkloadTable
+from kepler_tpu.monitor.terminated import TerminatedTracker
+from kepler_tpu.ops.attribution import attribute, pad_to_bucket
+from kepler_tpu.ops.deltas import energy_delta
+from kepler_tpu.resource.informer import FeatureBatch, ResourceInformer
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.monitor")
+
+_KINDS = ("processes", "containers", "virtual_machines", "pods")
+_KIND_CODES = (
+    FeatureBatch.KIND_PROCESS,
+    FeatureBatch.KIND_CONTAINER,
+    FeatureBatch.KIND_VM,
+    FeatureBatch.KIND_POD,
+)
+
+
+class PowerMonitor:
+    def __init__(
+        self,
+        meter: CPUPowerMeter,
+        resources: ResourceInformer,
+        interval: float = 5.0,
+        staleness: float = 0.5,
+        max_terminated: int = 500,
+        min_terminated_energy_uj: float = 10e6,
+        workload_bucket: int = 256,
+        clock: Callable[[], float] | None = None,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        self._meter = meter
+        self._resources = resources
+        self._interval = interval
+        self._staleness = staleness
+        self._max_terminated = max_terminated
+        self._min_terminated_energy_uj = min_terminated_energy_uj
+        self._bucket = workload_bucket
+        self._clock = clock or _time.time  # wall: timestamps/staleness
+        # dt for power uses a monotonic source so NTP steps can't inflate
+        # watts; tests inject the same fake for both
+        self._monotonic = monotonic or (clock if clock else _time.monotonic)
+
+        self._zones: list[EnergyZone] = []
+        self._zone_names: tuple[str, ...] = ()
+        self._prev_counters: list[int | None] = []
+        self._last_read_ts: float | None = None
+
+        # cumulative f64 accumulators: kind → id → [Z] µJ
+        self._cumulative: dict[str, dict[str, np.ndarray]] = {
+            k: {} for k in _KINDS
+        }
+        # last-known labels so terminated rows keep their metadata
+        # (reference pulls terminated entries from the previous snapshot)
+        self._meta_cache: dict[str, dict[str, Mapping[str, str]]] = {
+            k: {} for k in _KINDS
+        }
+        self._node_energy = np.zeros(0)
+        self._node_active = np.zeros(0)
+        self._node_idle = np.zeros(0)
+
+        self._trackers: dict[str, TerminatedTracker] = {}
+        self._snapshot: Snapshot | None = None
+        self._snapshot_lock = threading.Lock()  # singleflight for refresh
+        self._exported = False
+        self._data_event = threading.Event()  # reference dataCh signal
+
+    # -- service lifecycle -------------------------------------------------
+
+    def name(self) -> str:
+        return "power-monitor"
+
+    def init(self) -> None:
+        """Probe zones, seed counters, create trackers (reference Init
+        :118-150)."""
+        if hasattr(self._meter, "init"):
+            self._meter.init()
+        self._zones = list(self._meter.zones())
+        self._zone_names = tuple(z.name() for z in self._zones)
+        z = len(self._zones)
+        self._prev_counters = [None] * z
+        self._node_energy = np.zeros(z)
+        self._node_active = np.zeros(z)
+        self._node_idle = np.zeros(z)
+        primary = self._meter.primary_energy_zone().name()
+        primary_idx = self._zone_names.index(primary)
+        for kind in _KINDS:
+            self._trackers[kind] = TerminatedTracker(
+                n_zones=z,
+                primary_zone_index=primary_idx,
+                max_size=self._max_terminated,
+                min_energy_uj=self._min_terminated_energy_uj,
+            )
+        log.info("monitor initialized: zones=%s primary=%s",
+                 self._zone_names, primary)
+
+    def run(self, ctx: CancelContext) -> None:
+        """Self-rearming collection loop (reference collectionLoop :218)."""
+        if self._interval <= 0:
+            ctx.wait(None)
+            return
+        while not ctx.cancelled():
+            try:
+                self.refresh()
+            except Exception:
+                log.exception("refresh failed")
+            if ctx.wait(self._interval):
+                return
+
+    def shutdown(self) -> None:
+        pass
+
+    # -- read API (reference PowerDataProvider) ----------------------------
+
+    def zone_names(self) -> Sequence[str]:
+        return self._zone_names
+
+    def data_channel(self) -> threading.Event:
+        """Set once the first snapshot exists (collector readiness gate)."""
+        return self._data_event
+
+    def snapshot(self) -> Snapshot:
+        """Return a deep-cloned, fresh snapshot.
+
+        Freshness contract (reference :185-200, :254-302): if the current
+        snapshot is older than ``staleness``, refresh first; concurrent
+        callers dedupe on a lock with a double-check so at most one refresh
+        runs (singleflight).
+        """
+        snap = self._snapshot
+        if snap is None or not self._is_fresh():
+            with self._snapshot_lock:
+                if not self._is_fresh():  # double-check under the lock
+                    self._refresh_locked()
+            snap = self._snapshot
+        assert snap is not None
+        self._exported = True  # terminated data now consumable→clearable
+        return snap.clone()
+
+    def _is_fresh(self) -> bool:
+        snap = self._snapshot
+        if snap is None:
+            return False
+        return (self._clock() - snap.timestamp) <= self._staleness
+
+    # -- refresh -----------------------------------------------------------
+
+    def refresh(self) -> None:
+        with self._snapshot_lock:
+            self._refresh_locked()
+
+    def _refresh_locked(self) -> None:
+        start = _time.perf_counter()
+        now = self._clock()
+        mono = self._monotonic()
+        dt = (mono - self._last_read_ts
+              if self._last_read_ts is not None else 0.0)
+        self._last_read_ts = mono
+
+        zone_deltas, zone_valid = self._read_zone_deltas()
+        self._resources.refresh()
+        batch = self._resources.feature_batch()
+
+        w = batch.cpu_deltas.shape[0]
+        padded_w = pad_to_bucket(w, self._bucket)
+        cpu = np.zeros(padded_w, np.float32)
+        cpu[:w] = batch.cpu_deltas
+        valid = np.zeros(padded_w, bool)
+        valid[:w] = True
+
+        result = attribute(
+            jnp.asarray(zone_deltas, jnp.float32),
+            jnp.asarray(zone_valid),
+            jnp.float32(batch.usage_ratio),
+            jnp.asarray(cpu),
+            jnp.asarray(valid),
+            jnp.float32(batch.node_cpu_delta),
+            jnp.float32(max(dt, 0.0)),
+        )
+
+        node = self._accumulate_node(result, batch.usage_ratio)
+        tables = self._accumulate_workloads(batch, result, w)
+        self._handle_terminated(tables)
+
+        self._snapshot = Snapshot(
+            timestamp=now,
+            node=node,
+            terminated_processes=self._trackers["processes"].items(),
+            terminated_containers=self._trackers["containers"].items(),
+            terminated_virtual_machines=self._trackers[
+                "virtual_machines"].items(),
+            terminated_pods=self._trackers["pods"].items(),
+            **tables,
+        )
+        self._data_event.set()
+        log.debug("refresh done in %.2f ms", (_time.perf_counter() - start) * 1e3)
+
+    def _read_zone_deltas(self) -> tuple[np.ndarray, np.ndarray]:
+        z = len(self._zones)
+        deltas = np.zeros(z, np.float64)
+        valid = np.zeros(z, bool)
+        for i, zone in enumerate(self._zones):
+            try:
+                current = int(zone.energy())
+            except (OSError, ValueError) as err:
+                log.warning("zone %s read failed: %s", zone.name(), err)
+                continue  # stays masked this window
+            prev = self._prev_counters[i]
+            self._prev_counters[i] = current
+            if prev is None:
+                continue  # first reading seeds only (reference firstNodeRead)
+            deltas[i] = energy_delta(current, prev, int(zone.max_energy()))
+            valid[i] = True
+        return deltas, valid
+
+    def _accumulate_node(self, result, usage_ratio: float) -> NodeUsage:
+        n = result.node
+        energy = np.asarray(n.energy_uj, np.float64)
+        active = np.asarray(n.active_uj, np.float64)
+        idle = np.asarray(n.idle_uj, np.float64)
+        self._node_energy += energy
+        self._node_active += active
+        self._node_idle += idle
+        return NodeUsage(
+            zone_names=self._zone_names,
+            energy_uj=self._node_energy.copy(),
+            active_uj=self._node_active.copy(),
+            idle_uj=self._node_idle.copy(),
+            power_uw=np.asarray(n.power_uw, np.float64),
+            active_power_uw=np.asarray(n.active_power_uw, np.float64),
+            idle_power_uw=np.asarray(n.idle_power_uw, np.float64),
+            window_active_uj=active,
+            usage_ratio=float(usage_ratio),
+        )
+
+    def _workload_meta(self) -> dict[str, dict[str, Mapping[str, str]]]:
+        """Exporter label metadata per kind/id, from the informer's views."""
+        res = self._resources
+        meta: dict[str, dict[str, Mapping[str, str]]] = {
+            "processes": {
+                str(pid): {"comm": p.comm, "exe": p.exe,
+                           "type": ("container" if p.container else
+                                    "vm" if p.virtual_machine else "regular"),
+                           "container_id": p.container.id if p.container else "",
+                           "vm_id": (p.virtual_machine.id
+                                     if p.virtual_machine else ""),
+                           # numeric pseudo-label consumed (and stripped) by
+                           # the collector for kepler_process_cpu_seconds_total
+                           "_cpu_total_seconds": f"{p.cpu_total_time:.6f}"}
+                for pid, p in res.processes().running.items()
+            },
+            "containers": {
+                c.id: {"container_name": c.name, "runtime": c.runtime.value,
+                       "pod_id": c.pod_id or ""}
+                for c in res.containers().running.values()
+            },
+            "virtual_machines": {
+                v.id: {"vm_name": v.name, "hypervisor": v.hypervisor.value}
+                for v in res.virtual_machines().running.values()
+            },
+            "pods": {
+                p.id: {"pod_name": p.name, "namespace": p.namespace}
+                for p in res.pods().running.values()
+            },
+        }
+        return meta
+
+    def _accumulate_workloads(self, batch: FeatureBatch, result, w: int
+                              ) -> dict[str, WorkloadTable]:
+        energy_delta_wz = np.asarray(result.workloads.energy_uj,
+                                     np.float64)[:w]
+        power_wz = np.asarray(result.workloads.power_uw, np.float64)[:w]
+        meta_by_kind = self._workload_meta()
+        tables: dict[str, WorkloadTable] = {}
+        kinds = batch.kinds
+        for kind_name, kind_code in zip(_KINDS, _KIND_CODES):
+            idx = np.nonzero(kinds == kind_code)[0]
+            store = self._cumulative[kind_name]
+            ids = [batch.ids[i] for i in idx]
+            kind_meta = meta_by_kind[kind_name]
+            nz = len(self._zone_names)
+            energy_rows = np.zeros((len(idx), nz))
+            power_rows = power_wz[idx] if len(idx) else np.zeros((0, nz))
+            for row, (i, wid) in enumerate(zip(idx, ids)):
+                acc = store.get(wid)
+                if acc is None:
+                    acc = np.zeros(nz)
+                acc = acc + energy_delta_wz[i]
+                store[wid] = acc
+                energy_rows[row] = acc
+            meta_rows = tuple(kind_meta.get(wid, {}) for wid in ids)
+            self._meta_cache[kind_name].update(zip(ids, meta_rows))
+            # terminated ids stay in the store until _handle_terminated has
+            # captured their final cumulative values
+            tables[kind_name] = WorkloadTable(
+                ids=tuple(ids),
+                meta=meta_rows,
+                energy_uj=energy_rows,
+                power_uw=power_rows,
+            )
+        return tables
+
+    def _terminated_views(self) -> dict[str, WorkloadTable]:
+        """Final cumulative usage of workloads that vanished this refresh."""
+        res = self._resources
+        views: dict[str, WorkloadTable] = {}
+        terminated_ids = {
+            "processes": [str(pid) for pid in res.processes().terminated],
+            "containers": list(res.containers().terminated),
+            "virtual_machines": list(res.virtual_machines().terminated),
+            "pods": list(res.pods().terminated),
+        }
+        nz = len(self._zone_names)
+        for kind in _KINDS:
+            store = self._cumulative[kind]
+            ids = [wid for wid in terminated_ids[kind] if wid in store]
+            energy = (np.stack([store[wid] for wid in ids])
+                      if ids else np.zeros((0, nz)))
+            meta_cache = self._meta_cache[kind]
+            views[kind] = WorkloadTable(
+                ids=tuple(ids),
+                meta=tuple(meta_cache.get(wid, {}) for wid in ids),
+                energy_uj=energy,
+                power_uw=np.zeros((len(ids), nz)),
+            )
+        return views
+
+    def _handle_terminated(self, tables: dict[str, WorkloadTable]) -> None:
+        """Clear-after-export then absorb this window's terminated workloads
+        (reference refreshSnapshot: exported flag gates clearing)."""
+        views = self._terminated_views()
+        for kind in _KINDS:
+            if self._exported:
+                self._trackers[kind].clear()
+            self._trackers[kind].add_batch(views[kind])
+        if self._exported:
+            self._exported = False
+        # now that final values are tracked, drop them from the stores
+        for kind in _KINDS:
+            store = self._cumulative[kind]
+            meta_cache = self._meta_cache[kind]
+            for wid in views[kind].ids:
+                store.pop(wid, None)
+                meta_cache.pop(wid, None)

@@ -1,0 +1,22 @@
+"""TPU compute kernels: attribution, delta math, top-k tracking."""
+
+from kepler_tpu.ops.attribution import (
+    AttributionResult,
+    NodeAttribution,
+    WorkloadAttribution,
+    attribute,
+    attribute_fleet,
+    pad_to_bucket,
+)
+from kepler_tpu.ops.deltas import energy_delta, energy_deltas
+
+__all__ = [
+    "AttributionResult",
+    "NodeAttribution",
+    "WorkloadAttribution",
+    "attribute",
+    "attribute_fleet",
+    "energy_delta",
+    "energy_deltas",
+    "pad_to_bucket",
+]

@@ -1,0 +1,158 @@
+"""The attribution kernel: Kepler's power math as one fused tensor program.
+
+Reference parity (semantics, not structure):
+
+- ``internal/monitor/node.go:10-84``  — per-zone: split the window's energy
+  delta into ``active = Δ × usage_ratio`` and ``idle = Δ − active``; power =
+  Δenergy / Δt.
+- ``internal/monitor/process.go:123-145`` (and container.go/vm.go/pod.go —
+  identical formula per workload kind) — per workload w, zone z:
+  ``ratio_w = Δcpu_w / Δcpu_node``; ``energy[w,z] = ratio_w × active[z]``;
+  ``power[w,z] = ratio_w × active_power[z]``.
+
+The reference runs this as a per-workload Python-shaped loop,
+O(workloads × zones) scalar ops. Here the whole thing is a rank-1 outer
+product ``ratio[W] ⊗ active[Z]`` — one fused XLA computation; batched over
+nodes it becomes ``einsum('nw,nz->nwz')``, an MXU-shaped contraction
+(`attribute_fleet`).
+
+Masking: invalid workload rows (padding) and invalid zones (read errors —
+reference node.go:39-44 skips failed zones) contribute exactly zero, the
+batched analog of the reference's skip-on-error behavior.
+
+Dtypes: µJ deltas arrive as f32 (a 5 s RAPL delta < 2^32 µJ keeps ~1e-7
+relative error); cumulative energy accumulation happens on the host in f64
+(see ``kepler_tpu.monitor``) so long-running totals don't lose precision.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class NodeAttribution(NamedTuple):
+    """Per-zone node-level results (reference NodeUsage, monitor/types.go)."""
+
+    energy_uj: jax.Array  # [..., Z] total Δenergy this window
+    active_uj: jax.Array  # [..., Z] Δ × usage_ratio
+    idle_uj: jax.Array  # [..., Z] Δ − active
+    power_uw: jax.Array  # [..., Z] Δ / Δt
+    active_power_uw: jax.Array  # [..., Z]
+    idle_power_uw: jax.Array  # [..., Z]
+
+
+class WorkloadAttribution(NamedTuple):
+    """Per-workload per-zone results (reference Usage maps)."""
+
+    energy_uj: jax.Array  # [..., W, Z]
+    power_uw: jax.Array  # [..., W, Z]
+    cpu_ratio: jax.Array  # [..., W] attribution ratios (diagnostics)
+
+
+class AttributionResult(NamedTuple):
+    node: NodeAttribution
+    workloads: WorkloadAttribution
+
+
+def _node_split(
+    zone_deltas_uj: jax.Array,
+    zone_valid: jax.Array,
+    usage_ratio: jax.Array,
+    dt_s: jax.Array,
+) -> NodeAttribution:
+    deltas = jnp.where(zone_valid, zone_deltas_uj, 0.0)
+    ratio = jnp.clip(usage_ratio, 0.0, 1.0)[..., None]  # broadcast over Z
+    active = deltas * ratio
+    idle = deltas - active
+    # dt <= 0 (first window, or a clock anomaly) → power 0, never inf
+    dt = dt_s[..., None]
+    safe_dt = jnp.where(dt > 0.0, dt, 1.0)
+    power = jnp.where(dt > 0.0, deltas / safe_dt, 0.0)  # µJ/s == µW
+    return NodeAttribution(
+        energy_uj=deltas,
+        active_uj=active,
+        idle_uj=idle,
+        power_uw=power,
+        active_power_uw=jnp.where(dt > 0.0, active / safe_dt, 0.0),
+        idle_power_uw=jnp.where(dt > 0.0, idle / safe_dt, 0.0),
+    )
+
+
+def _workload_ratios(
+    cpu_deltas: jax.Array,
+    workload_valid: jax.Array,
+    node_cpu_delta: jax.Array,
+) -> jax.Array:
+    deltas = jnp.where(workload_valid, cpu_deltas, 0.0)
+    denom = node_cpu_delta[..., None]
+    return jnp.where(denom > 0.0, deltas / jnp.maximum(denom, 1e-30), 0.0)
+
+
+@jax.jit
+def attribute(
+    zone_deltas_uj: jax.Array,  # f32 [Z]
+    zone_valid: jax.Array,  # bool [Z]
+    usage_ratio: jax.Array,  # f32 scalar
+    cpu_deltas: jax.Array,  # f32 [W]
+    workload_valid: jax.Array,  # bool [W]
+    node_cpu_delta: jax.Array,  # f32 scalar
+    dt_s: jax.Array,  # f32 scalar
+) -> AttributionResult:
+    """Single-node attribution: the reference's entire hot loop, jitted.
+
+    Invariant (conservation, the executable spec of
+    ``monitor_snapshot_integration_test.go``): for any subset S of workloads
+    with ``Σ_{w∈S} Δcpu_w == node_cpu_delta``,
+    ``Σ_{w∈S} energy[w,z] == active[z]`` (up to f32 rounding).
+    """
+    node = _node_split(zone_deltas_uj, zone_valid, usage_ratio, dt_s)
+    ratios = _workload_ratios(cpu_deltas, workload_valid, node_cpu_delta)
+    # [W] ⊗ [Z] outer product — XLA fuses this with the masking above.
+    energy = ratios[..., :, None] * node.active_uj[..., None, :]
+    power = ratios[..., :, None] * node.active_power_uw[..., None, :]
+    return AttributionResult(
+        node=node,
+        workloads=WorkloadAttribution(
+            energy_uj=energy, power_uw=power, cpu_ratio=ratios
+        ),
+    )
+
+
+@jax.jit
+def attribute_fleet(
+    zone_deltas_uj: jax.Array,  # f32 [N, Z]
+    zone_valid: jax.Array,  # bool [N, Z]
+    usage_ratio: jax.Array,  # f32 [N]
+    cpu_deltas: jax.Array,  # f32 [N, W]
+    workload_valid: jax.Array,  # bool [N, W]
+    node_cpu_delta: jax.Array,  # f32 [N]
+    dt_s: jax.Array,  # f32 [N]
+) -> AttributionResult:
+    """Cluster-batched attribution over ``[nodes × workloads × zones]``.
+
+    One einsum-shaped contraction attributes an entire fleet; the node axis
+    shards across TPU devices (see ``kepler_tpu.parallel.aggregator``).
+    Missing/late nodes are handled by zeroed masks (the batched analog of the
+    reference's per-zone-error skip; SURVEY §5 "pad + mask the node axis").
+    """
+    node = _node_split(zone_deltas_uj, zone_valid, usage_ratio, dt_s)
+    ratios = _workload_ratios(cpu_deltas, workload_valid, node_cpu_delta)
+    energy = jnp.einsum("nw,nz->nwz", ratios, node.active_uj)
+    power = jnp.einsum("nw,nz->nwz", ratios, node.active_power_uw)
+    return AttributionResult(
+        node=node,
+        workloads=WorkloadAttribution(
+            energy_uj=energy, power_uw=power, cpu_ratio=ratios
+        ),
+    )
+
+
+def pad_to_bucket(n: int, bucket: int) -> int:
+    """Next multiple of ``bucket`` ≥ max(n, 1) — bounds the set of compiled
+    shapes (SURVEY §7 hard part (a): ragged fleets must not trigger a
+    recompile per pod-count)."""
+    n = max(n, 1)
+    return ((n + bucket - 1) // bucket) * bucket

@@ -1,0 +1,47 @@
+"""Top-K selection for terminated-workload tracking.
+
+Reference parity: ``internal/monitor/terminated_resource_tracker.go`` keeps a
+min-heap of terminated workloads ranked by primary-zone energy with a minimum
+energy threshold. The batched equivalent is a masked ``lax.top_k`` over the
+energy column — one call replaces the heap's per-item push/evict.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _masked_topk(energies: jax.Array, mask: jax.Array, k: int):
+    neg_inf = jnp.asarray(-jnp.inf, energies.dtype)
+    masked = jnp.where(mask, energies, neg_inf)
+    values, indices = jax.lax.top_k(masked, k)
+    return values, indices
+
+
+def top_k_by_energy(
+    energies: np.ndarray,
+    k: int,
+    min_energy: float = 0.0,
+) -> np.ndarray:
+    """Indices of the top-k energies above ``min_energy``, descending.
+
+    ``k <= 0`` returns an empty selection (tracking disabled); ``k`` larger
+    than the candidate count returns all qualifying indices.
+    """
+    energies = np.asarray(energies)
+    n = energies.shape[0]
+    if k <= 0 or n == 0:
+        return np.empty(0, dtype=np.int64)
+    k_eff = min(k, n)
+    mask = energies >= min_energy
+    values, indices = _masked_topk(
+        jnp.asarray(energies, jnp.float32), jnp.asarray(mask), k_eff
+    )
+    values = np.asarray(values)
+    indices = np.asarray(indices, dtype=np.int64)
+    return indices[values > -np.inf]

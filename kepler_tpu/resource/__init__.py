@@ -1,0 +1,48 @@
+"""Resource layer: workload discovery from procfs (reference
+``internal/resource/``)."""
+
+from kepler_tpu.resource.container import (
+    container_info_from_cgroup_paths,
+    container_info_from_proc,
+)
+from kepler_tpu.resource.informer import (
+    Containers,
+    FeatureBatch,
+    Pods,
+    Processes,
+    ResourceInformer,
+    VirtualMachines,
+)
+from kepler_tpu.resource.procfs import ProcFSReader, ProcInfo, ProcReader
+from kepler_tpu.resource.types import (
+    Container,
+    ContainerRuntime,
+    Hypervisor,
+    Node,
+    Pod,
+    Process,
+    VirtualMachine,
+)
+from kepler_tpu.resource.vm import vm_info_from_proc
+
+__all__ = [
+    "Container",
+    "ContainerRuntime",
+    "Containers",
+    "FeatureBatch",
+    "Hypervisor",
+    "Node",
+    "Pod",
+    "Pods",
+    "ProcFSReader",
+    "ProcInfo",
+    "ProcReader",
+    "Process",
+    "Processes",
+    "ResourceInformer",
+    "VirtualMachine",
+    "VirtualMachines",
+    "container_info_from_cgroup_paths",
+    "container_info_from_proc",
+    "vm_info_from_proc",
+]

@@ -1,0 +1,92 @@
+"""Container detection from cgroup paths.
+
+Reference parity: ``internal/resource/container.go`` — regex over
+``/proc/<pid>/cgroup`` paths for 7 runtime patterns (:14-25), choosing the
+*deepest* (most path components) match (:92-141); container name from
+HOSTNAME / CONTAINER_NAME env (:144-159) or ``--name`` in cmdline (:162-190).
+"""
+
+from __future__ import annotations
+
+import re
+
+from kepler_tpu.resource.types import Container, ContainerRuntime
+from kepler_tpu.resource.procfs import ProcInfo
+
+# (pattern, runtime) in reference order (container.go:14-40).
+_PATTERNS: list[tuple[re.Pattern[str], ContainerRuntime]] = [
+    (re.compile(r"/docker[-/]([0-9a-f]{64})"), ContainerRuntime.DOCKER),
+    (re.compile(r"/containerd[-/]([0-9a-f]{64})"), ContainerRuntime.CONTAINERD),
+    (re.compile(r"[:/]cri-containerd[-:]([0-9a-f]{64})"),
+     ContainerRuntime.CONTAINERD),
+    (re.compile(r"/crio-([0-9a-f]{64})"), ContainerRuntime.CRIO),
+    (re.compile(r"libpod-([0-9a-f]{64})"), ContainerRuntime.PODMAN),
+    (re.compile(r"/libpod-payload-([0-9a-f]+)"), ContainerRuntime.PODMAN),
+    (re.compile(r"/kubepods/[^/]+/pod[0-9a-f\-]+/([0-9a-f]{64})"),
+     ContainerRuntime.KUBEPODS),
+]
+
+
+def container_info_from_cgroup_paths(
+    paths: list[str],
+) -> tuple[ContainerRuntime, str]:
+    """Return (runtime, container_id) of the deepest matching path.
+
+    Deepest = most '/' components; systemd nesting puts the leaf container
+    scope deepest (reference container.go:92-141).
+    """
+    best: tuple[int, ContainerRuntime, str] | None = None
+    for path in paths:
+        for pattern, runtime in _PATTERNS:
+            m = pattern.search(path)
+            if not m:
+                continue
+            depth = path.count("/")
+            if best is None or depth > best[0]:
+                best = (depth, runtime, m.group(1))
+    if best is None:
+        return ContainerRuntime.UNKNOWN, ""
+    return best[1], best[2]
+
+
+def _name_from_env(env: dict[str, str]) -> str:
+    # CONTAINER_NAME beats HOSTNAME (reference container.go:144-159)
+    if env.get("CONTAINER_NAME"):
+        return env["CONTAINER_NAME"]
+    return env.get("HOSTNAME", "")
+
+
+def _name_from_cmdline(cmdline: list[str]) -> str:
+    # docker/podman runtimes pass --name <name> or --name=<name>
+    for i, arg in enumerate(cmdline):
+        if arg == "--name" and i + 1 < len(cmdline):
+            return cmdline[i + 1]
+        if arg.startswith("--name="):
+            return arg.split("=", 1)[1]
+    return ""
+
+
+def container_info_from_proc(proc: ProcInfo) -> Container | None:
+    """Detect containment; None when the process isn't in a container."""
+    try:
+        paths = proc.cgroups()
+    except OSError:
+        return None
+    if not paths:
+        return None
+    runtime, container_id = container_info_from_cgroup_paths(paths)
+    if not container_id:
+        return None
+    name = ""
+    try:
+        name = _name_from_env(proc.environ())
+    except OSError:
+        pass
+    if not name:
+        try:
+            name = _name_from_cmdline(proc.cmdline())
+        except OSError:
+            pass
+    if not name:
+        name = container_id[:12]
+    return Container(id=container_id, name=name, runtime=runtime)

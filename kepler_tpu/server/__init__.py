@@ -1,0 +1,6 @@
+"""HTTP API server + debug endpoints (reference ``internal/server/``)."""
+
+from kepler_tpu.server.debug import DebugService
+from kepler_tpu.server.http import APIServer
+
+__all__ = ["APIServer", "DebugService"]

@@ -1,0 +1,124 @@
+"""Debug/profiling endpoints.
+
+Reference parity: ``internal/server/pprof.go`` mounts ``net/http/pprof``
+under ``/debug/pprof/`` when ``debug.pprof`` is enabled. The Python analog
+serves:
+
+- ``/debug/pprof/``         — index of available profiles
+- ``/debug/pprof/stack``    — live stack dump of every thread (goroutine
+                              profile analog)
+- ``/debug/pprof/profile``  — sampling CPU profile across ALL threads
+                              (?seconds=N&hz=M); aggregates
+                              ``sys._current_frames()`` samples, so it sees
+                              the monitor/exporter threads, which a
+                              per-thread cProfile cannot
+- ``/debug/pprof/jax``      — one-shot JAX device profiler trace to a temp
+                              dir (TPU addition; inspect with TensorBoard)
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import sys
+import tempfile
+import threading
+import time
+import traceback
+from urllib.parse import parse_qs, urlparse
+
+from kepler_tpu.server.http import APIServer
+
+
+class DebugService:
+    def __init__(self, server: APIServer) -> None:
+        self._server = server
+
+    def name(self) -> str:
+        return "pprof"
+
+    def init(self) -> None:
+        self._server.register("/debug/pprof/", "Profiling",
+                              "pprof-style debug profiles", self._handle)
+
+    def _handle(self, request) -> tuple[int, dict[str, str], bytes]:
+        url = urlparse(request.path)
+        parts = [p for p in url.path.split("/") if p]
+        which = parts[2] if len(parts) > 2 else "index"
+        if which == "stack":
+            return self._stacks()
+        if which == "profile":
+            qs = parse_qs(url.query)
+            seconds = float(qs.get("seconds", ["5"])[0])
+            hz = float(qs.get("hz", ["100"])[0])
+            return self._profile(min(seconds, 60.0), min(max(hz, 1.0), 1000.0))
+        if which == "jax":
+            return self._jax_trace()
+        body = (
+            "<html><body><h1>debug/pprof</h1><ul>"
+            '<li><a href="/debug/pprof/stack">stack</a></li>'
+            '<li><a href="/debug/pprof/profile?seconds=5">profile</a></li>'
+            '<li><a href="/debug/pprof/jax">jax trace</a></li>'
+            "</ul></body></html>"
+        ).encode()
+        return 200, {"Content-Type": "text/html"}, body
+
+    @staticmethod
+    def _stacks() -> tuple[int, dict[str, str], bytes]:
+        out = io.StringIO()
+        frames = sys._current_frames()
+        for thread in threading.enumerate():
+            frame = frames.get(thread.ident)
+            out.write(f"--- thread {thread.name} (id {thread.ident}) ---\n")
+            if frame:
+                traceback.print_stack(frame, file=out)
+            out.write("\n")
+        return 200, {"Content-Type": "text/plain"}, out.getvalue().encode()
+
+    @staticmethod
+    def _profile(seconds: float, hz: float
+                 ) -> tuple[int, dict[str, str], bytes]:
+        """Statistical profile: sample every thread's stack at ``hz``."""
+        own = threading.get_ident()
+        counts: collections.Counter[tuple[str, ...]] = collections.Counter()
+        samples = 0
+        deadline = time.monotonic() + seconds
+        period = 1.0 / hz
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == own:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 24:
+                    code = f.f_code
+                    stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                                 f"{f.f_lineno} {code.co_name}")
+                    f = f.f_back
+                counts[tuple(reversed(stack))] += 1
+            samples += 1
+            time.sleep(period)
+        out = io.StringIO()
+        out.write(f"sampling profile: {samples} samples over {seconds}s "
+                  f"at {hz:g} Hz (all threads except handler)\n\n")
+        for stack, n in counts.most_common(40):
+            out.write(f"{n}/{samples} samples ({n / max(samples, 1):.1%}):\n")
+            for line in stack:
+                out.write(f"    {line}\n")
+            out.write("\n")
+        return 200, {"Content-Type": "text/plain"}, out.getvalue().encode()
+
+    @staticmethod
+    def _jax_trace() -> tuple[int, dict[str, str], bytes]:
+        try:
+            import jax
+        except ImportError:  # pragma: no cover
+            return 503, {"Content-Type": "text/plain"}, b"jax unavailable\n"
+        trace_dir = tempfile.mkdtemp(prefix="kepler-jax-trace-")
+        with jax.profiler.trace(trace_dir):
+            # capture one trivial device op so the trace isn't empty; real
+            # attribution steps landing in this window are also captured
+            jax.numpy.zeros(8).block_until_ready()
+            time.sleep(0.5)
+        msg = f"jax trace written to {trace_dir}\n"
+        return 200, {"Content-Type": "text/plain"}, msg.encode()

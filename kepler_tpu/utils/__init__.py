@@ -1,0 +1,5 @@
+"""Shared utilities: logging."""
+
+from kepler_tpu.utils.logger import new_logger
+
+__all__ = ["new_logger"]

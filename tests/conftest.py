@@ -1,0 +1,24 @@
+"""Test harness setup.
+
+All JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding
+(`kepler_tpu.parallel`) is exercised without TPU hardware — and so tests
+never touch (or wedge) shared accelerator tunnels.
+
+Note: an ambient sitecustomize may import jax at interpreter startup with
+JAX_PLATFORMS pointing at real hardware; by the time conftest runs, jax's
+config has already read the env. Setting the env var here is therefore not
+enough — we must update jax.config directly.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,207 @@
+"""Attribution-kernel tests — the executable spec.
+
+Ports the semantics of the reference's
+``monitor_snapshot_integration_test.go`` (energy conservation: Σ workload
+energy == node active energy), ``node_power_test.go`` (active/idle split,
+wraparound), and the per-workload attribution tables in
+``{process,container,pod,vm}_power_test.go``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kepler_tpu.ops import (
+    attribute,
+    attribute_fleet,
+    energy_delta,
+    energy_deltas,
+    pad_to_bucket,
+)
+
+
+def run_single(zone_deltas, usage_ratio, cpu_deltas, node_cpu_delta,
+               dt=5.0, zone_valid=None, workload_valid=None):
+    zone_deltas = jnp.asarray(zone_deltas, jnp.float32)
+    cpu_deltas = jnp.asarray(cpu_deltas, jnp.float32)
+    if zone_valid is None:
+        zone_valid = jnp.ones(zone_deltas.shape, bool)
+    else:
+        zone_valid = jnp.asarray(zone_valid, bool)
+    if workload_valid is None:
+        workload_valid = jnp.ones(cpu_deltas.shape, bool)
+    else:
+        workload_valid = jnp.asarray(workload_valid, bool)
+    return attribute(
+        zone_deltas, zone_valid, jnp.float32(usage_ratio),
+        cpu_deltas, workload_valid, jnp.float32(node_cpu_delta),
+        jnp.float32(dt),
+    )
+
+
+class TestNodeSplit:
+    def test_active_idle_split(self):
+        # 100 J delta at 60% usage → 60 J active, 40 J idle
+        r = run_single([100e6], 0.6, [1.0], 1.0)
+        assert r.node.active_uj[0] == pytest.approx(60e6, rel=1e-6)
+        assert r.node.idle_uj[0] == pytest.approx(40e6, rel=1e-6)
+        assert r.node.energy_uj[0] == pytest.approx(100e6)
+
+    def test_power_is_delta_over_dt(self):
+        # 50 J over 5 s → 10 W = 1e7 µW
+        r = run_single([50e6], 1.0, [1.0], 1.0, dt=5.0)
+        assert r.node.power_uw[0] == pytest.approx(1e7, rel=1e-6)
+
+    def test_invalid_zone_contributes_zero(self):
+        r = run_single([100e6, 200e6], 0.5, [1.0], 1.0,
+                       zone_valid=[True, False])
+        assert r.node.energy_uj[1] == 0.0
+        assert r.workloads.energy_uj[0, 1] == 0.0
+
+    def test_usage_ratio_clamped(self):
+        r = run_single([100e6], 1.5, [1.0], 1.0)
+        assert r.node.active_uj[0] == pytest.approx(100e6)
+        r = run_single([100e6], -0.5, [1.0], 1.0)
+        assert r.node.active_uj[0] == 0.0
+
+
+class TestWorkloadAttribution:
+    def test_proportional_split(self):
+        # workloads use 1s and 3s of 4s node cpu → 25% / 75% of active energy
+        r = run_single([100e6], 0.8, [1.0, 3.0], 4.0)
+        active = 80e6
+        assert r.workloads.energy_uj[0, 0] == pytest.approx(0.25 * active, rel=1e-6)
+        assert r.workloads.energy_uj[1, 0] == pytest.approx(0.75 * active, rel=1e-6)
+
+    def test_conservation(self):
+        """Σ workload energy == node active energy (the core invariant)."""
+        rng = np.random.default_rng(0)
+        cpu = rng.uniform(0, 10, size=257).astype(np.float32)
+        zones = rng.uniform(1e6, 5e8, size=4).astype(np.float32)
+        r = run_single(zones, 0.7, cpu, float(cpu.sum()))
+        total = np.asarray(r.workloads.energy_uj).sum(axis=0)
+        np.testing.assert_allclose(total, np.asarray(r.node.active_uj),
+                                   rtol=1e-5)
+
+    def test_zero_node_cpu_no_nan(self):
+        r = run_single([100e6], 0.5, [0.0, 0.0], 0.0)
+        assert not np.isnan(np.asarray(r.workloads.energy_uj)).any()
+        assert np.asarray(r.workloads.energy_uj).sum() == 0.0
+
+    def test_masked_workloads_zero(self):
+        r = run_single([100e6], 1.0, [2.0, 2.0], 2.0,
+                       workload_valid=[True, False])
+        assert r.workloads.energy_uj[1, 0] == 0.0
+        # masked rows also drop out of ratios
+        assert r.workloads.cpu_ratio[1] == 0.0
+
+    def test_power_attribution(self):
+        # 100 J active over 5 s = 20 W active power; 50% share → 10 W
+        r = run_single([100e6], 1.0, [1.0, 1.0], 2.0, dt=5.0)
+        assert r.workloads.power_uw[0, 0] == pytest.approx(10e6, rel=1e-6)
+
+
+class TestFleet:
+    def test_fleet_matches_per_node(self):
+        rng = np.random.default_rng(1)
+        N, W, Z = 5, 33, 3
+        zones = rng.uniform(1e6, 5e8, (N, Z)).astype(np.float32)
+        cpu = rng.uniform(0, 10, (N, W)).astype(np.float32)
+        wl_valid = rng.random((N, W)) > 0.2
+        cpu = np.where(wl_valid, cpu, 0.0).astype(np.float32)
+        ratios = rng.uniform(0.1, 1.0, N).astype(np.float32)
+        denom = cpu.sum(axis=1).astype(np.float32)
+        dt = np.full(N, 5.0, np.float32)
+        fleet = attribute_fleet(
+            jnp.asarray(zones), jnp.ones((N, Z), bool), jnp.asarray(ratios),
+            jnp.asarray(cpu), jnp.asarray(wl_valid), jnp.asarray(denom),
+            jnp.asarray(dt),
+        )
+        for n in range(N):
+            single = attribute(
+                jnp.asarray(zones[n]), jnp.ones(Z, bool),
+                jnp.float32(ratios[n]), jnp.asarray(cpu[n]),
+                jnp.asarray(wl_valid[n]), jnp.float32(denom[n]),
+                jnp.float32(5.0),
+            )
+            np.testing.assert_allclose(
+                np.asarray(fleet.workloads.energy_uj[n]),
+                np.asarray(single.workloads.energy_uj), rtol=1e-5)
+
+    def test_fleet_conservation_per_node(self):
+        rng = np.random.default_rng(2)
+        N, W, Z = 8, 64, 4
+        zones = rng.uniform(1e6, 5e8, (N, Z)).astype(np.float32)
+        cpu = rng.uniform(0, 10, (N, W)).astype(np.float32)
+        denom = cpu.sum(axis=1).astype(np.float32)
+        r = attribute_fleet(
+            jnp.asarray(zones), jnp.ones((N, Z), bool),
+            jnp.full(N, 0.6, jnp.float32), jnp.asarray(cpu),
+            jnp.ones((N, W), bool), jnp.asarray(denom),
+            jnp.full(N, 5.0, jnp.float32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(r.workloads.energy_uj).sum(axis=1),
+            np.asarray(r.node.active_uj), rtol=1e-5)
+
+    def test_dead_node_fully_masked(self):
+        N, W, Z = 2, 4, 2
+        zone_valid = np.ones((N, Z), bool)
+        zone_valid[1] = False  # node 1 never reported
+        r = attribute_fleet(
+            jnp.full((N, Z), 1e8, jnp.float32), jnp.asarray(zone_valid),
+            jnp.full(N, 0.5, jnp.float32),
+            jnp.full((N, W), 1.0, jnp.float32),
+            jnp.asarray(np.array([[True] * W, [False] * W])),
+            jnp.full(N, 4.0, jnp.float32), jnp.full(N, 5.0, jnp.float32),
+        )
+        assert np.asarray(r.workloads.energy_uj[1]).sum() == 0.0
+        assert np.asarray(r.node.energy_uj[1]).sum() == 0.0
+
+
+class TestEnergyDelta:
+    def test_normal_delta(self):
+        assert energy_delta(150, 100, 1000) == 50
+
+    def test_wraparound(self):
+        # reference node.go:87-98: (max - prev) + current
+        assert energy_delta(20, 990, 1000) == 30
+
+    def test_no_max_energy_wrap_is_zero(self):
+        assert energy_delta(20, 990, 0) == 0
+
+    def test_vectorized_matches_scalar(self):
+        current = np.array([150, 20, 5], dtype=np.uint64)
+        prev = np.array([100, 990, 5], dtype=np.uint64)
+        max_e = np.array([1000, 1000, 1000], dtype=np.uint64)
+        out = energy_deltas(current, prev, max_e)
+        np.testing.assert_array_equal(out, [50.0, 30.0, 0.0])
+
+    def test_vectorized_large_counters_exact(self):
+        big = 2**53 + 4096  # beyond f64 integer range if done naively
+        out = energy_deltas(
+            np.array([big + 1000], np.uint64), np.array([big], np.uint64),
+            np.array([2**63], np.uint64))
+        assert out[0] == 1000.0
+
+
+class TestBucketing:
+    def test_pad_to_bucket(self):
+        assert pad_to_bucket(0, 256) == 256
+        assert pad_to_bucket(1, 256) == 256
+        assert pad_to_bucket(256, 256) == 256
+        assert pad_to_bucket(257, 256) == 512
+
+    def test_padding_does_not_change_result(self):
+        cpu = np.array([1.0, 3.0], np.float32)
+        padded = np.zeros(8, np.float32)
+        padded[:2] = cpu
+        valid = np.zeros(8, bool)
+        valid[:2] = True
+        r_small = run_single([100e6], 0.5, cpu, 4.0)
+        r_padded = run_single([100e6], 0.5, padded, 4.0,
+                              workload_valid=valid)
+        np.testing.assert_allclose(
+            np.asarray(r_padded.workloads.energy_uj[:2]),
+            np.asarray(r_small.workloads.energy_uj), rtol=1e-6)
+        assert np.asarray(r_padded.workloads.energy_uj[2:]).sum() == 0.0

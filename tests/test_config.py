@@ -1,0 +1,203 @@
+"""Config precedence/validation tests (reference ``config_test.go``, 1886 LoC
+— the core matrix: defaults < YAML < explicit flags; unknown keys rejected;
+duration parsing; metrics-level parsing; builder merge)."""
+
+import argparse
+
+import pytest
+
+from kepler_tpu.config import (
+    Builder,
+    Level,
+    apply_flags,
+    default_config,
+    load,
+    parse_level,
+    register_flags,
+)
+from kepler_tpu.config.config import _parse_duration, format_duration
+
+
+def parse(argv):
+    parser = argparse.ArgumentParser()
+    register_flags(parser)
+    return parser.parse_args(argv)
+
+
+class TestDefaults:
+    def test_defaults_match_reference(self):
+        cfg = default_config()
+        assert cfg.log.level == "info"
+        assert cfg.log.format == "text"
+        assert cfg.host.sysfs == "/sys"
+        assert cfg.host.procfs == "/proc"
+        assert cfg.monitor.interval == 5.0
+        assert cfg.monitor.staleness == 0.5
+        assert cfg.monitor.max_terminated == 500
+        assert cfg.monitor.min_terminated_energy_threshold == 10.0
+        assert cfg.exporter.stdout.enabled is False
+        assert cfg.exporter.prometheus.enabled is True
+        assert cfg.exporter.prometheus.debug_collectors == ["go"]
+        assert cfg.exporter.prometheus.metrics_level == Level.all()
+        assert cfg.web.listen_addresses == [":28282"]
+        assert cfg.kube.enabled is False
+        assert cfg.dev.fake_cpu_meter.enabled is False
+
+
+class TestYAML:
+    def test_yaml_overrides_defaults(self):
+        cfg = load(
+            """
+log:
+  level: debug
+monitor:
+  interval: 10s
+  staleness: 250ms
+  maxTerminated: 100
+rapl:
+  zones: [package, dram]
+exporter:
+  stdout:
+    enabled: true
+  prometheus:
+    metricsLevel: [node, pod]
+"""
+        )
+        assert cfg.log.level == "debug"
+        assert cfg.monitor.interval == 10.0
+        assert cfg.monitor.staleness == 0.25
+        assert cfg.monitor.max_terminated == 100
+        assert cfg.rapl.zones == ["package", "dram"]
+        assert cfg.exporter.stdout.enabled is True
+        assert cfg.exporter.prometheus.metrics_level == Level.NODE | Level.POD
+        # untouched sections keep defaults
+        assert cfg.log.format == "text"
+        assert cfg.exporter.prometheus.enabled is True
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError, match="unknown config key"):
+            load("bogus:\n  x: 1\n")
+        with pytest.raises(ValueError, match="unknown config key"):
+            load("monitor:\n  intervall: 5s\n")
+
+    def test_empty_yaml_is_defaults(self):
+        cfg = load("")
+        assert cfg.monitor.interval == 5.0
+
+    def test_dev_settings_from_yaml(self):
+        cfg = load(
+            "dev:\n  fake-cpu-meter:\n    enabled: true\n    zones: [package]\n"
+        )
+        assert cfg.dev.fake_cpu_meter.enabled is True
+        assert cfg.dev.fake_cpu_meter.zones == ["package"]
+
+
+class TestFlags:
+    def test_explicit_flags_override_yaml(self):
+        cfg = load("log:\n  level: debug\nmonitor:\n  interval: 10s\n")
+        args = parse(["--log.level", "error"])
+        cfg = apply_flags(cfg, args)
+        assert cfg.log.level == "error"  # flag wins
+        assert cfg.monitor.interval == 10.0  # unset flag leaves YAML value
+
+    def test_boolean_flags(self):
+        cfg = apply_flags(default_config(), parse(["--exporter.stdout"]))
+        assert cfg.exporter.stdout.enabled is True
+        cfg = apply_flags(default_config(), parse(["--no-exporter.prometheus"]))
+        assert cfg.exporter.prometheus.enabled is False
+
+    def test_metrics_flag_cumulative(self):
+        cfg = apply_flags(
+            default_config(), parse(["--metrics", "node", "--metrics", "pod"])
+        )
+        assert cfg.exporter.prometheus.metrics_level == Level.NODE | Level.POD
+
+    def test_listen_address_repeatable(self):
+        cfg = apply_flags(
+            default_config(),
+            parse(["--web.listen-address", ":1234",
+                   "--web.listen-address", "localhost:5678"]),
+        )
+        assert cfg.web.listen_addresses == [":1234", "localhost:5678"]
+
+
+class TestValidation:
+    def test_valid_default(self):
+        default_config().validate(skip=["host"])
+
+    def test_bad_log_level(self):
+        cfg = default_config()
+        cfg.log.level = "verbose"
+        with pytest.raises(ValueError, match="log level"):
+            cfg.validate(skip=["host"])
+
+    def test_host_validation_skippable(self):
+        cfg = default_config()
+        cfg.host.sysfs = "/nonexistent-sysfs"
+        cfg.validate(skip=["host"])  # ok
+        with pytest.raises(ValueError, match="sysfs"):
+            cfg.validate()
+
+    def test_kube_requires_node_name(self):
+        cfg = default_config()
+        cfg.kube.enabled = True
+        with pytest.raises(ValueError, match="nodeName"):
+            cfg.validate(skip=["host"])
+        cfg.validate(skip=["host", "kube"])  # skippable
+
+    def test_negative_interval_rejected(self):
+        cfg = default_config()
+        cfg.monitor.interval = -1
+        with pytest.raises(ValueError, match="interval"):
+            cfg.validate(skip=["host"])
+
+
+class TestLevel:
+    def test_parse_single(self):
+        assert parse_level(["node"]) == Level.NODE
+        assert parse_level(["ALL"]) == Level.all()
+
+    def test_parse_combined(self):
+        lv = parse_level(["node", "container"])
+        assert Level.NODE in lv and Level.CONTAINER in lv
+        assert Level.PROCESS not in lv
+
+    def test_parse_invalid(self):
+        with pytest.raises(ValueError, match="invalid metrics level"):
+            parse_level(["gpu"])
+
+    def test_str(self):
+        assert str(Level.all()) == "all"
+        assert str(Level.NODE | Level.POD) == "node|pod"
+
+
+class TestDuration:
+    @pytest.mark.parametrize(
+        "s,expected",
+        [("5s", 5.0), ("500ms", 0.5), ("1m30s", 90.0), ("2h", 7200.0),
+         ("5", 5.0), (5, 5.0), (0.25, 0.25), ("100us", 1e-4)],
+    )
+    def test_parse(self, s, expected):
+        assert _parse_duration(s) == pytest.approx(expected)
+
+    @pytest.mark.parametrize("s", ["", "abc", "5x", None, []])
+    def test_parse_invalid(self, s):
+        with pytest.raises((ValueError, TypeError)):
+            _parse_duration(s)
+
+    def test_format(self):
+        assert format_duration(5.0) == "5s"
+        assert format_duration(0.5) == "500ms"
+
+
+class TestBuilder:
+    def test_fragments_merge_last_wins(self):
+        cfg = (
+            Builder()
+            .use("log: {level: debug}")
+            .use("monitor: {interval: 1s}")
+            .use("log: {level: error}")
+            .build()
+        )
+        assert cfg.log.level == "error"
+        assert cfg.monitor.interval == 1.0

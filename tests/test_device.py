@@ -1,0 +1,222 @@
+"""Device-layer tests.
+
+Mirrors the reference suites: ``rapl_sysfs_power_meter_test.go`` (discovery
+against a tempdir fake sysfs tree), ``energy_zone_test.go`` (aggregation +
+wraparound), ``rapl_zone_filtering_test.go`` (name filter),
+``fake_cpu_power_meter_test.go``.
+"""
+
+import os
+
+import pytest
+
+from kepler_tpu.device import (
+    AggregatedZone,
+    Energy,
+    FakeCPUMeter,
+    RaplPowerMeter,
+    zone_rank,
+)
+from kepler_tpu.device.rapl import canonical_zone_key
+
+
+def make_zone(root, dirname, name, energy_uj, max_uj=2**32):
+    path = os.path.join(root, "class", "powercap", dirname)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "name"), "w") as f:
+        f.write(name + "\n")
+    with open(os.path.join(path, "energy_uj"), "w") as f:
+        f.write(str(energy_uj) + "\n")
+    with open(os.path.join(path, "max_energy_range_uj"), "w") as f:
+        f.write(str(max_uj) + "\n")
+    return path
+
+
+class FakeCounterZone:
+    """Scriptable zone: returns queued readings in order."""
+
+    def __init__(self, name, readings, max_uj=1000, index=0):
+        self._name = name
+        self.readings = list(readings)
+        self._max = max_uj
+        self._index = index
+
+    def name(self):
+        return self._name
+
+    def index(self):
+        return self._index
+
+    def path(self):
+        return f"test://{self._name}"
+
+    def energy(self):
+        return Energy(self.readings.pop(0))
+
+    def max_energy(self):
+        return Energy(self._max)
+
+
+class TestSysfsDiscovery:
+    def test_discovers_and_reads_zones(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 1_000_000)
+        make_zone(root, "intel-rapl:0:0", "core", 400_000)
+        make_zone(root, "intel-rapl:0:1", "dram", 200_000)
+        meter = RaplPowerMeter(sysfs_path=root)
+        meter.init()
+        by_name = {z.name(): z for z in meter.zones()}
+        assert set(by_name) == {"package-0", "core", "dram"}
+        assert int(by_name["package-0"].energy()) == 1_000_000
+        assert int(by_name["core"].energy()) == 400_000
+
+    def test_non_rapl_dirs_ignored(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 10)
+        os.makedirs(os.path.join(root, "class/powercap/dtpm"), exist_ok=True)
+        os.makedirs(
+            os.path.join(root, "class/powercap/intel-rapl"), exist_ok=True
+        )  # control dir without counters
+        meter = RaplPowerMeter(sysfs_path=root)
+        meter.init()
+        assert [z.name() for z in meter.zones()] == ["package-0"]
+
+    def test_no_zones_raises(self, tmp_path):
+        os.makedirs(os.path.join(str(tmp_path), "class/powercap"))
+        with pytest.raises(RuntimeError, match="no RAPL zones"):
+            RaplPowerMeter(sysfs_path=str(tmp_path)).init()
+
+    def test_missing_powercap_raises(self, tmp_path):
+        with pytest.raises(RuntimeError, match="powercap"):
+            RaplPowerMeter(sysfs_path=str(tmp_path)).init()
+
+    def test_primary_zone_priority(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 1)
+        make_zone(root, "intel-rapl:0:0", "core", 1)
+        make_zone(root, "intel-rapl:0:1", "dram", 1)
+        meter = RaplPowerMeter(sysfs_path=root)
+        meter.init()
+        assert meter.primary_energy_zone().name() == "package-0"
+
+    def test_psys_beats_package(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 1)
+        make_zone(root, "intel-rapl:1", "psys", 1)
+        meter = RaplPowerMeter(sysfs_path=root)
+        meter.init()
+        assert meter.primary_energy_zone().name() == "psys"
+
+
+class TestZoneFiltering:
+    def test_filter_keeps_named_zones(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 1)
+        make_zone(root, "intel-rapl:0:0", "core", 1)
+        make_zone(root, "intel-rapl:0:1", "dram", 1)
+        meter = RaplPowerMeter(sysfs_path=root, zone_filter=["package", "dram"])
+        meter.init()
+        assert sorted(z.name() for z in meter.zones()) == ["dram", "package-0"]
+
+    def test_filter_is_case_insensitive(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 1)
+        meter = RaplPowerMeter(sysfs_path=root, zone_filter=["PACKAGE"])
+        meter.init()
+        assert len(meter.zones()) == 1
+
+
+class TestMultiSocketAggregation:
+    def test_same_name_zones_aggregate(self, tmp_path):
+        root = str(tmp_path)
+        make_zone(root, "intel-rapl:0", "package-0", 100)
+        make_zone(root, "intel-rapl:1", "package-1", 200)
+        meter = RaplPowerMeter(sysfs_path=root)
+        meter.init()
+        zones = meter.zones()
+        assert len(zones) == 1
+        assert isinstance(zones[0], AggregatedZone)
+        # first read seeds at sum of current counters
+        assert int(zones[0].energy()) == 300
+
+    def test_canonical_key(self):
+        assert canonical_zone_key("package-0") == "package"
+        assert canonical_zone_key("package-12") == "package"
+        assert canonical_zone_key("psys") == "psys"
+        assert canonical_zone_key("DRAM") == "dram"
+
+
+class TestAggregatedZone:
+    def test_sums_deltas_across_reads(self):
+        a = FakeCounterZone("package-0", [100, 150, 160])
+        b = FakeCounterZone("package-1", [200, 210, 260])
+        agg = AggregatedZone([a, b])
+        assert int(agg.energy()) == 300  # seed = 100+200
+        assert int(agg.energy()) == 360  # +50 +10
+        assert int(agg.energy()) == 420  # +10 +50
+
+    def test_subzone_wraparound(self):
+        # zone wraps from 990 → 15 with max 1000 → delta = (1000-990)+15 = 25
+        a = FakeCounterZone("package-0", [990, 15], max_uj=1000)
+        b = FakeCounterZone("package-1", [0, 0], max_uj=1000)
+        agg = AggregatedZone([a, b])
+        assert int(agg.energy()) == 990
+        # max_energy = 2000; 990+25 = 1015 < 2000 → no aggregate wrap
+        assert int(agg.energy()) == 1015
+
+    def test_aggregate_wraps_at_combined_max(self):
+        a = FakeCounterZone("p", [900, 950], max_uj=1000)
+        b = FakeCounterZone("p", [900, 980], max_uj=1000)
+        agg = AggregatedZone([a, b])
+        assert int(agg.energy()) == 1800
+        # +50 +80 = 1930 < 2000 OK; force wrap with another read
+        a.readings.append(999)
+        b.readings.append(999)
+        assert int(agg.energy()) == 1930
+        assert int(agg.energy()) == (1930 + 49 + 19) % 2000
+
+    def test_max_energy_overflow_clamp(self):
+        a = FakeCounterZone("p", [], max_uj=2**63)
+        b = FakeCounterZone("p", [], max_uj=2**63)
+        agg = AggregatedZone([a, b])
+        assert int(agg.max_energy()) == 2**64 - 1
+
+    def test_empty_zones_rejected(self):
+        with pytest.raises(ValueError):
+            AggregatedZone([])
+
+
+class TestFakeMeter:
+    def test_default_zones(self):
+        meter = FakeCPUMeter(seed=42)
+        names = [z.name() for z in meter.zones()]
+        assert names == ["package", "core", "dram", "uncore"]
+        assert meter.primary_energy_zone().name() == "package"
+
+    def test_counters_monotonic_mod_wrap(self):
+        meter = FakeCPUMeter(seed=7)
+        zone = meter.zones()[0]
+        e1, e2 = int(zone.energy()), int(zone.energy())
+        max_e = int(zone.max_energy())
+        assert 0 <= e1 < max_e and 0 <= e2 < max_e
+        assert e2 != e1  # advances every read
+
+    def test_custom_zone_names(self):
+        meter = FakeCPUMeter(zones=["package"], seed=1)
+        assert [z.name() for z in meter.zones()] == ["package"]
+
+    def test_seeded_meters_reproducible(self):
+        e1 = int(FakeCPUMeter(seed=5).zones()[0].energy())
+        e2 = int(FakeCPUMeter(seed=5).zones()[0].energy())
+        # initial counter value is seed-determined (time-scaled increment
+        # differs, but the starting point dominates)
+        assert abs(e1 - e2) < 1_000_000
+
+
+class TestZoneRank:
+    def test_priority_order(self):
+        assert zone_rank("psys") < zone_rank("package")
+        assert zone_rank("package-0") < zone_rank("core")
+        assert zone_rank("core") < zone_rank("dram")
+        assert zone_rank("dram") < zone_rank("uncore")
+        assert zone_rank("mystery") > zone_rank("uncore")

@@ -1,0 +1,36 @@
+"""Unit-type tests (reference ``internal/device/energy_test.go``, 199 LoC)."""
+
+from kepler_tpu.device.energy import (
+    JOULE,
+    KILO_JOULE,
+    MICRO_JOULE,
+    MILLI_JOULE,
+    WATT,
+    Energy,
+    Power,
+)
+
+
+def test_energy_conversions():
+    assert Energy(1 * JOULE).joules == 1.0
+    assert Energy(1_500 * MILLI_JOULE).joules == 1.5
+    assert Energy(2 * KILO_JOULE).joules == 2000.0
+    assert Energy(123).micro_joules == 123
+    assert MICRO_JOULE == 1
+
+
+def test_energy_string():
+    assert str(Energy(1_230_000)) == "1.23J"
+    assert str(Energy(0)) == "0.00J"
+
+
+def test_energy_arithmetic_is_exact():
+    a = Energy(2**62)
+    b = Energy(123)
+    assert int(a) + int(b) == 2**62 + 123
+
+
+def test_power_conversions():
+    assert Power(1 * WATT).watts == 1.0
+    assert Power(2_500_000).watts == 2.5
+    assert str(Power(1_500_000)) == "1.50W"

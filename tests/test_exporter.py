@@ -1,0 +1,293 @@
+"""Exporter + server tests.
+
+Mirrors reference suites: ``power_collector_test.go`` (scrape via test
+server, assert metric text), ``stdout_test.go``, ``server_test.go``
+(landing page, endpoint registration), ``pod_test.go`` (containerID index,
+scheme stripping).
+"""
+
+import io
+import urllib.request
+
+import pytest
+
+from kepler_tpu.config.level import Level
+from kepler_tpu.exporter.prometheus import (
+    PowerCollector,
+    PrometheusExporter,
+    create_collectors,
+)
+from kepler_tpu.exporter.stdout import StdoutExporter
+from kepler_tpu.k8s.pod import PodInformer, _strip_scheme
+from kepler_tpu.server.debug import DebugService
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+import threading
+
+from tests.test_monitor import MockProc, make_monitor
+
+CID = "d" * 64
+
+
+def scrape(registry):
+    from prometheus_client.exposition import generate_latest
+    return generate_latest(registry).decode()
+
+
+def make_ready_monitor():
+    procs = [MockProc(1, cpu=1.0, comm="bash", exe="/bin/bash"),
+             MockProc(2, cpu=1.0, cgroups=[f"/docker-{CID}.scope"],
+                      env={"HOSTNAME": "web-1"})]
+    mon, reader, zones, clock = make_monitor(procs, ratio=0.5)
+    mon.refresh()
+    zones[0].increment = 100_000_000
+    zones[1].increment = 30_000_000
+    for p in procs:
+        p.cpu += 1.0
+    clock.step(5.0)
+    mon.refresh()
+    # make the snapshot fresh forever for test purposes
+    mon._staleness = 1e9
+    return mon
+
+
+class TestPowerCollector:
+    def test_metric_families_present(self):
+        mon = make_ready_monitor()
+        from prometheus_client import CollectorRegistry
+        reg = CollectorRegistry()
+        reg.register(PowerCollector(mon, node_name="node-a"))
+        text = scrape(reg)
+        for family in [
+            "kepler_node_cpu_joules_total",
+            "kepler_node_cpu_active_joules_total",
+            "kepler_node_cpu_idle_joules_total",
+            "kepler_node_cpu_watts",
+            "kepler_node_cpu_active_watts",
+            "kepler_node_cpu_idle_watts",
+            "kepler_node_cpu_usage_ratio",
+            "kepler_process_cpu_joules_total",
+            "kepler_process_cpu_watts",
+            "kepler_process_cpu_seconds_total",
+            "kepler_container_cpu_joules_total",
+            "kepler_container_cpu_watts",
+        ]:
+            assert family in text, f"missing {family}"
+        assert 'node_name="node-a"' in text
+        assert 'comm="bash"' in text
+        assert f'container_id="{CID}"' in text
+        assert 'state="running"' in text
+        assert 'zone="package"' in text
+
+    def test_values_scaled_to_joules_and_watts(self):
+        mon = make_ready_monitor()
+        from prometheus_client import CollectorRegistry
+        reg = CollectorRegistry()
+        reg.register(PowerCollector(mon))
+        text = scrape(reg)
+        # 100 J package delta; power = 100 J / 5 s = 20 W
+        line = [l for l in text.splitlines()
+                if l.startswith("kepler_node_cpu_joules_total")
+                and 'zone="package"' in l][0]
+        assert float(line.rsplit(" ", 1)[1]) == pytest.approx(100.0, rel=1e-5)
+        wline = [l for l in text.splitlines()
+                 if l.startswith("kepler_node_cpu_watts")
+                 and 'zone="package"' in l][0]
+        assert float(wline.rsplit(" ", 1)[1]) == pytest.approx(20.0, rel=1e-5)
+
+    def test_metrics_level_filtering(self):
+        mon = make_ready_monitor()
+        from prometheus_client import CollectorRegistry
+        reg = CollectorRegistry()
+        reg.register(PowerCollector(mon, metrics_level=Level.NODE))
+        text = scrape(reg)
+        assert "kepler_node_cpu_joules_total" in text
+        assert "kepler_process_cpu_joules_total" not in text
+        assert "kepler_container_cpu_joules_total" not in text
+
+    def test_not_ready_yields_nothing(self):
+        from tests.test_monitor import make_monitor as mk
+        mon, *_ = mk([MockProc(1, cpu=1.0)])
+        # no refresh yet → data channel unset
+        from prometheus_client import CollectorRegistry
+        reg = CollectorRegistry()
+        reg.register(PowerCollector(mon, ready_timeout=0.0))
+        text = scrape(reg)
+        assert "kepler_node_cpu_joules_total" not in text
+
+    def test_consistent_scrape_uses_one_snapshot(self):
+        mon = make_ready_monitor()
+        from prometheus_client import CollectorRegistry
+        reg = CollectorRegistry()
+        reg.register(PowerCollector(mon))
+        text = scrape(reg)
+        # Σ process joules ≈ node active joules for each zone (conservation
+        # visible at the exported-text level)
+        import re
+        def values(prefix, zone):
+            out = []
+            for line in text.splitlines():
+                if line.startswith(prefix) and f'zone="{zone}"' in line:
+                    out.append(float(line.rsplit(" ", 1)[1]))
+            return out
+        total_proc = sum(values("kepler_process_cpu_joules_total", "package"))
+        node_active = values("kepler_node_cpu_active_joules_total",
+                             "package")[0]
+        assert total_proc == pytest.approx(node_active, rel=1e-4)
+
+
+class TestInfoCollectors:
+    def test_build_info(self):
+        from prometheus_client import CollectorRegistry
+        from kepler_tpu.exporter.prometheus import BuildInfoCollector
+        reg = CollectorRegistry()
+        reg.register(BuildInfoCollector())
+        text = scrape(reg)
+        assert "kepler_build_info" in text
+
+    def test_cpu_info_real_procfs(self):
+        from prometheus_client import CollectorRegistry
+        from kepler_tpu.exporter.prometheus import CPUInfoCollector
+        reg = CollectorRegistry()
+        reg.register(CPUInfoCollector())
+        text = scrape(reg)
+        assert "kepler_node_cpu_info" in text
+
+
+class TestStdoutExporter:
+    def test_write_once_renders_table(self):
+        mon = make_ready_monitor()
+        buf = io.StringIO()
+        exp = StdoutExporter(mon, writer=buf)
+        exp.write_once()
+        out = buf.getvalue()
+        assert "Zone" in out and "package" in out and "dram" in out
+        assert "Power (W)" in out
+        assert "procs" in out
+
+
+class TestAPIServer:
+    def make_server(self):
+        server = APIServer(listen_addresses=["127.0.0.1:0"])
+        server.init()
+        ctx = CancelContext()
+        t = threading.Thread(target=server.run, args=(ctx,), daemon=True)
+        t.start()
+        host, port = server.addresses[0]
+        return server, ctx, f"http://{host}:{port}"
+
+    def test_landing_page_lists_endpoints(self):
+        server, ctx, base = self.make_server()
+        try:
+            server.register("/metrics", "Metrics", "Prometheus metrics",
+                            lambda r: (200, {"Content-Type": "text/plain"},
+                                       b"ok"))
+            html = urllib.request.urlopen(base + "/").read().decode()
+            assert "Metrics" in html and "/metrics" in html
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+    def test_endpoint_serving_and_404(self):
+        server, ctx, base = self.make_server()
+        try:
+            server.register("/ping", "Ping", "ping", lambda r: (
+                200, {"Content-Type": "text/plain"}, b"pong"))
+            assert urllib.request.urlopen(base + "/ping").read() == b"pong"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/nope")
+            assert e.value.code == 404
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+    def test_full_prometheus_scrape_over_http(self):
+        """End-to-end: monitor → exporter → HTTP server → scrape."""
+        mon = make_ready_monitor()
+        server, ctx, base = self.make_server()
+        try:
+            exporter = PrometheusExporter(
+                server, create_collectors(mon, node_name="n1"))
+            exporter.init()
+            text = urllib.request.urlopen(base + "/metrics").read().decode()
+            assert "kepler_node_cpu_joules_total" in text
+            assert "kepler_build_info" in text
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+    def test_debug_endpoints(self):
+        server, ctx, base = self.make_server()
+        try:
+            DebugService(server).init()
+            index = urllib.request.urlopen(
+                base + "/debug/pprof/").read().decode()
+            assert "stack" in index
+            stacks = urllib.request.urlopen(
+                base + "/debug/pprof/stack").read().decode()
+            assert "thread" in stacks
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+
+class TestPodInformerIndex:
+    def test_strip_scheme(self):
+        assert _strip_scheme("containerd://abc") == "abc"
+        assert _strip_scheme("docker://xyz") == "xyz"
+        assert _strip_scheme("bare") == "bare"
+
+    def pod_obj(self, uid, name, ns, statuses):
+        return {
+            "metadata": {"uid": uid, "name": name, "namespace": ns,
+                         "resourceVersion": "1"},
+            "status": {"containerStatuses": [
+                {"name": n, "containerID": cid} for n, cid in statuses
+            ]},
+        }
+
+    def test_index_and_lookup(self):
+        inf = PodInformer(node_name="n1", client=object())
+        pod = self.pod_obj("uid-1", "web", "default",
+                           [("app", f"containerd://{CID}")])
+        inf._apply_event({"type": "ADDED", "object": pod})
+        assert inf.lookup_by_container_id(CID) == (
+            "uid-1", "web", "default", "app")
+        # lookup with scheme also resolves
+        assert inf.lookup_by_container_id(f"containerd://{CID}") is not None
+
+    def test_init_and_ephemeral_containers_indexed(self):
+        inf = PodInformer(node_name="n1", client=object())
+        pod = {
+            "metadata": {"uid": "u", "name": "p", "namespace": "ns"},
+            "status": {
+                "initContainerStatuses": [
+                    {"name": "init", "containerID": "containerd://" + "1" * 64}
+                ],
+                "ephemeralContainerStatuses": [
+                    {"name": "dbg", "containerID": "containerd://" + "2" * 64}
+                ],
+            },
+        }
+        inf._apply_event({"type": "ADDED", "object": pod})
+        assert inf.lookup_by_container_id("1" * 64)[3] == "init"
+        assert inf.lookup_by_container_id("2" * 64)[3] == "dbg"
+
+    def test_delete_removes_index(self):
+        inf = PodInformer(node_name="n1", client=object())
+        pod = self.pod_obj("uid-1", "web", "default",
+                           [("app", f"containerd://{CID}")])
+        inf._apply_event({"type": "ADDED", "object": pod})
+        inf._apply_event({"type": "DELETED", "object": pod})
+        assert inf.lookup_by_container_id(CID) is None
+
+    def test_modify_replaces_containers(self):
+        inf = PodInformer(node_name="n1", client=object())
+        old = self.pod_obj("uid-1", "web", "default",
+                           [("app", "containerd://" + "3" * 64)])
+        new = self.pod_obj("uid-1", "web", "default",
+                           [("app", "containerd://" + "4" * 64)])
+        inf._apply_event({"type": "ADDED", "object": old})
+        inf._apply_event({"type": "MODIFIED", "object": new})
+        assert inf.lookup_by_container_id("3" * 64) is None
+        assert inf.lookup_by_container_id("4" * 64) is not None

@@ -1,0 +1,109 @@
+"""Service lifecycle tests (reference ``internal/service/{initializer,run}_test.go``:
+Init order, rollback-shutdown on failure, run-group cancellation)."""
+
+import threading
+
+import pytest
+
+from kepler_tpu.service import (
+    CancelContext,
+    ServiceError,
+    init_services,
+    run_services,
+)
+
+
+class Recorder:
+    def __init__(self):
+        self.events = []
+        self.lock = threading.Lock()
+
+    def add(self, event):
+        with self.lock:
+            self.events.append(event)
+
+
+class FakeService:
+    def __init__(self, name, rec, init_error=None, has_run=False,
+                 run_error=None, run_returns_immediately=False):
+        self._name = name
+        self.rec = rec
+        self.init_error = init_error
+        self.run_error = run_error
+        self.run_returns_immediately = run_returns_immediately
+        if has_run or run_error or run_returns_immediately:
+            self.run = self._run
+
+    def name(self):
+        return self._name
+
+    def init(self):
+        if self.init_error:
+            raise self.init_error
+        self.rec.add(f"init:{self._name}")
+
+    def _run(self, ctx):
+        self.rec.add(f"run:{self._name}")
+        if self.run_error:
+            raise self.run_error
+        if not self.run_returns_immediately:
+            ctx.wait(5.0)
+
+    def shutdown(self):
+        self.rec.add(f"shutdown:{self._name}")
+
+
+class TestInit:
+    def test_init_order_sequential(self):
+        rec = Recorder()
+        init_services([FakeService("a", rec), FakeService("b", rec),
+                       FakeService("c", rec)])
+        assert rec.events == ["init:a", "init:b", "init:c"]
+
+    def test_rollback_on_failure(self):
+        rec = Recorder()
+        services = [
+            FakeService("a", rec),
+            FakeService("b", rec),
+            FakeService("c", rec, init_error=RuntimeError("boom")),
+            FakeService("d", rec),
+        ]
+        with pytest.raises(ServiceError, match="c"):
+            init_services(services)
+        # a and b initialized then rolled back in reverse; d never touched
+        assert rec.events == ["init:a", "init:b", "shutdown:b", "shutdown:a"]
+
+    def test_service_without_init_skipped(self):
+        class Bare:
+            def name(self):
+                return "bare"
+
+        init_services([Bare()])  # no error
+
+
+class TestRun:
+    def test_first_return_cancels_group(self):
+        rec = Recorder()
+        quick = FakeService("quick", rec, run_returns_immediately=True)
+        slow = FakeService("slow", rec, has_run=True)
+        ctx = CancelContext()
+        run_services(ctx, [quick, slow])
+        assert ctx.cancelled()
+        assert "run:quick" in rec.events and "run:slow" in rec.events
+        # shutdowns run in reverse service order
+        shutdowns = [e for e in rec.events if e.startswith("shutdown")]
+        assert shutdowns == ["shutdown:slow", "shutdown:quick"]
+
+    def test_runner_error_propagates(self):
+        rec = Recorder()
+        bad = FakeService("bad", rec, run_error=RuntimeError("crash"))
+        other = FakeService("other", rec, has_run=True)
+        with pytest.raises(ServiceError):
+            run_services(CancelContext(), [bad, other])
+
+    def test_non_runner_services_still_shut_down(self):
+        rec = Recorder()
+        runner = FakeService("runner", rec, run_returns_immediately=True)
+        passive = FakeService("passive", rec)
+        run_services(CancelContext(), [passive, runner])
+        assert "shutdown:passive" in rec.events
