@@ -1,0 +1,147 @@
+"""North-star benchmark: cluster-batched attribution latency.
+
+BASELINE.json: "<1 ms p99 attribution latency for 10k pods across 1k nodes
+on a single v5e-1" (the reference publishes no numbers of its own —
+BASELINE.md). Scenario 5: 1k nodes × ~100 pods each, mixed RAPL-ratio +
+MLP-estimated, evaluated as ONE sharded device program.
+
+Measures end-to-end device-step latency: host batch → device (H2D), the
+fused ratio+MLP attribution program, and the attributed watts back to host
+(D2H — the "scatter back to node collectors" leg). p99 over 50 timed
+iterations after warmup.
+
+Prints ONE JSON line:
+  {"metric": "fleet_attribution_p99_latency", "value": <ms>, "unit": "ms",
+   "vs_baseline": <north-star 1 ms / measured — >1 means beating target>}
+
+If the accelerator runtime wedges during init (tunnel loss), falls back to
+CPU after a timeout so the driver always gets its JSON line (flagged via
+"platform" in the extra fields).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+N_NODES = 1024  # 1k nodes (bucketed)
+N_WORKLOADS = 128  # ~100 pods/node padded to bucket
+N_ZONES = 4  # package/core/dram/uncore
+TARGET_MS = 1.0  # north-star p99
+INIT_TIMEOUT_S = 180
+
+
+def _init_jax_with_timeout():
+    """Import jax + touch devices; fall back to CPU if init hangs."""
+
+    def on_timeout(*_):
+        raise TimeoutError
+
+    old = signal.signal(signal.SIGALRM, on_timeout)
+    signal.alarm(INIT_TIMEOUT_S)
+    try:
+        import jax
+
+        if (os.environ.get("KEPLER_BENCH_CPU_FALLBACK")
+                or os.environ.get("JAX_PLATFORMS") == "cpu"):
+            # an ambient accelerator shim may force jax_platforms at
+            # registration time; env vars alone don't stick (see
+            # tests/conftest.py)
+            jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        signal.alarm(0)
+        return jax, devs[0].platform
+    except (TimeoutError, RuntimeError) as err:
+        signal.alarm(0)
+        print(f"accelerator init failed ({err!r}); retrying on CPU",
+              file=sys.stderr)
+        os.execvpe(
+            sys.executable,
+            [sys.executable, os.path.abspath(__file__)],
+            {**os.environ, "JAX_PLATFORMS": "cpu",
+             "KEPLER_BENCH_CPU_FALLBACK": "1"},
+        )
+    finally:
+        signal.signal(signal.SIGALRM, old)
+
+
+def main() -> None:
+    jax, platform = _init_jax_with_timeout()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kepler_tpu.models import init_mlp
+    from kepler_tpu.parallel import make_fleet_program, make_mesh
+
+    mesh = make_mesh(devices=jax.devices()[:1])  # single chip (v5e-1)
+    program = make_fleet_program(mesh, model_mode="mlp")
+    params = init_mlp(jax.random.PRNGKey(0), n_zones=N_ZONES)
+
+    rng = np.random.default_rng(0)
+    cpu_h = rng.uniform(0.0, 5.0, (N_NODES, N_WORKLOADS)).astype(np.float32)
+    valid_h = np.zeros((N_NODES, N_WORKLOADS), bool)
+    for i in range(N_NODES):  # ~100 real pods per node, ragged
+        valid_h[i, : rng.integers(80, 121)] = True
+    cpu_h = np.where(valid_h, cpu_h, 0.0).astype(np.float32)
+    host_batch = dict(
+        zone=rng.uniform(1e7, 5e8, (N_NODES, N_ZONES)).astype(np.float32),
+        zone_valid=np.ones((N_NODES, N_ZONES), bool),
+        ratio=rng.uniform(0.2, 0.9, N_NODES).astype(np.float32),
+        cpu=cpu_h,
+        valid=valid_h,
+        denom=cpu_h.sum(axis=1).astype(np.float32),
+        dt=np.full(N_NODES, 5.0, np.float32),
+        mode=(np.arange(N_NODES) % 2).astype(np.int32),  # mixed fleet
+    )
+
+    def step():
+        out = program(
+            params,
+            jnp.asarray(host_batch["zone"]),
+            jnp.asarray(host_batch["zone_valid"]),
+            jnp.asarray(host_batch["ratio"]),
+            jnp.asarray(host_batch["cpu"]),
+            jnp.asarray(host_batch["valid"]),
+            jnp.asarray(host_batch["denom"]),
+            jnp.asarray(host_batch["dt"]),
+            jnp.asarray(host_batch["mode"]),
+        )
+        # D2H of the attributed watts — the scatter-back leg
+        np.asarray(out.workload_power_uw)
+        np.asarray(out.node_power_uw)
+
+    n_warm, n_iter = (5, 50) if platform != "cpu" else (1, 10)
+    n_iter = int(os.environ.get("KEPLER_BENCH_ITERS", n_iter))
+    for _ in range(n_warm):  # warmup + compile
+        step()
+    times = []
+    for _ in range(n_iter):
+        t0 = time.perf_counter()
+        step()
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    import math
+
+    p99 = times[math.ceil(0.99 * len(times)) - 1]  # nearest-rank p99
+    p50 = times[len(times) // 2]
+    pods = int(valid_h.sum())
+    result = {
+        "metric": "fleet_attribution_p99_latency",
+        "value": round(p99, 4),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p99, 3),
+        "p50_ms": round(p50, 4),
+        "pods": pods,
+        "nodes": N_NODES,
+        "pods_per_sec": round(pods / (p50 / 1e3)),
+        "platform": platform,
+        "cpu_fallback": bool(os.environ.get("KEPLER_BENCH_CPU_FALLBACK")),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Learned power models (kepler-model-server capability)."""
+
+from kepler_tpu.models.estimator import (
+    LINEAR,
+    MLP,
+    RATIO,
+    ModelEstimator,
+    initializer,
+    predictor,
+)
+from kepler_tpu.models.features import NUM_FEATURES, build_features
+from kepler_tpu.models.linear import LinearParams, init_linear, predict_linear
+from kepler_tpu.models.mlp import MLPParams, init_mlp, predict_mlp
+from kepler_tpu.models.train import (
+    TrainState,
+    create_train_state,
+    fit,
+    make_optimizer,
+    make_train_step,
+    masked_mse,
+)
+
+__all__ = [
+    "LINEAR",
+    "LinearParams",
+    "MLP",
+    "MLPParams",
+    "ModelEstimator",
+    "NUM_FEATURES",
+    "RATIO",
+    "TrainState",
+    "build_features",
+    "create_train_state",
+    "fit",
+    "init_linear",
+    "init_mlp",
+    "initializer",
+    "make_optimizer",
+    "make_train_step",
+    "masked_mse",
+    "predict_linear",
+    "predict_mlp",
+    "predictor",
+]

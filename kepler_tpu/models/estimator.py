@@ -1,0 +1,89 @@
+"""Estimator registry: pluggable power backends behind one interface.
+
+The reference's monitor hard-codes ratio attribution; BASELINE.json's north
+star puts ratio + learned models behind one switchable backend
+(``power.estimator``). An estimator maps a feature window to per-workload
+watts [W, Z]; the ratio backend additionally needs zone deltas.
+
+Modes (BASELINE configs):
+  "ratio"  — RAPL proportional attribution (configs 1-2)
+  "linear" — linear regression from features  (config 3)
+  "mlp"    — MLP from features                (config 4)
+Mixed fleets evaluate ratio and model in the same device program and select
+per node (config 5; see ``kepler_tpu.parallel.aggregator``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import build_features
+from kepler_tpu.models.linear import init_linear, predict_linear
+from kepler_tpu.models.mlp import init_mlp, predict_mlp
+
+RATIO = "ratio"
+LINEAR = "linear"
+MLP = "mlp"
+
+_PREDICTORS: dict[str, Callable] = {
+    LINEAR: predict_linear,
+    MLP: predict_mlp,
+}
+
+_INITIALIZERS: dict[str, Callable] = {
+    LINEAR: init_linear,
+    MLP: init_mlp,
+}
+
+
+def initializer(mode: str) -> Callable:
+    if mode == RATIO:
+        raise ValueError(
+            "ratio attribution has no learned parameters; only "
+            f"{', '.join(_INITIALIZERS)} need initialization")
+    if mode not in _INITIALIZERS:
+        raise ValueError(f"unknown estimator mode {mode!r}; "
+                         f"valid: {RATIO}, {', '.join(_INITIALIZERS)}")
+    return _INITIALIZERS[mode]
+
+
+def predictor(mode: str) -> Callable | None:
+    """→ predict fn for a learned mode; None for RATIO (no model to run)."""
+    if mode == RATIO:
+        return None
+    if mode not in _PREDICTORS:
+        raise ValueError(f"unknown estimator mode {mode!r}; "
+                         f"valid: {RATIO}, {', '.join(_PREDICTORS)}")
+    return _PREDICTORS[mode]
+
+
+@dataclass
+class ModelEstimator:
+    """A trained model + its mode, usable wherever ratio attribution is."""
+
+    mode: str
+    params: Any
+
+    @classmethod
+    def create(cls, mode: str, n_zones: int, seed: int = 0,
+               **kwargs) -> "ModelEstimator":
+        key = jax.random.PRNGKey(seed)
+        return cls(mode=mode,
+                   params=initializer(mode)(key, n_zones, **kwargs))
+
+    def predict_watts(
+        self,
+        cpu_deltas: jax.Array,
+        workload_valid: jax.Array,
+        node_cpu_delta: jax.Array,
+        usage_ratio: jax.Array,
+        dt_s: jax.Array,
+    ) -> jax.Array:
+        """Features → watts [..., W, Z] (µW = watts * 1e6 handled by caller)."""
+        feats = build_features(cpu_deltas, workload_valid, node_cpu_delta,
+                               usage_ratio, dt_s)
+        return predictor(self.mode)(self.params, feats, workload_valid)

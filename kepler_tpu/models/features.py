@@ -1,0 +1,56 @@
+"""Feature engineering for learned power models.
+
+The kepler-model-server (the reference ecosystem's model-serving sidecar,
+referenced by BASELINE.json configs 3-4) predicts workload power from
+resource-usage counters when RAPL isn't available (VMs, non-Intel nodes).
+Here the feature pipeline is a pure function from the informer's
+``FeatureBatch`` (+ node context) to a dense ``[W, F]`` matrix, so the model
+evaluation fuses with ratio attribution in one device program.
+
+Feature vector (F = 6):
+    0: cpu_time_delta       seconds of CPU in the window
+    1: cpu_share            workload delta / node delta (the ratio feature)
+    2: node_usage_ratio     broadcast node active/total ratio
+    3: dt                   window length (s)
+    4: cpu_rate             cpu_time_delta / dt (cores actively used)
+    5: bias                 constant 1.0
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NUM_FEATURES = 6
+
+
+def build_features(
+    cpu_deltas: jax.Array,  # f32 [..., W]
+    workload_valid: jax.Array,  # bool [..., W]
+    node_cpu_delta: jax.Array,  # f32 [...]
+    usage_ratio: jax.Array,  # f32 [...]
+    dt_s: jax.Array,  # f32 [...]
+) -> jax.Array:
+    """→ f32 [..., W, F]; masked rows are all-zero (bias included)."""
+    from kepler_tpu.ops.attribution import _workload_ratios
+
+    deltas = jnp.where(workload_valid, cpu_deltas, 0.0)
+    # the exact ratio the attribution kernel uses — the model's share
+    # feature must match the labels it is trained to reproduce
+    share = _workload_ratios(cpu_deltas, workload_valid, node_cpu_delta)
+    dt = jnp.maximum(dt_s[..., None], 1e-30)
+    rate = jnp.where(dt_s[..., None] > 0, deltas / dt, 0.0)
+    broadcast = jnp.broadcast_to
+    w_shape = deltas.shape
+    feats = jnp.stack(
+        [
+            deltas,
+            share,
+            broadcast(usage_ratio[..., None], w_shape),
+            broadcast(dt_s[..., None], w_shape),
+            rate,
+            jnp.ones_like(deltas),
+        ],
+        axis=-1,
+    )
+    return jnp.where(workload_valid[..., None], feats, 0.0)

@@ -1,0 +1,54 @@
+"""Linear-regression power model.
+
+BASELINE.json config 3: "linear-regression power model (no RAPL; cgroup
+CPU-time features only)" — the kepler-model-server's simplest estimator.
+
+``watts[W, Z] = relu(features[W, F] @ weight[F, Z] + bias[Z])`` — a single
+matmul; batched over nodes it rides the MXU as ``[N*W, F] @ [F, Z]``.
+Output is clamped non-negative (power can't be negative) and masked rows
+predict zero.
+"""
+
+from __future__ import annotations
+
+from typing import TypedDict
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import NUM_FEATURES
+
+
+class LinearParams(TypedDict):
+    weight: jax.Array  # [F, Z]
+    bias: jax.Array  # [Z]
+
+
+def init_linear(
+    key: jax.Array, n_zones: int, n_features: int = NUM_FEATURES
+) -> LinearParams:
+    wkey, _ = jax.random.split(key)
+    return LinearParams(
+        weight=jax.random.normal(wkey, (n_features, n_zones),
+                                 jnp.float32) * 0.01,
+        bias=jnp.zeros((n_zones,), jnp.float32),
+    )
+
+
+def predict_linear(
+    params: LinearParams,
+    features: jax.Array,  # [..., W, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    clamp: bool = True,
+) -> jax.Array:
+    """→ watts f32 [..., W, Z].
+
+    ``clamp=True`` (serving) floors predictions at 0 W; training passes
+    ``clamp=False`` so gradients flow through negative raw outputs (a hard
+    relu at the output dead-locks learning when init predictions are all
+    negative).
+    """
+    watts = features @ params["weight"] + params["bias"]
+    if clamp:
+        watts = jnp.maximum(watts, 0.0)
+    return jnp.where(workload_valid[..., None], watts, 0.0)

@@ -1,0 +1,80 @@
+"""MLP power estimator.
+
+BASELINE.json config 4: "kepler-model-server MLP estimator (perf-counter
+feature set, VM/non-RAPL node)".
+
+Architecture: ``F → H → H → Z`` with GELU, matching the scale of
+kepler-model-server's small regressors but shaped for the MXU: hidden dims
+default to 128 (lane-width multiples), activations compute in bfloat16 with
+float32 params and output (TPU-friendly mixed precision), and the whole
+forward is a pair of matmuls XLA fuses with the surrounding attribution
+program.
+
+The hidden dimension is the tensor-parallel axis in the sharded trainer
+(`kepler_tpu.parallel`): layer-0 weights shard column-wise, layer-1
+row-wise, so the only collective is one psum on the output projection.
+"""
+
+from __future__ import annotations
+
+from typing import TypedDict
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import NUM_FEATURES
+
+
+class MLPParams(TypedDict):
+    w0: jax.Array  # [F, H]
+    b0: jax.Array  # [H]
+    w1: jax.Array  # [H, H]
+    b1: jax.Array  # [H]
+    w2: jax.Array  # [H, Z]
+    b2: jax.Array  # [Z]
+
+
+def init_mlp(
+    key: jax.Array,
+    n_zones: int,
+    hidden: int = 128,
+    n_features: int = NUM_FEATURES,
+) -> MLPParams:
+    k0, k1, k2 = jax.random.split(key, 3)
+
+    def glorot(k, shape):
+        scale = jnp.sqrt(2.0 / (shape[0] + shape[1]))
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    return MLPParams(
+        w0=glorot(k0, (n_features, hidden)),
+        b0=jnp.zeros((hidden,), jnp.float32),
+        w1=glorot(k1, (hidden, hidden)),
+        b1=jnp.zeros((hidden,), jnp.float32),
+        w2=glorot(k2, (hidden, n_zones)),
+        b2=jnp.zeros((n_zones,), jnp.float32),
+    )
+
+
+def predict_mlp(
+    params: MLPParams,
+    features: jax.Array,  # [..., W, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    clamp: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """→ watts f32 [..., W, Z]; bf16 matmuls, f32 accumulation at the end.
+
+    ``clamp`` as in ``predict_linear``: floor at 0 W for serving only —
+    training needs gradients through negative raw outputs.
+    """
+    x = features.astype(compute_dtype)
+    h = jax.nn.gelu(x @ params["w0"].astype(compute_dtype)
+                    + params["b0"].astype(compute_dtype))
+    h = jax.nn.gelu(h @ params["w1"].astype(compute_dtype)
+                    + params["b1"].astype(compute_dtype))
+    watts = (h @ params["w2"].astype(compute_dtype)).astype(jnp.float32)
+    watts = watts + params["b2"]
+    if clamp:
+        watts = jnp.maximum(watts, 0.0)
+    return jnp.where(workload_valid[..., None], watts, 0.0)

@@ -1,0 +1,101 @@
+"""Training for the learned power models.
+
+The models train against RAPL-ratio ground truth: on RAPL-capable nodes the
+ratio attribution gives per-workload watts "labels"; the estimator learns to
+reproduce them from features alone, then serves nodes without RAPL
+(the kepler-model-server train/serve split, BASELINE.json configs 3-4).
+
+``train_step`` is a pure jitted function (loss = masked MSE in watts);
+the distributed variant in ``kepler_tpu.parallel.trainer`` shards batch
+over the data axis and the MLP hidden dim over the model axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Params = Any  # LinearParams | MLPParams pytree
+
+
+class TrainState(NamedTuple):
+    params: Params
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def masked_mse(
+    pred_watts: jax.Array,  # [..., W, Z]
+    target_watts: jax.Array,  # [..., W, Z]
+    workload_valid: jax.Array,  # bool [..., W]
+) -> jax.Array:
+    err = (pred_watts - target_watts) ** 2
+    mask = workload_valid[..., None].astype(err.dtype)
+    total = jnp.sum(err * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def make_optimizer(learning_rate: float = 1e-3,
+                   weight_decay: float = 1e-4) -> optax.GradientTransformation:
+    return optax.adamw(learning_rate, weight_decay=weight_decay)
+
+
+def create_train_state(params: Params,
+                       optimizer: optax.GradientTransformation) -> TrainState:
+    return TrainState(params=params, opt_state=optimizer.init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    predict_fn: Callable[..., jax.Array],
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Build a jitted SGD step: (state, features, valid, targets) → state, loss.
+
+    ``predict_fn`` must accept ``clamp=`` — the loss runs on UNclamped
+    outputs so the serve-time non-negativity floor can't zero the gradients.
+    """
+    train_predict = functools.partial(predict_fn, clamp=False)
+
+    @jax.jit
+    def train_step(
+        state: TrainState,
+        features: jax.Array,  # [B, F] or [N, W, F]
+        workload_valid: jax.Array,
+        target_watts: jax.Array,
+    ) -> tuple[TrainState, jax.Array]:
+        def loss_fn(params):
+            pred = train_predict(params, features, workload_valid)
+            return masked_mse(pred, target_watts, workload_valid)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step
+
+
+def fit(
+    predict_fn: Callable,
+    params: Params,
+    features: jax.Array,
+    workload_valid: jax.Array,
+    target_watts: jax.Array,
+    steps: int = 200,
+    learning_rate: float = 1e-2,
+) -> tuple[Params, float]:
+    """Small full-batch fit loop (host-driven; used by tests/benchmarks)."""
+    optimizer = make_optimizer(learning_rate)
+    state = create_train_state(params, optimizer)
+    step_fn = make_train_step(predict_fn, optimizer)
+    loss = jnp.inf
+    for _ in range(steps):
+        state, loss = step_fn(state, features, workload_valid, target_watts)
+    return state.params, float(loss)

@@ -1,0 +1,39 @@
+"""Parallel/distributed layer: mesh, sharded fleet attribution, trainer."""
+
+from kepler_tpu.parallel.aggregator_core import (
+    FleetResult,
+    fleet_attribution_program,
+    make_fleet_program,
+    run_fleet_attribution,
+)
+from kepler_tpu.parallel.fleet import (
+    MODE_MODEL,
+    MODE_RATIO,
+    FleetBatch,
+    NodeReport,
+    assemble_fleet_batch,
+)
+from kepler_tpu.parallel.mesh import MODEL_AXIS, NODE_AXIS, make_mesh
+from kepler_tpu.parallel.trainer import (
+    make_distributed_train_step,
+    mlp_param_shardings,
+    shard_train_state,
+)
+
+__all__ = [
+    "FleetBatch",
+    "FleetResult",
+    "MODE_MODEL",
+    "MODE_RATIO",
+    "MODEL_AXIS",
+    "NODE_AXIS",
+    "NodeReport",
+    "assemble_fleet_batch",
+    "fleet_attribution_program",
+    "make_distributed_train_step",
+    "make_fleet_program",
+    "make_mesh",
+    "mlp_param_shardings",
+    "run_fleet_attribution",
+    "shard_train_state",
+]

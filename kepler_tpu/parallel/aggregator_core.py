@@ -1,0 +1,158 @@
+"""The sharded cluster-attribution program.
+
+BASELINE.json north star: gather per-node feature rows, evaluate
+ratio-attribution AND learned estimators as one batched computation over
+``[nodes × pods × features]`` on TPU, scatter watts back per node.
+
+Sharding: the node axis spreads across the mesh's ``node`` axis (each device
+attributes its slice of the fleet — pure data parallelism, zero collectives
+in the forward program since every reduction is within one node's row).
+Model params are replicated (tiny) or tensor-sharded over ``model``
+(see ``kepler_tpu.parallel.trainer``). XLA GSPMD propagates shardings from
+the input annotations; there are no hand-placed collectives here.
+
+Mixed fleets (config 5): both paths evaluate for every node (the model is a
+pair of matmuls — cheaper than a branch on TPU, and `lax.cond` over a
+batched axis would serialize anyway); `jnp.where` on the per-node mode code
+selects the result. RAPL nodes get ratio watts, non-RAPL nodes get model
+watts scaled onto their (unknown) zone axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kepler_tpu.models.estimator import predictor
+from kepler_tpu.models.features import build_features
+from kepler_tpu.ops.attribution import AttributionResult, attribute_fleet
+from kepler_tpu.parallel.fleet import MODE_MODEL, FleetBatch
+from kepler_tpu.parallel.mesh import NODE_AXIS
+
+
+class FleetResult(NamedTuple):
+    node_energy_uj: jax.Array  # [N, Z]
+    node_active_uj: jax.Array  # [N, Z]
+    node_idle_uj: jax.Array  # [N, Z]
+    node_power_uw: jax.Array  # [N, Z]
+    node_active_power_uw: jax.Array  # [N, Z]
+    node_idle_power_uw: jax.Array  # [N, Z]
+    workload_energy_uj: jax.Array  # [N, W, Z]
+    workload_power_uw: jax.Array  # [N, W, Z]
+
+
+def fleet_attribution_program(
+    model_params: Any,
+    zone_deltas_uj: jax.Array,  # f32 [N, Z]
+    zone_valid: jax.Array,  # bool [N, Z]
+    usage_ratio: jax.Array,  # f32 [N]
+    cpu_deltas: jax.Array,  # f32 [N, W]
+    workload_valid: jax.Array,  # bool [N, W]
+    node_cpu_delta: jax.Array,  # f32 [N]
+    dt_s: jax.Array,  # f32 [N]
+    mode: jax.Array,  # int32 [N] MODE_RATIO / MODE_MODEL
+    *,
+    predict_fn,
+) -> FleetResult:
+    """The pure program; wrap with jit+shardings via ``make_fleet_program``."""
+    ratio = attribute_fleet(
+        zone_deltas_uj, zone_valid, usage_ratio, cpu_deltas,
+        workload_valid, node_cpu_delta, dt_s,
+    )
+    if predict_fn is not None:
+        feats = build_features(cpu_deltas, workload_valid, node_cpu_delta,
+                               usage_ratio, dt_s)
+        model_watts = predict_fn(model_params, feats, workload_valid)
+        model_power_uw = model_watts * 1e6  # watts → µW
+        model_energy_uj = model_power_uw * dt_s[:, None, None]  # µW·s = µJ
+        is_model = (mode == MODE_MODEL)[:, None, None]
+        wl_power = jnp.where(is_model, model_power_uw,
+                             ratio.workloads.power_uw)
+        wl_energy = jnp.where(is_model, model_energy_uj,
+                              ratio.workloads.energy_uj)
+        # model-mode nodes have no RAPL; their node totals are the sum of
+        # model-estimated workload power (active == total, idle unknown → 0)
+        est_node_power = jnp.sum(model_power_uw, axis=1)  # [N, Z]
+        est_node_energy = jnp.sum(model_energy_uj, axis=1)
+        is_model_nz = (mode == MODE_MODEL)[:, None]
+        node_power = jnp.where(is_model_nz, est_node_power,
+                               ratio.node.power_uw)
+        node_energy = jnp.where(is_model_nz, est_node_energy,
+                                ratio.node.energy_uj)
+        node_active = jnp.where(is_model_nz, est_node_energy,
+                                ratio.node.active_uj)
+        node_idle = jnp.where(is_model_nz, 0.0, ratio.node.idle_uj)
+        node_active_p = jnp.where(is_model_nz, est_node_power,
+                                  ratio.node.active_power_uw)
+        node_idle_p = jnp.where(is_model_nz, 0.0, ratio.node.idle_power_uw)
+    else:
+        wl_power = ratio.workloads.power_uw
+        wl_energy = ratio.workloads.energy_uj
+        node_power = ratio.node.power_uw
+        node_energy = ratio.node.energy_uj
+        node_active = ratio.node.active_uj
+        node_idle = ratio.node.idle_uj
+        node_active_p = ratio.node.active_power_uw
+        node_idle_p = ratio.node.idle_power_uw
+    return FleetResult(
+        node_energy_uj=node_energy,
+        node_active_uj=node_active,
+        node_idle_uj=node_idle,
+        node_power_uw=node_power,
+        node_active_power_uw=node_active_p,
+        node_idle_power_uw=node_idle_p,
+        workload_energy_uj=wl_energy,
+        workload_power_uw=wl_power,
+    )
+
+
+def make_fleet_program(mesh: Mesh, model_mode: str | None = None):
+    """jit the fleet program with node-axis shardings over ``mesh``.
+
+    ``model_mode``: None = ratio only; "linear"/"mlp" compiles that
+    predictor into the program for mixed fleets.
+    """
+    predict_fn = predictor(model_mode) if model_mode else None
+    by_node_2d = NamedSharding(mesh, P(NODE_AXIS, None))
+    by_node_1d = NamedSharding(mesh, P(NODE_AXIS))
+    replicated = NamedSharding(mesh, P())
+
+    fn = functools.partial(fleet_attribution_program, predict_fn=predict_fn)
+    return jax.jit(
+        fn,
+        in_shardings=(
+            replicated,  # model params (tiny; tensor-sharded in trainer)
+            by_node_2d,  # zone_deltas
+            by_node_2d,  # zone_valid
+            by_node_1d,  # usage_ratio
+            by_node_2d,  # cpu_deltas
+            by_node_2d,  # workload_valid
+            by_node_1d,  # node_cpu_delta
+            by_node_1d,  # dt
+            by_node_1d,  # mode
+        ),
+        out_shardings=NamedSharding(mesh, P(NODE_AXIS)),
+    )
+
+
+def run_fleet_attribution(
+    program,
+    batch: FleetBatch,
+    model_params: Any = None,
+) -> FleetResult:
+    """Host entry: device_put the padded batch and run one sharded step."""
+    return program(
+        model_params if model_params is not None else jnp.zeros(()),
+        jnp.asarray(batch.zone_deltas_uj),
+        jnp.asarray(batch.zone_valid),
+        jnp.asarray(batch.usage_ratio),
+        jnp.asarray(batch.cpu_deltas),
+        jnp.asarray(batch.workload_valid),
+        jnp.asarray(batch.node_cpu_delta),
+        jnp.asarray(batch.dt_s),
+        jnp.asarray(batch.mode),
+    )

@@ -1,0 +1,60 @@
+"""Device-mesh construction.
+
+The scaling axes of this framework (per SURVEY §2 checklist: the reference
+has no DP/TP/PP — its "fleet" is a K8s DaemonSet; the TPU build's analog
+axes are):
+
+- ``node``  — data parallelism over the fleet's node axis: each device
+  attributes a slice of the cluster's nodes (the moral equivalent of DP).
+- ``model`` — tensor parallelism over the MLP estimator's hidden dim
+  (column-/row-parallel weights, one psum on the output projection).
+
+A 1-D mesh uses all devices on ``node``; a 2-D mesh splits them
+``node × model``. Collectives ride ICI inside one pjit program — there is
+no hand-written NCCL/MPI analog anywhere (XLA inserts them from sharding
+annotations).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+NODE_AXIS = "node"
+MODEL_AXIS = "model"
+
+
+def make_mesh(
+    mesh_shape: Sequence[int] = (),
+    axes: Sequence[str] = (NODE_AXIS,),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh; empty shape = all devices on the first axis.
+
+    ``mesh_shape`` may contain one ``-1`` (inferred). Axis count must match
+    shape length (after defaulting).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if not mesh_shape:
+        mesh_shape = [n] + [1] * (len(axes) - 1)
+    shape = list(mesh_shape)
+    if shape.count(-1) > 1:
+        raise ValueError("at most one -1 in mesh_shape")
+    if -1 in shape:
+        known = math.prod(s for s in shape if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        shape[shape.index(-1)] = n // known
+    if math.prod(shape) != n:
+        raise ValueError(
+            f"mesh shape {shape} needs {math.prod(shape)} devices, "
+            f"have {n}")
+    if len(shape) != len(axes):
+        raise ValueError(f"shape {shape} vs axes {axes} rank mismatch")
+    import numpy as np
+
+    return Mesh(np.asarray(devs).reshape(shape), tuple(axes))
