@@ -24,7 +24,7 @@ from kepler_tpu.exporter.prometheus import (
 )
 from kepler_tpu.exporter.stdout import StdoutExporter
 from kepler_tpu.monitor.monitor import PowerMonitor
-from kepler_tpu.resource.informer import ResourceInformer
+from kepler_tpu.resource import ResourceInformer, make_proc_reader
 from kepler_tpu.server.debug import DebugService
 from kepler_tpu.server.http import APIServer
 from kepler_tpu.service.lifecycle import (
@@ -56,7 +56,8 @@ def create_services(cfg: Config) -> list:
         pod_lookup = PodInformer(
             node_name=cfg.kube.node_name, kubeconfig=cfg.kube.config)
 
-    resources = ResourceInformer(procfs_path=cfg.host.procfs,
+    resources = ResourceInformer(reader=make_proc_reader(cfg.host.procfs),
+                                 procfs_path=cfg.host.procfs,
                                  pod_lookup=pod_lookup)
     monitor = PowerMonitor(
         meter,

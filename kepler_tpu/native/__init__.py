@@ -1,0 +1,174 @@
+"""Native (C++) fast path for host-side IO.
+
+The TPU attribution math is one fused device program; what remains
+host-bound is the per-tick procfs scan and sysfs counter reads (SURVEY §7
+hard part (d)). ``src/scan.cpp`` batches those into single C calls; this
+module builds it on demand with ``g++`` (no pybind11 in the toolchain —
+plain C ABI via ctypes) and exposes a typed wrapper.
+
+Everything degrades gracefully: if no compiler or the build fails, callers
+get ``None`` from :func:`load` and fall back to the pure-Python readers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("kepler.native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "scan.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+_LIB = os.path.join(_BUILD_DIR, "libkepler_scan.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def ensure_built(force: bool = False) -> str | None:
+    """Compile the shared library if missing/stale. Returns its path or None.
+
+    Rebuilds when the source is newer than the .so (dev loop) — the compile
+    is ~1 s and happens at most once per process.
+    """
+    with _lock:
+        have_lib = os.path.exists(_LIB)
+        if not os.path.exists(_SRC):
+            # source-less install (e.g. prebuilt image): use the .so as-is
+            return _LIB if have_lib else None
+        if (not force and have_lib
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        # compile to a pid-suffixed temp and rename: concurrent processes
+        # (the in-process lock can't see them) each build privately and the
+        # atomic rename means readers never dlopen a half-written .so
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-Wall", "-Wextra", _SRC, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB)
+        except (OSError, subprocess.SubprocessError) as err:
+            detail = getattr(err, "stderr", b"") or b""
+            log.warning("native build failed (%s): %s — using pure-Python "
+                        "readers", err, detail.decode("utf-8", "replace")[:500])
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return _LIB
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None on any failure."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("KEPLER_NO_NATIVE"):
+        return None
+    path = ensure_built()
+    if path is None:
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.kepler_native_abi_version.restype = ctypes.c_int
+        if lib.kepler_native_abi_version() != _ABI_VERSION:
+            raise OSError(
+                f"ABI mismatch: {lib.kepler_native_abi_version()} "
+                f"!= {_ABI_VERSION}")
+        lib.kepler_scan_procs.restype = ctypes.c_int
+        lib.kepler_scan_procs.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int32,
+        ]
+        lib.kepler_read_stat_totals.restype = ctypes.c_int
+        lib.kepler_read_stat_totals.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.kepler_read_counter_files.restype = ctypes.c_int
+        lib.kepler_read_counter_files.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+    except (OSError, AttributeError) as err:
+        # AttributeError: a stale/foreign .so missing expected symbols
+        log.warning("native load failed: %s — using pure-Python readers", err)
+        _load_failed = True
+        return None
+    _lib = lib
+    return lib
+
+
+class NativeScanner:
+    """Typed wrapper over the C calls. One instance is thread-safe."""
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+
+    def scan_procs(self, procfs: str = "/proc",
+                   cap: int = 8192) -> tuple[np.ndarray, np.ndarray]:
+        """→ (pids int32 [n], cpu_seconds f64 [n]) for all live PIDs."""
+        procfs_b = procfs.encode()
+        while True:
+            pids = np.empty(cap, np.int32)
+            cpu = np.empty(cap, np.float64)
+            n = self._lib.kepler_scan_procs(
+                procfs_b,
+                pids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                cpu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                cap,
+            )
+            if n == -2:  # more PIDs than cap — grow and rescan
+                cap *= 4
+                continue
+            if n < 0:
+                raise OSError(f"cannot scan {procfs}")
+            return pids[:n].copy(), cpu[:n].copy()
+
+    def stat_totals(self, procfs: str = "/proc") -> tuple[float, float]:
+        """→ (active, total) jiffies from the aggregate 'cpu' line."""
+        active = ctypes.c_double()
+        total = ctypes.c_double()
+        rc = self._lib.kepler_read_stat_totals(
+            procfs.encode(), ctypes.byref(active), ctypes.byref(total))
+        if rc != 0:
+            raise OSError(f"cannot read {procfs}/stat")
+        return active.value, total.value
+
+    def read_counters(self, paths: list[str]) -> np.ndarray:
+        """Batch-read uint64 counter files; failures → UINT64_MAX."""
+        out = np.empty(len(paths), np.uint64)
+        if not paths:
+            return out
+        blob = b"\0".join(p.encode() for p in paths) + b"\0"
+        self._lib.kepler_read_counter_files(
+            blob, len(paths),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)))
+        return out
+
+
+def scanner() -> NativeScanner | None:
+    """The process-wide scanner, or None when native is unavailable."""
+    lib = load()
+    return NativeScanner(lib) if lib is not None else None

@@ -13,6 +13,10 @@ from kepler_tpu.resource.informer import (
     ResourceInformer,
     VirtualMachines,
 )
+from kepler_tpu.resource.fast_procfs import (
+    FastProcFSReader,
+    make_proc_reader,
+)
 from kepler_tpu.resource.procfs import ProcFSReader, ProcInfo, ProcReader
 from kepler_tpu.resource.types import (
     Container,
@@ -29,6 +33,7 @@ __all__ = [
     "Container",
     "ContainerRuntime",
     "Containers",
+    "FastProcFSReader",
     "FeatureBatch",
     "Hypervisor",
     "Node",
@@ -44,5 +49,6 @@ __all__ = [
     "VirtualMachines",
     "container_info_from_cgroup_paths",
     "container_info_from_proc",
+    "make_proc_reader",
     "vm_info_from_proc",
 ]

@@ -1,0 +1,73 @@
+"""Native-accelerated /proc reader.
+
+Same semantics as :class:`kepler_tpu.resource.procfs.ProcFSReader`
+(reference ``internal/resource/procfs_reader.go``), but the per-tick hot
+path — one stat read per PID plus the /proc/stat totals — is a single C
+call into ``kepler_tpu.native`` instead of thousands of Python
+open/read/parse round-trips. Everything cold (comm/exe/cgroup/environ/
+cmdline, read once per PID at classification time) stays the Python
+implementation.
+
+``make_proc_reader`` picks the fast path when the native library is
+available and falls back silently otherwise, so callers never care.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kepler_tpu import native
+from kepler_tpu.resource.procfs import ProcFSInfo, ProcFSReader
+
+log = logging.getLogger("kepler.resource")
+
+
+class FastProcInfo(ProcFSInfo):
+    """ProcFSInfo whose cpu_time came from the batched native scan."""
+
+    def __init__(self, procfs: str, pid: int, cpu_time_s: float) -> None:
+        super().__init__(procfs, pid)
+        self._cpu_time_s = cpu_time_s
+
+    def cpu_time(self) -> float:
+        return self._cpu_time_s
+
+
+class FastProcFSReader(ProcFSReader):
+    def __init__(self, scanner: native.NativeScanner,
+                 procfs: str = "/proc") -> None:
+        super().__init__(procfs)
+        self._scanner = scanner
+
+    def all_procs(self) -> list[FastProcInfo]:
+        pids, cpu = self._scanner.scan_procs(self._procfs)
+        return [
+            FastProcInfo(self._procfs, int(p), float(c))
+            for p, c in zip(pids, cpu)
+        ]
+
+    def _read_stat_totals(self) -> tuple[float, float]:
+        return self._scanner.stat_totals(self._procfs)
+
+
+def make_proc_reader(procfs: str = "/proc",
+                     use_native: bool | None = None) -> ProcFSReader:
+    """Best available reader: native batched scan if buildable, else Python.
+
+    ``use_native``: True forces native (raises if unavailable), False forces
+    Python, None (default) auto-detects.
+    """
+    if use_native is False:
+        return ProcFSReader(procfs)
+    scanner = native.scanner()
+    if scanner is None:
+        if use_native:
+            import os
+            why = ("disabled via KEPLER_NO_NATIVE"
+                   if os.environ.get("KEPLER_NO_NATIVE")
+                   else "no g++ or build failed")
+            raise RuntimeError(
+                f"native scanner requested but unavailable ({why})")
+        return ProcFSReader(procfs)
+    log.debug("using native procfs scanner (%s)", native.lib_path())
+    return FastProcFSReader(scanner, procfs)
