@@ -1,0 +1,93 @@
+"""Cluster-aggregator entry point.
+
+The second role of the framework (SURVEY §7: "two roles, one codebase"):
+``python -m kepler_tpu.cmd.aggregator`` starts the fleet ingest + sharded
+TPU attribution service. Node agents point at it via
+``--aggregator.endpoint`` on the regular ``kepler_tpu.cmd.main`` binary.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Sequence
+
+from kepler_tpu import version
+from kepler_tpu.config import parse_args_and_config
+from kepler_tpu.fleet import Aggregator
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import (
+    CancelContext,
+    SignalHandler,
+    init_services,
+    run_services,
+)
+from kepler_tpu.utils.logger import new_logger
+
+log = logging.getLogger("kepler.aggregator")
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    try:
+        cfg = parse_args_and_config(argv, skip_validation=("host",))
+    except (ValueError, OSError) as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    new_logger(cfg.log.level, cfg.log.format)
+    info = version.info()
+    log.info("kepler-tpu aggregator %s (%s, %s)", info.version,
+             info.python_version, info.platform)
+
+    params = None
+    if cfg.aggregator.params_path:
+        from kepler_tpu.models.estimator import load_params
+        params = load_params(cfg.aggregator.params_path)
+        log.info("loaded %s params from %s", cfg.aggregator.model,
+                 cfg.aggregator.params_path)
+
+    server = APIServer(listen_addresses=[cfg.aggregator.listen_address])
+    aggregator = Aggregator(
+        server,
+        interval=cfg.aggregator.interval,
+        stale_after=cfg.aggregator.stale_after,
+        model_mode=cfg.aggregator.model or None,
+        model_params=params,
+        node_bucket=cfg.tpu.node_bucket,
+        workload_bucket=cfg.tpu.workload_bucket,
+    )
+    services: list = [server, aggregator]
+
+    if cfg.exporter.prometheus.enabled:
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import (
+            CONTENT_TYPE_LATEST,
+            generate_latest,
+        )
+        registry = CollectorRegistry()
+        registry.register(aggregator)
+
+        def metrics_handler(_request):
+            return (200, {"Content-Type": CONTENT_TYPE_LATEST},
+                    generate_latest(registry))
+
+        server.register("/metrics", "Metrics",
+                        "Fleet-level Prometheus metrics", metrics_handler)
+
+    services.append(SignalHandler())
+    try:
+        init_services(services)
+    except Exception as err:
+        log.error("initialization failed: %s", err)
+        return 1
+    ctx = CancelContext()
+    try:
+        run_services(ctx, services)
+    except Exception as err:
+        log.error("run failed: %s", err)
+        return 1
+    log.info("Graceful shutdown completed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,11 +88,19 @@ def create_services(cfg: Config) -> list:
         services.append(DebugService(server))
     if cfg.exporter.stdout.enabled:
         services.append(StdoutExporter(monitor))
-    if cfg.aggregator.enabled or cfg.aggregator.endpoint:
-        # wired by kepler_tpu.parallel (cluster aggregator role); loud until
-        # the service graph grows that arm
-        log.warning("aggregator config present but the aggregator service "
-                    "is started via kepler_tpu.cmd.aggregator")
+    if cfg.aggregator.endpoint:
+        from kepler_tpu.fleet import FleetAgent
+        from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO
+        services.append(FleetAgent(
+            monitor,
+            endpoint=cfg.aggregator.endpoint,
+            node_name=cfg.kube.node_name,
+            mode=(MODE_MODEL if cfg.aggregator.node_mode == "model"
+                  else MODE_RATIO),
+        ))
+    if cfg.aggregator.enabled:
+        log.warning("aggregator.enabled is set — the aggregator role runs "
+                    "as its own binary: python -m kepler_tpu.cmd.aggregator")
     return services
 
 

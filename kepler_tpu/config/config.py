@@ -189,6 +189,16 @@ class AggregatorConfig:
     listen_address: str = ":28283"
     # node-agent side: where to stream feature rows ("" = standalone mode)
     endpoint: str = ""
+    # aggregation cadence and how long a silent node stays in the batch
+    interval: float = 5.0
+    stale_after: float = 15.0
+    # learned estimator for non-RAPL nodes: "" = ratio-only, else
+    # "linear"/"mlp"; params_path = .npz from models.estimator.save_params
+    model: str = "mlp"
+    params_path: str = ""
+    # node-agent side: report as a model-estimated node (no trustworthy
+    # RAPL — e.g. a VM guest); the aggregator then uses the estimator
+    node_mode: str = "ratio"  # ratio | model
 
 
 @dataclass
@@ -256,13 +266,17 @@ _YAML_KEYS: dict[str, str] = {
     "nodeName": "node_name",
     "fake-cpu-meter": "fake_cpu_meter",
     "listenAddress": "listen_address",
+    "staleAfter": "stale_after",
+    "stale-after": "stale_after",
+    "paramsPath": "params_path",
+    "nodeMode": "node_mode",
     "workloadBucket": "workload_bucket",
     "nodeBucket": "node_bucket",
     "meshShape": "mesh_shape",
     "meshAxes": "mesh_axes",
 }
 
-_DURATION_FIELDS = {"interval", "staleness"}
+_DURATION_FIELDS = {"interval", "staleness", "stale_after"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -364,6 +378,12 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         action=argparse.BooleanOptionalAction)
     add("--aggregator.listen-address", dest="aggregator_listen", default=None)
     add("--aggregator.endpoint", dest="aggregator_endpoint", default=None)
+    add("--aggregator.model", dest="aggregator_model", default=None,
+        choices=["", "linear", "mlp"])
+    add("--aggregator.params-path", dest="aggregator_params_path",
+        default=None)
+    add("--aggregator.node-mode", dest="aggregator_node_mode", default=None,
+        choices=["ratio", "model"])
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
 
@@ -400,6 +420,9 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "enabled"), args.aggregator_enable)
     set_if(("aggregator", "listen_address"), args.aggregator_listen)
     set_if(("aggregator", "endpoint"), args.aggregator_endpoint)
+    set_if(("aggregator", "model"), args.aggregator_model)
+    set_if(("aggregator", "params_path"), args.aggregator_params_path)
+    set_if(("aggregator", "node_mode"), args.aggregator_node_mode)
     set_if(("tpu", "platform"), args.tpu_platform)
     return cfg
 

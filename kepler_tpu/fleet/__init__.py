@@ -1,0 +1,24 @@
+"""Fleet plane: node agents → cluster aggregator over DCN.
+
+The reference's only aggregation plane is Prometheus scrape (SURVEY §2
+checklist); this package adds the TPU-native one from BASELINE.json: agents
+stream per-window feature rows (``wire`` format) to an ``Aggregator`` that
+attributes the whole fleet as one sharded device program and scatters watts
+back per node.
+"""
+
+from kepler_tpu.fleet.agent import FleetAgent
+from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.wire import (
+    WireError,
+    decode_report,
+    encode_report,
+)
+
+__all__ = [
+    "Aggregator",
+    "FleetAgent",
+    "WireError",
+    "decode_report",
+    "encode_report",
+]

@@ -1,0 +1,126 @@
+"""Fleet agent: streams per-window feature rows to the cluster aggregator.
+
+The node-side half of the DCN plane (SURVEY §5 "distributed communication
+backend"): subscribes to the monitor's raw window samples, serializes them
+(``fleet.wire``), and POSTs to the aggregator's ``/v1/report``. The node's
+own Prometheus exporter is untouched — the aggregator is an *additional*
+consumer, exactly as Prometheus scrape is in the reference.
+
+Failure model mirrors the reference's degrade-gracefully stance: an
+unreachable aggregator never blocks or kills the node monitor. Samples
+queue in a small ring (newest wins) and drop with a rate-limited warning —
+the aggregator pads/masks missing nodes out of the batch anyway.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import logging
+import socket
+import threading
+import urllib.parse
+
+from kepler_tpu.fleet.wire import encode_report
+from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
+from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.fleet.agent")
+
+
+class FleetAgent:
+    def __init__(
+        self,
+        monitor: PowerMonitor,
+        endpoint: str,
+        node_name: str = "",
+        mode: int = MODE_RATIO,
+        timeout_s: float = 2.0,
+        queue_max: int = 8,
+    ) -> None:
+        self._monitor = monitor
+        self._endpoint = endpoint
+        self._node_name = node_name or socket.gethostname()
+        self._mode = mode
+        self._timeout = timeout_s
+        self._queue: collections.deque[WindowSample] = collections.deque(
+            maxlen=queue_max)
+        self._wake = threading.Event()
+        self._seq = 0
+        self._drop_logged = 0.0
+        u = urllib.parse.urlsplit(endpoint if "//" in endpoint
+                                  else f"http://{endpoint}")
+        if not u.hostname or not u.port:
+            raise ValueError(
+                f"aggregator endpoint needs host:port, got {endpoint!r}")
+        self._host, self._port = u.hostname, u.port
+        self._path = (u.path.rstrip("/") or "") + "/v1/report"
+
+    def name(self) -> str:
+        return "fleet-agent"
+
+    def init(self) -> None:
+        self._monitor.add_window_listener(self._on_window)
+        log.info("fleet agent: node=%s → http://%s:%d%s",
+                 self._node_name, self._host, self._port, self._path)
+
+    def _on_window(self, sample: WindowSample) -> None:
+        # runs inside the monitor's refresh lock: enqueue only
+        self._queue.append(sample)
+        self._wake.set()
+
+    def run(self, ctx: CancelContext) -> None:
+        while not ctx.cancelled():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            while self._queue:
+                sample = self._queue.popleft()
+                try:
+                    self._send(sample)
+                except (OSError, http.client.HTTPException) as err:
+                    self._log_drop(sample, err)
+            if ctx.wait(0.0):
+                return
+
+    def shutdown(self) -> None:
+        self._wake.set()
+
+    # -- internals ---------------------------------------------------------
+
+    def _send(self, sample: WindowSample) -> None:
+        batch = sample.batch
+        report = NodeReport(
+            node_name=self._node_name,
+            zone_deltas_uj=sample.zone_deltas_uj,
+            zone_valid=sample.zone_valid,
+            usage_ratio=sample.usage_ratio,
+            cpu_deltas=batch.cpu_deltas,
+            workload_ids=list(batch.ids),
+            node_cpu_delta=batch.node_cpu_delta,
+            dt_s=sample.dt_s,
+            mode=self._mode,
+            workload_kinds=batch.kinds,
+        )
+        self._seq += 1
+        body = encode_report(report, list(sample.zone_names), seq=self._seq)
+        conn = http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self._timeout)
+        try:
+            conn.request("POST", self._path, body=body,
+                         headers={"Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status >= 300:
+                raise http.client.HTTPException(
+                    f"aggregator returned {resp.status}")
+        finally:
+            conn.close()
+
+    def _log_drop(self, sample: WindowSample, err: Exception) -> None:
+        # rate-limit to one warning per 30 s of sample time so a down
+        # aggregator doesn't flood the node's logs every interval
+        if sample.timestamp - self._drop_logged >= 30.0:
+            self._drop_logged = sample.timestamp
+            log.warning("dropping fleet report (aggregator unreachable): %s",
+                        err)

@@ -1,0 +1,375 @@
+"""Cluster aggregator: ingest node reports, attribute the whole fleet on TPU.
+
+The aggregator half of the DCN plane (BASELINE.json north star, SURVEY §7
+step 9): node agents POST per-window feature rows; every ``interval`` the
+aggregator pads/masks the latest report from each node into one
+``[nodes × workloads × zones]`` batch, runs the sharded mixed-mode
+attribution program (``kepler_tpu.parallel.aggregator_core`` — ratio for
+RAPL nodes, learned estimator for the rest, one device computation), and
+publishes:
+
+- ``GET /v1/results[?node=…]`` — attributed watts scattered back per node
+  (JSON), the pull leg for non-RAPL nodes that want their estimates;
+- ``GET /metrics`` — cluster-level Prometheus families
+  (``kepler_fleet_…``), the same scrape plane the reference leans on.
+
+Late/missing nodes: a node whose latest report is older than
+``stale_after`` falls out of the batch (its row just isn't assembled) —
+the batched analog of the reference's per-zone skip-on-error.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time as _time
+from dataclasses import dataclass
+from typing import Mapping
+
+import numpy as np
+
+from kepler_tpu.fleet.wire import WireError, decode_report
+from kepler_tpu.parallel.aggregator_core import (
+    FleetResult,
+    make_fleet_program,
+    run_fleet_attribution,
+)
+from kepler_tpu.parallel.fleet import NodeReport, assemble_fleet_batch
+from kepler_tpu.parallel.mesh import make_mesh
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.fleet.aggregator")
+
+# upper bound for one report POST (64 MiB ≫ any real fleet window: 10k
+# workloads ≈ 50 KiB of arrays + ids) — enforced by the server before the
+# body is buffered
+MAX_REPORT_BYTES = 64 << 20
+
+
+@dataclass
+class _Stored:
+    report: NodeReport
+    zone_names: tuple[str, ...]
+    received: float
+    seq: int
+
+
+class Aggregator:
+    """Service: report store + periodic sharded attribution."""
+
+    def __init__(
+        self,
+        server: APIServer,
+        interval: float = 5.0,
+        stale_after: float = 15.0,
+        model_mode: str | None = "mlp",
+        model_params: Mapping[str, np.ndarray] | None = None,
+        node_bucket: int = 8,
+        workload_bucket: int = 256,
+        clock=None,
+        mesh=None,
+    ) -> None:
+        self._server = server
+        self._interval = interval
+        self._stale_after = stale_after
+        self._model_mode = model_mode
+        self._params = model_params
+        self._node_bucket = node_bucket
+        self._workload_bucket = workload_bucket
+        self._clock = clock or _time.time
+        self._mesh = mesh
+
+        self._lock = threading.Lock()
+        self._reports: dict[str, _Stored] = {}
+        self._results_lock = threading.Lock()
+        self._results: dict[str, dict] = {}
+        self._stats = {"reports_total": 0, "rejected_total": 0,
+                       "attributions_total": 0, "last_batch_nodes": 0,
+                       "last_batch_workloads": 0,
+                       "last_attribution_ms": 0.0}
+        # cumulative per-node energy (f64, zone-keyed) for _total counters;
+        # survives a node briefly falling out of the batch, pruned after
+        # _cum_retention of total silence
+        self._cumulative: dict[str, dict[str, float]] = {}
+        self._cum_last_seen: dict[str, float] = {}
+        self._cum_retention = max(stale_after * 20.0, 600.0)
+        self._program = None  # jitted once; jax caches per input shape
+        # untrained fallbacks per zone count — never clobber trained params
+        self._fallback_params: dict[int, object] = {}
+
+    def name(self) -> str:
+        return "fleet-aggregator"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(self) -> None:
+        if self._mesh is None:
+            self._mesh = make_mesh()
+        n_dev = self._mesh.devices.size
+        # the node axis shards over the mesh: round the bucket up so padded
+        # batches always divide evenly across devices
+        if self._node_bucket % n_dev:
+            self._node_bucket = ((self._node_bucket // n_dev) + 1) * n_dev
+        if self._model_mode:
+            self._check_params_shape()
+            if self._params is None:
+                log.warning("no trained %s params given; estimates will use "
+                            "untrained initialization", self._model_mode)
+        self._server.register("/v1/report", "Fleet ingest",
+                              "POST node window reports", self._handle_report,
+                              max_body=MAX_REPORT_BYTES)
+        self._server.register("/v1/results", "Fleet results",
+                              "attributed watts per node", self._handle_results)
+        log.info("aggregator: mesh=%s devices=%d model=%s interval=%.1fs",
+                 dict(self._mesh.shape), n_dev, self._model_mode,
+                 self._interval)
+
+    def run(self, ctx: CancelContext) -> None:
+        while not ctx.cancelled():
+            if ctx.wait(self._interval):
+                return
+            try:
+                self.aggregate_once()
+            except Exception:
+                log.exception("fleet aggregation failed")
+
+    def shutdown(self) -> None:
+        pass
+
+    # -- ingest ------------------------------------------------------------
+
+    def _handle_report(self, request) -> tuple[int, dict[str, str], bytes]:
+        if request.command != "POST":
+            return 405, {"Content-Type": "text/plain"}, b"POST only\n"
+        try:
+            report, header = decode_report(request.body)
+        except (WireError, ValueError) as err:
+            with self._lock:
+                self._stats["rejected_total"] += 1
+            return 400, {"Content-Type": "text/plain"}, f"{err}\n".encode()
+        stored = _Stored(report=report,
+                         zone_names=tuple(header["zone_names"]),
+                         received=self._clock(),
+                         seq=int(header.get("seq", 0)))
+        with self._lock:
+            prev = self._reports.get(report.node_name)
+            # tolerate agent restarts (seq resets); reject only stale
+            # reordering within one agent run
+            if prev is None or stored.seq >= prev.seq or stored.seq == 1:
+                self._reports[report.node_name] = stored
+            self._stats["reports_total"] += 1
+        return 204, {}, b""
+
+    # -- aggregation -------------------------------------------------------
+
+    def aggregate_once(self) -> FleetResult | None:
+        """One fleet batch: align zones, pad, run the sharded program."""
+        now = self._clock()
+        with self._lock:
+            live = {name: s for name, s in self._reports.items()
+                    if now - s.received <= self._stale_after}
+            self._reports = dict(live)
+        if not live:
+            return None
+        # canonical zone axis = sorted union of reported zone names; nodes
+        # missing a zone keep their row with that zone masked invalid
+        zone_names = sorted({z for s in live.values() for z in s.zone_names})
+        z_index = {z: i for i, z in enumerate(zone_names)}
+        n_zones = len(zone_names)
+        aligned: list[NodeReport] = []
+        for s in sorted(live.values(), key=lambda s: s.report.node_name):
+            r = s.report
+            deltas = np.zeros(n_zones, np.float32)
+            valid = np.zeros(n_zones, bool)
+            for j, zn in enumerate(s.zone_names):
+                i = z_index[zn]
+                deltas[i] = r.zone_deltas_uj[j]
+                valid[i] = bool(r.zone_valid[j])
+            aligned.append(NodeReport(
+                node_name=r.node_name, zone_deltas_uj=deltas,
+                zone_valid=valid, usage_ratio=r.usage_ratio,
+                cpu_deltas=r.cpu_deltas, workload_ids=r.workload_ids,
+                node_cpu_delta=r.node_cpu_delta, dt_s=r.dt_s, mode=r.mode,
+                workload_kinds=r.workload_kinds, meta=r.meta))
+
+        batch = assemble_fleet_batch(
+            aligned, n_zones=n_zones, node_bucket=self._node_bucket,
+            workload_bucket=self._workload_bucket)
+        if self._program is None:
+            self._program = make_fleet_program(self._mesh,
+                                               model_mode=self._model_mode)
+        program = self._program
+        params = self._params_for_zones(n_zones)
+        t0 = _time.perf_counter()
+        result = run_fleet_attribution(program, batch, params)
+        node_power = np.asarray(result.node_power_uw)
+        node_energy = np.asarray(result.node_energy_uj)
+        wl_power = np.asarray(result.workload_power_uw)
+        wl_energy = np.asarray(result.workload_energy_uj)
+        elapsed_ms = (_time.perf_counter() - t0) * 1e3
+
+        results: dict[str, dict] = {}
+        for i in range(batch.n_nodes):
+            name = batch.node_names[i]
+            w = batch.workload_counts[i]
+            prev = self._cumulative.get(name, {})
+            cum = {zn: prev.get(zn, 0.0) + float(node_energy[i, j])
+                   for j, zn in enumerate(zone_names)}
+            self._cumulative[name] = cum
+            self._cum_last_seen[name] = now
+            results[name] = {
+                "timestamp": now,
+                "zones": zone_names,
+                "mode": int(batch.mode[i]),
+                "node_power_uw": node_power[i].tolist(),
+                "node_energy_uj": node_energy[i].tolist(),
+                "node_joules_total": [cum[zn] / 1e6 for zn in zone_names],
+                "workloads": [
+                    {
+                        "id": batch.workload_ids[i][k],
+                        "kind": (int(aligned[i].workload_kinds[k])
+                                 if aligned[i].workload_kinds is not None
+                                 else -1),
+                        "power_uw": wl_power[i, k].tolist(),
+                        "energy_uj": wl_energy[i, k].tolist(),
+                    }
+                    for k in range(w)
+                ],
+            }
+        # prune cumulative totals only after prolonged total silence
+        for name, seen in list(self._cum_last_seen.items()):
+            if now - seen > self._cum_retention:
+                del self._cum_last_seen[name]
+                self._cumulative.pop(name, None)
+        with self._results_lock:
+            self._results = results
+            self._stats["attributions_total"] += 1
+            self._stats["last_batch_nodes"] = batch.n_nodes
+            self._stats["last_batch_workloads"] = int(
+                batch.workload_valid.sum())
+            self._stats["last_attribution_ms"] = elapsed_ms
+        log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms",
+                  batch.n_nodes, self._stats["last_batch_workloads"],
+                  elapsed_ms)
+        return result
+
+    def _params_for_zones(self, n_zones: int):
+        """Trained params when their output dim matches the canonical zone
+        axis this window; otherwise a cached untrained fallback — the
+        trained params are kept, so a transient zone-set change (one node
+        reporting an extra zone) doesn't destroy them."""
+        if not self._model_mode:
+            return None
+        if self._params is not None and self._model_out_dim() == n_zones:
+            return self._params
+        fallback = self._fallback_params.get(n_zones)
+        if fallback is None:
+            import jax
+
+            from kepler_tpu.models.estimator import initializer
+            log.warning("model output dim %s != fleet zones %d; using "
+                        "untrained %s fallback for this window",
+                        self._model_out_dim(), n_zones, self._model_mode)
+            fallback = initializer(self._model_mode)(
+                jax.random.PRNGKey(0), n_zones=n_zones)
+            self._fallback_params[n_zones] = fallback
+        return fallback
+
+    def _check_params_shape(self) -> None:
+        """Fail at startup (not first window) on params/model mismatch."""
+        if self._params is None:
+            return
+        required = {"mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
+                    "linear": ("weight", "bias")}[self._model_mode]
+        missing = [k for k in required if k not in self._params]
+        if missing:
+            raise ValueError(
+                f"params are missing {missing} for model "
+                f"{self._model_mode!r} — were they saved from a different "
+                "model kind?")
+
+    def _model_out_dim(self) -> int | None:
+        if self._params is None:
+            return None
+        # output bias: "b2" (mlp) / "bias" (linear) — its length is Z
+        for key in ("b2", "bias"):
+            if key in self._params:
+                return int(np.asarray(self._params[key]).shape[-1])
+        return None
+
+    # -- read endpoints ----------------------------------------------------
+
+    def _handle_results(self, request) -> tuple[int, dict[str, str], bytes]:
+        query = ""
+        if "?" in request.path:
+            query = request.path.split("?", 1)[1]
+        node = None
+        for part in query.split("&"):
+            if part.startswith("node="):
+                node = part[len("node="):]
+        with self._results_lock:
+            if node is not None:
+                payload = self._results.get(node)
+                if payload is None:
+                    return (404, {"Content-Type": "text/plain"},
+                            f"no results for node {node!r}\n".encode())
+            else:
+                payload = {"nodes": self._results, "stats": dict(self._stats)}
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(payload).encode())
+
+    # -- prometheus (cluster-level families) -------------------------------
+
+    def collect(self):
+        """prometheus_client custom-collector hook (kepler_fleet_*)."""
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        with self._results_lock:
+            results = self._results
+            stats = dict(self._stats)
+        nodes = GaugeMetricFamily(
+            "kepler_fleet_nodes", "Nodes in the last fleet batch")
+        nodes.add_metric([], stats["last_batch_nodes"])
+        yield nodes
+        workloads = GaugeMetricFamily(
+            "kepler_fleet_workloads", "Workloads in the last fleet batch")
+        workloads.add_metric([], stats["last_batch_workloads"])
+        yield workloads
+        lat = GaugeMetricFamily(
+            "kepler_fleet_attribution_latency_ms",
+            "Device latency of the last fleet attribution")
+        lat.add_metric([], stats["last_attribution_ms"])
+        yield lat
+        total = CounterMetricFamily(
+            "kepler_fleet_attributions", "Completed fleet attributions")
+        total.add_metric([], stats["attributions_total"])
+        yield total
+        reports = CounterMetricFamily(
+            "kepler_fleet_reports", "Node reports received")
+        reports.add_metric([], stats["reports_total"])
+        yield reports
+        rejected = CounterMetricFamily(
+            "kepler_fleet_reports_rejected", "Malformed reports rejected")
+        rejected.add_metric([], stats["rejected_total"])
+        yield rejected
+        node_watts = GaugeMetricFamily(
+            "kepler_fleet_node_cpu_watts",
+            "Per-node power attributed by the fleet aggregator",
+            labels=["node_name", "zone", "mode"])
+        node_joules = CounterMetricFamily(
+            "kepler_fleet_node_cpu_joules",
+            "Per-node cumulative energy seen by the fleet aggregator",
+            labels=["node_name", "zone", "mode"])
+        for name, res in results.items():
+            mode = "model" if res["mode"] else "ratio"
+            for j, zone in enumerate(res["zones"]):
+                node_watts.add_metric([name, zone, mode],
+                                      res["node_power_uw"][j] / 1e6)
+                node_joules.add_metric([name, zone, mode],
+                                       res["node_joules_total"][j])
+        yield node_watts
+        yield node_joules

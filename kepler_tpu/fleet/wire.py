@@ -1,0 +1,140 @@
+"""Fleet wire format: NodeReport ⇄ bytes.
+
+The reference has no inter-node plane (SURVEY §2 checklist — Prometheus
+scrape is its only aggregation path); this framework adds a DCN leg: node
+agents stream per-window feature rows to the cluster aggregator, which
+batches them into the `[nodes × pods × features]` tensor (BASELINE.json
+north star).
+
+Format (version 1): a fixed magic, a length-prefixed JSON header (names,
+scalars, array manifest), then the raw little-endian array bytes in
+manifest order. No pickle anywhere — payloads arrive over the network and
+are treated as untrusted: dtypes come from a whitelist, every length is
+bounds-checked before allocation.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from kepler_tpu.parallel.fleet import NodeReport
+
+MAGIC = b"KTPUFL1\n"
+_HEADER_LEN = struct.Struct("<I")
+MAX_HEADER_BYTES = 16 << 20
+MAX_ARRAY_BYTES = 256 << 20
+
+_DTYPES = {"float32": np.float32, "float64": np.float64,
+           "int8": np.int8, "int32": np.int32, "bool": np.bool_}
+
+
+def encode_report(report: NodeReport, zone_names: list[str],
+                  seq: int = 0) -> bytes:
+    """Serialize one node's window for the POST /v1/report body."""
+    arrays: list[tuple[str, np.ndarray]] = [
+        ("zone_deltas_uj", np.ascontiguousarray(
+            report.zone_deltas_uj, np.float32)),
+        ("zone_valid", np.ascontiguousarray(report.zone_valid, np.bool_)),
+        ("cpu_deltas", np.ascontiguousarray(report.cpu_deltas, np.float32)),
+    ]
+    if report.workload_kinds is not None:
+        arrays.append(("workload_kinds", np.ascontiguousarray(
+            report.workload_kinds, np.int8)))
+    header = {
+        "v": 1,
+        "seq": seq,
+        "node_name": report.node_name,
+        "zone_names": list(zone_names),
+        "usage_ratio": float(report.usage_ratio),
+        "node_cpu_delta": float(report.node_cpu_delta),
+        "dt_s": float(report.dt_s),
+        "mode": int(report.mode),
+        "workload_ids": list(report.workload_ids),
+        "meta": dict(report.meta),
+        "arrays": [
+            {"name": n, "dtype": str(a.dtype), "shape": list(a.shape)}
+            for n, a in arrays
+        ],
+    }
+    header_bytes = json.dumps(header, separators=(",", ":")).encode()
+    parts = [MAGIC, _HEADER_LEN.pack(len(header_bytes)), header_bytes]
+    parts += [a.tobytes() for _, a in arrays]
+    return b"".join(parts)
+
+
+class WireError(ValueError):
+    pass
+
+
+def decode_report(data: bytes) -> tuple[NodeReport, dict]:
+    """Parse a report payload → (NodeReport, header). Raises WireError on
+    any malformed/oversized input."""
+    if len(data) < len(MAGIC) + _HEADER_LEN.size:
+        raise WireError("short payload")
+    if data[: len(MAGIC)] != MAGIC:
+        raise WireError("bad magic")
+    off = len(MAGIC)
+    (hlen,) = _HEADER_LEN.unpack_from(data, off)
+    off += _HEADER_LEN.size
+    if hlen > MAX_HEADER_BYTES or off + hlen > len(data):
+        raise WireError("bad header length")
+    try:
+        header = json.loads(data[off: off + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as err:
+        raise WireError(f"bad header json: {err}") from err
+    off += hlen
+    if not isinstance(header, dict) or header.get("v") != 1:
+        raise WireError(f"unsupported version {header.get('v')!r}")
+
+    arrays: dict[str, np.ndarray] = {}
+    for spec in header.get("arrays", []):
+        name, dtype_s = spec.get("name"), spec.get("dtype")
+        shape = spec.get("shape")
+        if dtype_s not in _DTYPES:
+            raise WireError(f"dtype {dtype_s!r} not allowed")
+        if (not isinstance(shape, list) or len(shape) != 1
+                or not isinstance(shape[0], int) or shape[0] < 0):
+            raise WireError(f"bad shape {shape!r} for {name!r}")
+        dtype = np.dtype(_DTYPES[dtype_s])
+        nbytes = shape[0] * dtype.itemsize
+        if nbytes > MAX_ARRAY_BYTES or off + nbytes > len(data):
+            raise WireError(f"array {name!r} overruns payload")
+        arrays[name] = np.frombuffer(
+            data, dtype=dtype, count=shape[0], offset=off).copy()
+        off += nbytes
+
+    zone_names = header.get("zone_names")
+    if (not isinstance(zone_names, list)
+            or not all(isinstance(z, str) for z in zone_names)):
+        raise WireError("zone_names must be a list of strings")
+    try:
+        n_zones = len(zone_names)
+        report = NodeReport(
+            node_name=str(header["node_name"]),
+            zone_deltas_uj=arrays["zone_deltas_uj"],
+            zone_valid=arrays["zone_valid"],
+            usage_ratio=float(header["usage_ratio"]),
+            cpu_deltas=arrays["cpu_deltas"],
+            workload_ids=[str(w) for w in header["workload_ids"]],
+            node_cpu_delta=float(header["node_cpu_delta"]),
+            dt_s=float(header["dt_s"]),
+            mode=int(header["mode"]),
+            workload_kinds=arrays.get("workload_kinds"),
+            meta={str(k): str(v)
+                  for k, v in dict(header.get("meta", {})).items()},
+        )
+    except (KeyError, TypeError) as err:
+        raise WireError(f"missing field: {err}") from err
+    if report.zone_deltas_uj.shape != (n_zones,):
+        raise WireError("zone_deltas/zone_names length mismatch")
+    if report.zone_valid.shape != (n_zones,):
+        raise WireError("zone_valid/zone_names length mismatch")
+    if len(report.workload_ids) != len(report.cpu_deltas):
+        raise WireError("workload_ids/cpu_deltas length mismatch")
+    if (report.workload_kinds is not None
+            and len(report.workload_kinds) != len(report.cpu_deltas)):
+        raise WireError("workload_kinds/cpu_deltas length mismatch")
+    return report, header

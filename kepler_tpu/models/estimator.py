@@ -87,3 +87,21 @@ class ModelEstimator:
         feats = build_features(cpu_deltas, workload_valid, node_cpu_delta,
                                usage_ratio, dt_s)
         return predictor(self.mode)(self.params, feats, workload_valid)
+
+
+def save_params(path: str, params: Any) -> None:
+    """Persist flat dict-of-arrays params (LinearParams/MLPParams) as .npz —
+    the train→serve handoff for the fleet aggregator. No pickle: arrays
+    only, loadable on any host."""
+    import numpy as np
+
+    np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+
+
+def load_params(path: str) -> dict:
+    """Load params saved by :func:`save_params` (allow_pickle stays off —
+    checkpoint files may come from untrusted storage)."""
+    import numpy as np
+
+    with np.load(path, allow_pickle=False) as data:
+        return {k: jnp.asarray(data[k]) for k in data.files}

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import threading
 import time as _time
+from dataclasses import dataclass
 from typing import Callable, Mapping, Sequence
 
 import jax.numpy as jnp
@@ -45,6 +46,22 @@ _KIND_CODES = (
     FeatureBatch.KIND_VM,
     FeatureBatch.KIND_POD,
 )
+
+
+@dataclass(frozen=True)
+class WindowSample:
+    """Raw per-refresh inputs, before attribution — the feature rows a fleet
+    agent streams to the cluster aggregator (SURVEY §5 "distributed
+    communication backend": per-node agents producing `[pods × features]`
+    rows; the aggregator batches them into `[nodes × pods × features]`)."""
+
+    timestamp: float
+    dt_s: float
+    zone_names: tuple[str, ...]
+    zone_deltas_uj: np.ndarray  # f64 [Z] this window
+    zone_valid: np.ndarray  # bool [Z]
+    usage_ratio: float
+    batch: FeatureBatch
 
 
 class PowerMonitor:
@@ -91,6 +108,7 @@ class PowerMonitor:
         self._node_idle = np.zeros(0)
 
         self._trackers: dict[str, TerminatedTracker] = {}
+        self._window_listeners: list[Callable[[WindowSample], None]] = []
         self._snapshot: Snapshot | None = None
         self._snapshot_lock = threading.Lock()  # singleflight for refresh
         self._exported = False
@@ -149,6 +167,13 @@ class PowerMonitor:
     def data_channel(self) -> threading.Event:
         """Set once the first snapshot exists (collector readiness gate)."""
         return self._data_event
+
+    def add_window_listener(
+            self, listener: Callable[[WindowSample], None]) -> None:
+        """Subscribe to raw per-window samples (fleet agent feed). Listeners
+        run inside the refresh lock — they must be fast and non-blocking
+        (the agent just enqueues)."""
+        self._window_listeners.append(listener)
 
     def snapshot(self) -> Snapshot:
         """Return a deep-cloned, fresh snapshot.
@@ -224,6 +249,18 @@ class PowerMonitor:
             **tables,
         )
         self._data_event.set()
+        if self._window_listeners:
+            sample = WindowSample(
+                timestamp=now, dt_s=max(dt, 0.0),
+                zone_names=self._zone_names,
+                zone_deltas_uj=zone_deltas, zone_valid=zone_valid,
+                usage_ratio=batch.usage_ratio, batch=batch,
+            )
+            for listener in self._window_listeners:
+                try:
+                    listener(sample)
+                except Exception:
+                    log.exception("window listener failed")
         log.debug("refresh done in %.2f ms", (_time.perf_counter() - start) * 1e3)
 
     def _read_zone_deltas(self) -> tuple[np.ndarray, np.ndarray]:

@@ -33,6 +33,10 @@ class Endpoint:
     name: str
     description: str
     handler: Handler
+    # largest POST body accepted; bigger requests get 413 without the body
+    # ever being buffered (and the connection closes, since the unread
+    # bytes would desync keep-alive)
+    max_body: int = 1 << 20
 
 
 class APIServer:
@@ -53,9 +57,10 @@ class APIServer:
         return "api-server"
 
     def register(self, path: str, name: str, description: str,
-                 handler: Handler) -> None:
+                 handler: Handler, max_body: int = 1 << 20) -> None:
         """Add an endpoint to the catalog (reference Register :167)."""
-        self._endpoints[path] = Endpoint(path, name, description, handler)
+        self._endpoints[path] = Endpoint(path, name, description, handler,
+                                         max_body)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -68,9 +73,27 @@ class APIServer:
             def log_message(self, fmt, *args):  # route into our logger
                 log.debug("http: " + fmt, *args)
 
-            def do_GET(self):  # noqa: N802 (stdlib casing)
+            def _dispatch(self):
                 path = self.path.split("?", 1)[0]
                 endpoint = outer._match(path)
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    length = -1
+                cap = endpoint.max_body if endpoint else 0
+                if length < 0 or length > cap:
+                    # don't buffer or trust the remainder of the stream
+                    self.close_connection = True
+                    if endpoint is not None:
+                        self._respond(413, {"Content-Type": "text/plain"},
+                                      b"payload too large\n")
+                        return
+                elif length:
+                    # pre-read so keep-alive connections never desync on
+                    # handlers that ignore the body
+                    self.body = self.rfile.read(length)
+                else:
+                    self.body = b""
                 if endpoint is None:
                     self._respond(404, {"Content-Type": "text/plain"},
                                   b"not found\n")
@@ -84,13 +107,25 @@ class APIServer:
                     return
                 self._respond(status, headers, body)
 
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                self._dispatch()
+
+            def do_POST(self):  # noqa: N802
+                # handlers see request.command and the pre-read request.body
+                self._dispatch()
+
             def _respond(self, status, headers, body):
-                self.send_response(status)
-                for k, v in headers.items():
-                    self.send_header(k, v)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                try:
+                    self.send_response(status)
+                    for k, v in headers.items():
+                        self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    # client gave up (e.g. agent timeout) — not our error
+                    log.debug("client disconnected before response: %s",
+                              self.path)
 
         self._handler_cls = RequestHandler
         self.register("/", "Home", "Landing page", self._landing_page)

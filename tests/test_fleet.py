@@ -1,0 +1,392 @@
+"""Fleet-plane tests: wire format, agent→aggregator over real HTTP, the
+aggregator's zone alignment/staleness/metrics — the "synthetic fleet"
+fixture strategy from SURVEY §4 (no real nodes needed)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kepler_tpu.fleet import (
+    Aggregator,
+    FleetAgent,
+    WireError,
+    decode_report,
+    encode_report,
+)
+from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+
+def make_report(name="node-a", w=3, z=2, mode=MODE_RATIO, seed=0):
+    rng = np.random.default_rng(seed)
+    cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+    return NodeReport(
+        node_name=name,
+        zone_deltas_uj=rng.uniform(1e6, 1e8, z).astype(np.float32),
+        zone_valid=np.ones(z, bool),
+        usage_ratio=0.6,
+        cpu_deltas=cpu,
+        workload_ids=[f"{name}-w{i}" for i in range(w)],
+        node_cpu_delta=float(cpu.sum()),
+        dt_s=5.0,
+        mode=mode,
+        workload_kinds=np.ones(w, np.int8),
+        meta={"os": "linux"},
+    )
+
+
+class TestWire:
+    def test_roundtrip(self):
+        report = make_report()
+        blob = encode_report(report, ["package", "dram"], seq=7)
+        decoded, header = decode_report(blob)
+        assert header["seq"] == 7
+        assert header["zone_names"] == ["package", "dram"]
+        assert decoded.node_name == report.node_name
+        np.testing.assert_array_equal(decoded.zone_deltas_uj,
+                                      report.zone_deltas_uj)
+        np.testing.assert_array_equal(decoded.cpu_deltas, report.cpu_deltas)
+        np.testing.assert_array_equal(decoded.workload_kinds,
+                                      report.workload_kinds)
+        assert decoded.workload_ids == report.workload_ids
+        assert decoded.meta == {"os": "linux"}
+        assert decoded.mode == MODE_RATIO
+        assert decoded.dt_s == 5.0
+
+    def test_roundtrip_without_kinds(self):
+        report = make_report()
+        report.workload_kinds = None
+        decoded, _ = decode_report(encode_report(report, ["package", "dram"]))
+        assert decoded.workload_kinds is None
+
+    @pytest.mark.parametrize("mutate", [
+        lambda b: b[:4],  # truncated magic
+        lambda b: b"XXXX" + b[4:],  # bad magic
+        lambda b: b[: len(b) // 2],  # truncated arrays
+        lambda b: b.replace(b'"v":1', b'"v":9'),  # bad version
+        lambda b: b.replace(b"float32", b"object_", 1),  # evil dtype
+    ])
+    def test_rejects_malformed(self, mutate):
+        blob = encode_report(make_report(), ["package", "dram"])
+        with pytest.raises(WireError):
+            decode_report(mutate(blob))
+
+    def test_rejects_non_string_zone_names(self):
+        blob = encode_report(make_report(z=2), ["package", "dram"])
+        # same byte length so the header length prefix stays valid
+        bad = blob.replace(b'"zone_names":["package","dram"]',
+                           b'"zone_names":["package",123456]')
+        with pytest.raises(WireError):
+            decode_report(bad)
+
+    def test_rejects_length_mismatch(self):
+        report = make_report(w=3)
+        report.workload_ids = ["only-one"]
+        with pytest.raises(WireError):
+            decode_report(encode_report(report, ["package", "dram"]))
+
+
+@pytest.fixture()
+def server():
+    s = APIServer(listen_addresses=["127.0.0.1:0"])
+    s.init()
+    ctx = CancelContext()
+    import threading
+    t = threading.Thread(target=s.run, args=(ctx,), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    yield s
+    ctx.cancel()
+    s.shutdown()
+
+
+def post_report(server, report, zones=("package", "dram"), seq=1):
+    host, port = server.addresses[0]
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/report",
+        data=encode_report(report, list(zones), seq=seq), method="POST")
+    return urllib.request.urlopen(req, timeout=5)
+
+
+class TestAggregator:
+    def test_ingest_and_aggregate(self, server):
+        agg = Aggregator(server, model_mode="mlp", node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        resp = post_report(server, make_report("node-a", mode=MODE_RATIO))
+        assert resp.status == 204
+        post_report(server, make_report("node-b", mode=MODE_MODEL, seed=1))
+        result = agg.aggregate_once()
+        assert result is not None
+        host, port = server.addresses[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/v1/results", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert set(payload["nodes"]) == {"node-a", "node-b"}
+        a = payload["nodes"]["node-a"]
+        assert a["zones"] == ["dram", "package"]  # canonical sorted union
+        assert len(a["workloads"]) == 3
+        assert all(np.isfinite(w["power_uw"]).all() for w in a["workloads"])
+        # ratio node: conservation Σ workload power == node active power
+        node_b = payload["nodes"]["node-b"]
+        assert node_b["mode"] == MODE_MODEL
+        assert payload["stats"]["attributions_total"] == 1
+
+    def test_ratio_conservation_through_wire(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        report = make_report("node-a", w=4)
+        post_report(server, report)
+        agg.aggregate_once()
+        host, port = server.addresses[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/v1/results?node=node-a", timeout=5) as r:
+            res = json.loads(r.read())
+        total_wl = np.sum([w["energy_uj"] for w in res["workloads"]], axis=0)
+        # zones arrive sorted; map report zones (package, dram) → canonical
+        active = np.zeros(2)
+        for j, zn in enumerate(["package", "dram"]):
+            i = res["zones"].index(zn)
+            active[i] = report.zone_deltas_uj[j] * report.usage_ratio
+        np.testing.assert_allclose(total_wl, active, rtol=1e-4)
+
+    def test_zone_union_alignment(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a", z=2),
+                    zones=("package", "dram"))
+        post_report(server, make_report("node-b", z=1), zones=("psys",))
+        agg.aggregate_once()
+        host, port = server.addresses[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/v1/results", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["nodes"]["node-a"]["zones"] == [
+            "dram", "package", "psys"]
+        # node-a has no psys → zero power there
+        a = payload["nodes"]["node-a"]
+        assert a["node_power_uw"][a["zones"].index("psys")] == 0.0
+        b = payload["nodes"]["node-b"]
+        assert b["node_power_uw"][b["zones"].index("psys")] > 0.0
+
+    def test_stale_nodes_fall_out(self, server):
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, stale_after=15.0,
+                         clock=lambda: now[0], node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a"))
+        post_report(server, make_report("node-b", seed=1))
+        agg.aggregate_once()
+        assert agg._stats["last_batch_nodes"] == 2
+        now[0] += 10.0
+        post_report(server, make_report("node-b", seed=2), seq=2)
+        now[0] += 10.0  # node-a now 20s old, node-b 10s old
+        agg.aggregate_once()
+        assert agg._stats["last_batch_nodes"] == 1
+        with agg._results_lock:
+            assert set(agg._results) == {"node-b"}
+
+    def test_rejects_garbage_post(self, server):
+        agg = Aggregator(server, model_mode=None)
+        agg.init()
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=b"not a report",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 400
+        assert agg._stats["rejected_total"] == 1
+
+    def test_oversized_post_rejected_without_buffering(self, server):
+        agg = Aggregator(server, model_mode=None)
+        agg.init()
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=b"x",
+            headers={"Content-Length": str(10**10)}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 413
+
+    def test_cumulative_survives_missed_batch(self, server):
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, stale_after=15.0,
+                         clock=lambda: now[0], node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a"))
+        agg.aggregate_once()
+        joules_before = dict(agg._cumulative["node-a"])
+        now[0] += 100.0  # node-a silent past stale_after but < retention
+        post_report(server, make_report("node-b", seed=1))
+        agg.aggregate_once()
+        assert agg._cumulative["node-a"] == joules_before  # kept
+        now[0] += 10.0
+        post_report(server, make_report("node-a", seed=2), seq=2)
+        agg.aggregate_once()
+        for zone, uj in agg._cumulative["node-a"].items():
+            assert uj >= joules_before[zone]  # accumulated, not reset
+
+    def test_stale_after_accepts_duration_string(self, tmp_path):
+        from kepler_tpu.config.config import from_file
+        path = tmp_path / "cfg.yaml"
+        path.write_text(
+            "aggregator:\n  interval: 2s\n  stale-after: 15s\n")
+        cfg = from_file(str(path))
+        assert cfg.aggregator.interval == 2.0
+        assert cfg.aggregator.stale_after == 15.0
+
+    def test_prometheus_families(self, server):
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import generate_latest
+
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a"))
+        agg.aggregate_once()
+        registry = CollectorRegistry()
+        registry.register(agg)
+        text = generate_latest(registry).decode()
+        assert "kepler_fleet_nodes 1.0" in text
+        assert 'kepler_fleet_node_cpu_watts{mode="ratio",node_name="node-a"'
+        assert "kepler_fleet_attributions_total 1.0" in text
+        assert "kepler_fleet_node_cpu_watts" in text
+
+    def test_model_params_reinit_on_zone_mismatch(self, server):
+        import jax
+        from kepler_tpu.models import init_mlp
+
+        agg = Aggregator(server, model_mode="mlp",
+                         model_params=init_mlp(jax.random.PRNGKey(0),
+                                               n_zones=5),
+                         node_bucket=8, workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a", mode=MODE_MODEL))
+        result = agg.aggregate_once()  # fleet has 2 zones, params have 5
+        assert result is not None
+        # trained params survive the mismatch; an untrained fallback served
+        # the window (review finding: transient zone changes must not
+        # destroy loaded params)
+        assert agg._model_out_dim() == 5
+        assert 2 in agg._fallback_params
+
+
+class FakeMeterMonitor:
+    """Minimal monitor stand-in exposing add_window_listener."""
+
+    def __init__(self):
+        self.listeners = []
+
+    def add_window_listener(self, fn):
+        self.listeners.append(fn)
+
+    def emit(self, sample):
+        for fn in self.listeners:
+            fn(sample)
+
+
+def make_sample(ts=100.0):
+    from kepler_tpu.monitor.monitor import WindowSample
+    from kepler_tpu.resource.informer import FeatureBatch
+
+    cpu = np.asarray([1.0, 2.0], np.float32)
+    batch = FeatureBatch(
+        kinds=np.asarray([0, 1], np.int8),
+        ids=["p1", "c1"],
+        cpu_deltas=cpu,
+        node_cpu_delta=3.0,
+        usage_ratio=0.5,
+    )
+    return WindowSample(
+        timestamp=ts, dt_s=5.0, zone_names=("package", "dram"),
+        zone_deltas_uj=np.asarray([1e7, 2e7]),
+        zone_valid=np.ones(2, bool), usage_ratio=0.5, batch=batch)
+
+
+class TestAgent:
+    def test_agent_end_to_end(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        host, port = server.addresses[0]
+        agent = FleetAgent(monitor, endpoint=f"{host}:{port}",
+                           node_name="test-node")
+        agent.init()
+        assert monitor.listeners  # subscribed
+        monitor.emit(make_sample())
+        # drain the queue synchronously (run() would do this in a thread)
+        sample = agent._queue.popleft()
+        agent._send(sample)
+        result = agg.aggregate_once()
+        assert result is not None
+        with agg._results_lock:
+            res = agg._results["test-node"]
+        assert [w["id"] for w in res["workloads"]] == ["p1", "c1"]
+        # workload kinds survive the wire
+        assert [w["kind"] for w in res["workloads"]] == [0, 1]
+
+    def test_agent_survives_down_aggregator(self):
+        monitor = FakeMeterMonitor()
+        agent = FleetAgent(monitor, endpoint="127.0.0.1:9",  # discard port
+                           node_name="test-node", timeout_s=0.2)
+        agent.init()
+        monitor.emit(make_sample())
+        sample = agent._queue.popleft()
+        with pytest.raises(OSError):
+            agent._send(sample)  # run() catches this and logs
+
+    def test_agent_run_loop_drains(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        host, port = server.addresses[0]
+        agent = FleetAgent(monitor, endpoint=f"http://{host}:{port}",
+                           node_name="loop-node")
+        agent.init()
+        ctx = CancelContext()
+        import threading
+        t = threading.Thread(target=agent.run, args=(ctx,), daemon=True)
+        t.start()
+        monitor.emit(make_sample())
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with agg._lock:
+                if "loop-node" in agg._reports:
+                    break
+            time.sleep(0.02)
+        ctx.cancel()
+        agent.shutdown()
+        t.join(timeout=2)
+        with agg._lock:
+            assert "loop-node" in agg._reports
+
+    def test_bad_endpoint_rejected(self):
+        with pytest.raises(ValueError):
+            FleetAgent(FakeMeterMonitor(), endpoint="nonsense")
+
+
+class TestParamsPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        import jax
+        from kepler_tpu.models import init_mlp
+        from kepler_tpu.models.estimator import load_params, save_params
+
+        params = init_mlp(jax.random.PRNGKey(0), n_zones=3)
+        path = str(tmp_path / "params.npz")
+        save_params(path, params)
+        loaded = load_params(path)
+        assert set(loaded) == set(params)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(loaded[k]),
+                                          np.asarray(params[k]))
