@@ -54,6 +54,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         model_params=params,
         node_bucket=cfg.tpu.node_bucket,
         workload_bucket=cfg.tpu.workload_bucket,
+        backend=cfg.tpu.fleet_backend,
     )
     services: list = [server, aggregator]
 

@@ -169,6 +169,9 @@ class TPUConfig:
     node_bucket: int = 8  # fleet aggregator node-axis bucket
     mesh_shape: list[int] = field(default_factory=list)  # [] = all devices, 1D
     mesh_axes: list[str] = field(default_factory=lambda: ["node"])
+    # fleet attribution contraction: "einsum" (XLA-fused) | "pallas"
+    # (hand-written Mosaic kernel, shard_map over the node axis)
+    fleet_backend: str = "einsum"
 
 
 @dataclass
@@ -274,6 +277,8 @@ _YAML_KEYS: dict[str, str] = {
     "nodeBucket": "node_bucket",
     "meshShape": "mesh_shape",
     "meshAxes": "mesh_axes",
+    "fleetBackend": "fleet_backend",
+    "fleet-backend": "fleet_backend",
 }
 
 _DURATION_FIELDS = {"interval", "staleness", "stale_after"}
@@ -386,6 +391,8 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         choices=["ratio", "model"])
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
+    add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
+        choices=["einsum", "pallas"])
 
 
 def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
@@ -424,6 +431,7 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "params_path"), args.aggregator_params_path)
     set_if(("aggregator", "node_mode"), args.aggregator_node_mode)
     set_if(("tpu", "platform"), args.tpu_platform)
+    set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     return cfg
 
 

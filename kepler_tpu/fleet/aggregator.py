@@ -68,6 +68,7 @@ class Aggregator:
         model_params: Mapping[str, np.ndarray] | None = None,
         node_bucket: int = 8,
         workload_bucket: int = 256,
+        backend: str = "einsum",
         clock=None,
         mesh=None,
     ) -> None:
@@ -78,6 +79,7 @@ class Aggregator:
         self._params = model_params
         self._node_bucket = node_bucket
         self._workload_bucket = workload_bucket
+        self._backend = backend
         self._clock = clock or _time.time
         self._mesh = mesh
 
@@ -199,7 +201,8 @@ class Aggregator:
             workload_bucket=self._workload_bucket)
         if self._program is None:
             self._program = make_fleet_program(self._mesh,
-                                               model_mode=self._model_mode)
+                                               model_mode=self._model_mode,
+                                               backend=self._backend)
         program = self._program
         params = self._params_for_zones(n_zones)
         t0 = _time.perf_counter()

@@ -1,0 +1,119 @@
+"""Pallas TPU kernel for the fleet-attribution hot op.
+
+The core contraction is ``energy[n,w,z] = ratio[n,w] × active[n,z]`` (+ the
+same shape for power) — a bandwidth-bound rank-1 outer product over the
+fleet batch. XLA fuses the einsum path well; this kernel exists to pin the
+best layout and fuse BOTH outputs in one pass over the inputs:
+
+- grid ``(Z, N/TN, W/TW)`` — each program computes a ``[TN, TW]`` tile, a
+  clean (8, 128)-aligned 2-D block. Emitting ``[N, W, Z]`` directly would
+  put Z(=4) on the lane axis and waste 32× of every VMEM tile; instead the
+  kernel writes ``[Z, N, W]`` and the wrapper transposes (one cheap XLA
+  relayout) to keep the public ``[N, W, Z]`` contract.
+- energy and power tiles read the same ratio block from VMEM once —
+  the einsum path reads it twice.
+
+CPU tests run the same kernel with ``interpret=True``
+(tests/conftest.py forces the CPU backend); on TPU it compiles with
+Mosaic. Sharded use goes through ``shard_map`` over the node axis (see
+``kepler_tpu.parallel.aggregator_core.make_fleet_program``) so each device
+runs the kernel on its local node shard — no cross-device communication,
+matching the einsum path's zero-collective forward.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kepler_tpu.ops.attribution import (
+    AttributionResult,
+    WorkloadAttribution,
+    _node_split,
+    _workload_ratios,
+)
+
+
+def _tile(n: int, preferred: int) -> int:
+    """Largest divisor of ``n`` that is ≤ preferred (fleet batches are
+    bucketed, so this is almost always ``preferred`` itself)."""
+    t = min(preferred, n)
+    while n % t:
+        t -= 1
+    return t
+
+
+def _outer_kernel(ratio_ref, a_ref, p_ref, energy_ref, power_ref):
+    ratio = ratio_ref[...]  # [TN, TW]
+    energy_ref[0] = ratio * a_ref[0]  # a_ref: [1, TN, 1] → [TN, 1] broadcasts
+    power_ref[0] = ratio * p_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def outer_product_attribution(
+    ratio: jax.Array,  # f32 [N, W]
+    active_uj: jax.Array,  # f32 [N, Z]
+    active_power_uw: jax.Array,  # f32 [N, Z]
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """→ (energy_uj [N,W,Z], power_uw [N,W,Z]) in one fused kernel pass."""
+    n, w = ratio.shape
+    z = active_uj.shape[1]
+    tn = _tile(n, 8)
+    tw = _tile(w, 512)  # wide lanes amortize the per-program overhead
+    grid = (z, n // tn, w // tw)
+
+    # zone columns as [Z, N, 1] so each program's block is a legal tile
+    # (Mosaic wants the last block dim ≡ 128-divisible OR equal to the
+    # array's — a trailing singleton qualifies); the relayout is a few KB
+    active_zn1 = jnp.transpose(active_uj)[..., None]
+    power_zn1 = jnp.transpose(active_power_uw)[..., None]
+    zone_spec = pl.BlockSpec((1, tn, 1), lambda zi, i, j: (zi, i, 0))
+    out_shape = jax.ShapeDtypeStruct((z, n, w), ratio.dtype)
+    out_spec = pl.BlockSpec((1, tn, tw), lambda zi, i, j: (zi, i, j))
+    energy_znw, power_znw = pl.pallas_call(
+        _outer_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tn, tw), lambda zi, i, j: (i, j)),
+            zone_spec,
+            zone_spec,
+        ],
+        out_specs=[out_spec, out_spec],
+        out_shape=[out_shape, out_shape],
+        interpret=interpret,
+    )(ratio, active_zn1, power_zn1)
+    # relayout to the public [N, W, Z] contract
+    return (jnp.transpose(energy_znw, (1, 2, 0)),
+            jnp.transpose(power_znw, (1, 2, 0)))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def attribute_fleet_pallas(
+    zone_deltas_uj: jax.Array,  # f32 [N, Z]
+    zone_valid: jax.Array,  # bool [N, Z]
+    usage_ratio: jax.Array,  # f32 [N]
+    cpu_deltas: jax.Array,  # f32 [N, W]
+    workload_valid: jax.Array,  # bool [N, W]
+    node_cpu_delta: jax.Array,  # f32 [N]
+    dt_s: jax.Array,  # f32 [N]
+    *,
+    interpret: bool = False,
+) -> AttributionResult:
+    """Drop-in for ``ops.attribution.attribute_fleet`` with the outer
+    product running as the Pallas kernel (identical results to f32
+    rounding)."""
+    node = _node_split(zone_deltas_uj, zone_valid, usage_ratio, dt_s)
+    ratios = _workload_ratios(cpu_deltas, workload_valid, node_cpu_delta)
+    energy, power = outer_product_attribution(
+        ratios, node.active_uj, node.active_power_uw, interpret=interpret)
+    return AttributionResult(
+        node=node,
+        workloads=WorkloadAttribution(
+            energy_uj=energy, power_uw=power, cpu_ratio=ratios
+        ),
+    )

@@ -57,9 +57,10 @@ def fleet_attribution_program(
     mode: jax.Array,  # int32 [N] MODE_RATIO / MODE_MODEL
     *,
     predict_fn,
+    attribute_fn=attribute_fleet,
 ) -> FleetResult:
     """The pure program; wrap with jit+shardings via ``make_fleet_program``."""
-    ratio = attribute_fleet(
+    ratio = attribute_fn(
         zone_deltas_uj, zone_valid, usage_ratio, cpu_deltas,
         workload_valid, node_cpu_delta, dt_s,
     )
@@ -110,18 +111,62 @@ def fleet_attribution_program(
     )
 
 
-def make_fleet_program(mesh: Mesh, model_mode: str | None = None):
+def resolve_attribute_fn(mesh: Mesh, backend: str):
+    """→ the fleet-attribution contraction for ``backend``.
+
+    "einsum" lets XLA fuse it; "pallas" binds the Mosaic kernel with
+    interpret mode engaged automatically off-TPU. Shared by the sharded
+    and packed-transfer program builders.
+    """
+    if backend == "pallas":
+        from kepler_tpu.ops.pallas_attribution import attribute_fleet_pallas
+        interpret = mesh.devices.flat[0].platform != "tpu"
+        return functools.partial(attribute_fleet_pallas, interpret=interpret)
+    if backend == "einsum":
+        return attribute_fleet
+    raise ValueError(f"unknown attribution backend {backend!r}; "
+                     "valid: einsum, pallas")
+
+
+def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
+                       backend: str = "einsum"):
     """jit the fleet program with node-axis shardings over ``mesh``.
 
     ``model_mode``: None = ratio only; "linear"/"mlp" compiles that
     predictor into the program for mixed fleets.
+
+    ``backend``: "einsum" lets XLA fuse the attribution contraction;
+    "pallas" runs it as the hand-written Mosaic kernel
+    (``ops.pallas_attribution``), wrapped in ``shard_map`` over the node
+    axis so each device executes the kernel on its local shard (the
+    forward has no cross-node math, so this changes layout, not
+    semantics; interpret mode engages automatically off-TPU).
     """
     predict_fn = predictor(model_mode) if model_mode else None
     by_node_2d = NamedSharding(mesh, P(NODE_AXIS, None))
     by_node_1d = NamedSharding(mesh, P(NODE_AXIS))
     replicated = NamedSharding(mesh, P())
 
-    fn = functools.partial(fleet_attribution_program, predict_fn=predict_fn)
+    attribute_fn = resolve_attribute_fn(mesh, backend)
+    if backend == "pallas":
+        from jax import shard_map
+
+        inner = functools.partial(fleet_attribution_program,
+                                  predict_fn=predict_fn,
+                                  attribute_fn=attribute_fn)
+        data_specs = (P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
+                      P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
+                      P(NODE_AXIS), P(NODE_AXIS))
+        fn = shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(),) + data_specs,
+            out_specs=P(NODE_AXIS),
+            check_vma=False,  # pallas_call defeats the varying-axes checker
+        )
+    else:
+        fn = functools.partial(fleet_attribution_program,
+                               predict_fn=predict_fn,
+                               attribute_fn=attribute_fn)
     return jax.jit(
         fn,
         in_shardings=(

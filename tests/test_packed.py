@@ -1,0 +1,87 @@
+"""Packed-transfer fleet program: one-array-in/one-array-out parity with
+the unpacked program (f16 scatter-back within the 0.5%-of-RAPL budget)."""
+
+import jax
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kepler_tpu.models import init_mlp
+from kepler_tpu.parallel import (
+    assemble_fleet_batch,
+    make_fleet_program,
+    make_mesh,
+    run_fleet_attribution,
+)
+from kepler_tpu.parallel.fleet import MODE_MODEL, NodeReport
+from kepler_tpu.parallel.packed import (
+    make_packed_fleet_program,
+    pack_fleet_inputs,
+    unpack_fleet_watts,
+)
+
+
+def make_batch(n_reports=16, z=2, workload_bucket=16):
+    rng = np.random.default_rng(0)
+    reports = []
+    for i in range(n_reports):
+        w = int(rng.integers(2, 12))
+        cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+        reports.append(NodeReport(
+            node_name=f"n{i}",
+            zone_deltas_uj=rng.uniform(1e7, 1e8, z).astype(np.float32),
+            zone_valid=np.ones(z, bool),
+            usage_ratio=0.6,
+            cpu_deltas=cpu,
+            workload_ids=[f"n{i}-w{j}" for j in range(w)],
+            node_cpu_delta=float(cpu.sum()),
+            dt_s=5.0,
+            mode=MODE_MODEL if i % 2 else 0,
+        ))
+    return assemble_fleet_batch(reports, n_zones=z, node_bucket=8,
+                                workload_bucket=workload_bucket)
+
+
+@pytest.mark.parametrize("backend", ["einsum", "pallas"])
+def test_packed_matches_unpacked(backend):
+    mesh = make_mesh()
+    batch = make_batch()
+    n, w, z = batch.shape
+    params = init_mlp(jax.random.PRNGKey(0), n_zones=z)
+    packed_prog = make_packed_fleet_program(
+        mesh, n_workloads=w, n_zones=z, model_mode="mlp", backend=backend)
+    out = np.asarray(packed_prog(params, jnp.asarray(pack_fleet_inputs(batch))))
+    wl_watts, node_watts = unpack_fleet_watts(out)
+    assert wl_watts.shape == (n, w, z)
+    assert node_watts.shape == (n, z)
+
+    ref = run_fleet_attribution(
+        make_fleet_program(mesh, model_mode="mlp"), batch, params)
+    ref_wl = np.asarray(ref.workload_power_uw) * 1e-6
+    ref_node = np.asarray(ref.node_active_power_uw) * 1e-6
+    # f16 wire format: ~0.05% relative error, inside the 0.5% budget
+    np.testing.assert_allclose(wl_watts.astype(np.float64), ref_wl,
+                               rtol=2e-3, atol=1e-4)
+    np.testing.assert_allclose(node_watts.astype(np.float64), ref_node,
+                               rtol=2e-3, atol=1e-4)
+
+
+def test_padding_rides_as_nan_and_returns_zero():
+    mesh = make_mesh()
+    batch = make_batch()
+    n, w, z = batch.shape
+    packed = pack_fleet_inputs(batch)
+    assert np.isnan(packed[0, :w][~batch.workload_valid[0]]).all()
+    assert not np.isnan(packed[0, :w][batch.workload_valid[0]]).any()
+    prog = make_packed_fleet_program(mesh, n_workloads=w, n_zones=z,
+                                     model_mode=None)
+    wl_watts, _ = unpack_fleet_watts(
+        np.asarray(prog(None, jnp.asarray(packed))))
+    assert (wl_watts[~batch.workload_valid] == 0).all()
+    assert np.isfinite(wl_watts).all()
+
+
+def test_packed_rejects_unknown_backend():
+    with pytest.raises(ValueError, match="backend"):
+        make_packed_fleet_program(make_mesh(), 16, 2, backend="cuda")
