@@ -1,0 +1,108 @@
+# kepler-tpu build/test/deploy targets (analog of the reference Makefile).
+
+SHELL := /bin/bash
+PYTHON ?= python
+IMG ?= kepler-tpu
+TAG ?= latest
+CLUSTER_NAME ?= kepler-tpu-dev
+
+VERSION := $(shell $(PYTHON) -c "from kepler_tpu.version import __version__; print(__version__)" 2>/dev/null || echo unknown)
+GIT_COMMIT := $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
+GIT_BRANCH := $(shell git rev-parse --abbrev-ref HEAD 2>/dev/null || echo unknown)
+
+.PHONY: all
+all: test
+
+# -- test ---------------------------------------------------------------------
+# Tests run on a virtual 8-device CPU mesh (tests/conftest.py) so multi-chip
+# sharding is exercised without TPU hardware — the analog of the reference's
+# `go test -race` everywhere (Makefile:131).
+.PHONY: test
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+.PHONY: test-verbose
+test-verbose:
+	$(PYTHON) -m pytest tests/ -v
+
+.PHONY: bench
+bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
+	$(PYTHON) bench.py
+
+.PHONY: dryrun
+dryrun: ## compile-check driver entry points on a virtual 8-device mesh
+	$(PYTHON) __graft_entry__.py
+
+# -- native -------------------------------------------------------------------
+.PHONY: native
+native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)
+	$(PYTHON) -c "from kepler_tpu.native import ensure_built; print(ensure_built(force=True))"
+
+# -- lint ---------------------------------------------------------------------
+.PHONY: lint
+lint:
+	$(PYTHON) -m compileall -q kepler_tpu tests hack
+	@command -v ruff >/dev/null 2>&1 && ruff check kepler_tpu tests hack || \
+		echo "ruff not installed; compileall-only lint"
+
+# -- docs ---------------------------------------------------------------------
+.PHONY: gen-metric-docs
+gen-metric-docs: ## regenerate docs/user/metrics.md from the live collectors
+	$(PYTHON) hack/gen_metric_docs.py
+
+.PHONY: check-metric-docs
+check-metric-docs:
+	$(PYTHON) hack/gen_metric_docs.py --check
+
+# -- run ----------------------------------------------------------------------
+.PHONY: run
+run: ## run the node agent against the real host (needs RAPL access)
+	$(PYTHON) -m kepler_tpu.cmd.main
+
+.PHONY: run-fake
+run-fake: ## run with the fake meter + stdout exporter (no hardware needed)
+	$(PYTHON) -m kepler_tpu.cmd.main \
+		--config.file=compose/dev/kepler/etc/kepler/config.yaml \
+		--exporter.stdout --no-kube.enable --aggregator.endpoint=
+
+.PHONY: run-aggregator
+run-aggregator: ## run the TPU fleet aggregator
+	$(PYTHON) -m kepler_tpu.cmd.aggregator --aggregator.enable
+
+# -- image / deploy -----------------------------------------------------------
+.PHONY: image
+image:
+	docker build -t $(IMG):$(TAG) .
+
+.PHONY: compose-up
+compose-up: ## dev stack: agent + aggregator + prometheus + grafana
+	cd compose/dev && docker compose up --build -d
+
+.PHONY: compose-down
+compose-down:
+	cd compose/dev && docker compose down -v
+
+.PHONY: cluster-up
+cluster-up: ## kind dev cluster (hack/cluster.sh)
+	CLUSTER_NAME=$(CLUSTER_NAME) hack/cluster.sh up
+
+.PHONY: cluster-down
+cluster-down:
+	CLUSTER_NAME=$(CLUSTER_NAME) hack/cluster.sh down
+
+.PHONY: deploy
+deploy: ## build image, load into kind, apply manifests
+	CLUSTER_NAME=$(CLUSTER_NAME) IMG=$(IMG) TAG=$(TAG) hack/cluster.sh deploy
+
+.PHONY: undeploy
+undeploy:
+	kubectl delete -k manifests/k8s || true
+
+.PHONY: version
+version:
+	@echo "version=$(VERSION) commit=$(GIT_COMMIT) branch=$(GIT_BRANCH)"
+
+.PHONY: help
+help:
+	@grep -E '^[a-zA-Z_-]+:.*?## .*$$' $(MAKEFILE_LIST) | \
+		awk 'BEGIN {FS = ":.*?## "}; {printf "  \033[36m%-18s\033[0m %s\n", $$1, $$2}'

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# kind-based dev cluster for kepler-tpu (analog of reference hack/cluster.sh).
+#
+#   hack/cluster.sh up       create the kind cluster
+#   hack/cluster.sh deploy   build + load the image, apply manifests/k8s
+#   hack/cluster.sh down     delete the cluster
+set -euo pipefail
+
+CLUSTER_NAME=${CLUSTER_NAME:-kepler-tpu-dev}
+IMG=${IMG:-kepler-tpu}
+TAG=${TAG:-latest}
+ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+
+need() {
+    command -v "$1" >/dev/null 2>&1 || {
+        echo "error: '$1' is required" >&2
+        exit 1
+    }
+}
+
+cluster_up() {
+    need kind
+    if kind get clusters 2>/dev/null | grep -qx "$CLUSTER_NAME"; then
+        echo "cluster '$CLUSTER_NAME' already exists"
+        return
+    fi
+    # hostPID DaemonSet needs /proc and /sys from the node; kind nodes are
+    # containers, so the agent sees the kind node's (host's) procfs — good
+    # enough for dev. RAPL is typically absent: deploy the fake meter config.
+    kind create cluster --name "$CLUSTER_NAME" --wait 120s
+}
+
+cluster_down() {
+    need kind
+    kind delete cluster --name "$CLUSTER_NAME"
+}
+
+deploy() {
+    need kind
+    need kubectl
+    need docker
+    docker build -t "$IMG:$TAG" "$ROOT"
+    kind load docker-image "$IMG:$TAG" --name "$CLUSTER_NAME"
+    kubectl apply -k "$ROOT/manifests/k8s"
+    # kind nodes have no RAPL and no TPUs: switch the agent to the fake
+    # meter and drop the aggregator's TPU node selector
+    kubectl -n kepler-tpu patch daemonset kepler-tpu --type=json -p='[
+      {"op": "add",
+       "path": "/spec/template/spec/containers/0/args/-",
+       "value": "--config.file=/etc/kepler/config.yaml"}]' || true
+    kubectl -n kepler-tpu patch deployment kepler-tpu-aggregator --type=json -p='[
+      {"op": "remove", "path": "/spec/template/spec/nodeSelector"},
+      {"op": "remove", "path": "/spec/template/spec/containers/0/resources/limits/google.com~1tpu"}]' || true
+    kubectl -n kepler-tpu rollout status daemonset/kepler-tpu --timeout=120s
+    echo "deployed; scrape any agent at :28282/metrics"
+}
+
+case "${1:-}" in
+up) cluster_up ;;
+down) cluster_down ;;
+deploy) deploy ;;
+*)
+    echo "usage: $0 {up|down|deploy}" >&2
+    exit 1
+    ;;
+esac

@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""Generate ``docs/user/metrics.md`` from the live collectors.
+
+Reference parity: ``hack/gen-metric-docs/main.go`` — instantiate the real
+Prometheus collectors against a fixture monitor (reference ``MockMonitor``,
+main.go:31-47), harvest every metric family's name / type / help / labels,
+and render the user-facing metrics reference. Running the generator keeps
+the doc from drifting from the code; a test pins the output
+(reference ``hack/gen-metric-docs/main_test.go``).
+
+Usage:  python hack/gen_metric_docs.py [--check]
+  --check   exit 1 if docs/user/metrics.md is stale (CI mode) instead of
+            rewriting it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kepler_tpu.exporter.prometheus.collector import PowerCollector  # noqa: E402
+from kepler_tpu.exporter.prometheus.info_collectors import (  # noqa: E402
+    BuildInfoCollector,
+    CPUInfoCollector,
+)
+from kepler_tpu.monitor.snapshot import (  # noqa: E402
+    NodeUsage,
+    Snapshot,
+    WorkloadTable,
+)
+
+OUT_PATH = os.path.join(REPO, "docs", "user", "metrics.md")
+
+_ZONES = ("package", "dram")
+
+
+def _table(kind: str) -> WorkloadTable:
+    meta = {
+        "process": {"comm": "bash", "exe": "/bin/bash", "type": "regular",
+                    "container_id": "", "vm_id": "",
+                    "_cpu_total_seconds": 1.0},
+        "container": {"container_name": "web", "runtime": "docker",
+                      "pod_id": "p-1"},
+        "vm": {"vm_name": "guest", "hypervisor": "kvm"},
+        "pod": {"pod_name": "web-1", "namespace": "default"},
+    }[kind]
+    return WorkloadTable(
+        ids=("1",), meta=(meta,),
+        energy_uj=np.full((1, len(_ZONES)), 1e6),
+        power_uw=np.full((1, len(_ZONES)), 1e6),
+    )
+
+
+class FixtureMonitor:
+    """Minimal PowerDataProvider: one workload of every kind, both states
+    (the analog of the reference MockMonitor, gen-metric-docs/main.go:31-47).
+    """
+
+    def __init__(self) -> None:
+        self._ready = threading.Event()
+        self._ready.set()
+        z = len(_ZONES)
+        node = NodeUsage(
+            zone_names=_ZONES,
+            energy_uj=np.full(z, 1e6), active_uj=np.full(z, 6e5),
+            idle_uj=np.full(z, 4e5), power_uw=np.full(z, 1e6),
+            active_power_uw=np.full(z, 6e5), idle_power_uw=np.full(z, 4e5),
+            window_active_uj=np.full(z, 6e5), usage_ratio=0.6,
+        )
+        self._snap = Snapshot(
+            timestamp=0.0, node=node,
+            processes=_table("process"), containers=_table("container"),
+            virtual_machines=_table("vm"), pods=_table("pod"),
+            terminated_processes=_table("process"),
+            terminated_containers=_table("container"),
+            terminated_virtual_machines=_table("vm"),
+            terminated_pods=_table("pod"),
+        )
+
+    def data_channel(self) -> threading.Event:
+        return self._ready
+
+    def snapshot(self) -> Snapshot:
+        return self._snap
+
+
+def harvest():
+    """Collect (name, type, help, labels) for every family, in emit order."""
+    # fixture cpuinfo so label harvesting never depends on the host machine
+    import tempfile
+
+    tmp = tempfile.mkdtemp(prefix="kepler-gen-docs-")
+    with open(os.path.join(tmp, "cpuinfo"), "w", encoding="utf-8") as f:
+        f.write("processor\t: 0\nvendor_id\t: GenuineIntel\n"
+                "model name\t: Fixture CPU\nphysical id\t: 0\n"
+                "core id\t: 0\n\n")
+    collectors = [
+        PowerCollector(FixtureMonitor(), node_name="node-a"),  # type: ignore
+        BuildInfoCollector(),
+        CPUInfoCollector(procfs=tmp),
+    ]
+    seen: dict[str, tuple[str, str, tuple[str, ...]]] = {}
+    for collector in collectors:
+        for family in collector.collect():
+            labels: tuple[str, ...] = ()
+            for sample in family.samples:
+                if len(sample.labels) > len(labels):
+                    labels = tuple(sample.labels)
+            prev = seen.get(family.name)
+            if prev is None or len(labels) > len(prev[2]):
+                seen[family.name] = (family.type, family.documentation,
+                                     labels)
+    return seen
+
+
+_GROUPS = (
+    ("Node", "kepler_node_cpu_"),
+    ("Process", "kepler_process_"),
+    ("Container", "kepler_container_"),
+    ("Virtual Machine", "kepler_vm_"),
+    ("Pod", "kepler_pod_"),
+    ("Exporter self-metrics", "kepler_build_info"),
+    ("Node info", "kepler_node_cpu_info"),
+)
+
+_SUFFIX = {"counter": "_total"}  # OpenMetrics: counters expose *_total
+
+
+def render(families) -> str:
+    lines = [
+        "# Metrics",
+        "",
+        "All metrics exported by kepler-tpu, generated from the live",
+        "collectors by `hack/gen_metric_docs.py` — do not edit by hand.",
+        "Regenerate with `make gen-metric-docs` (CI checks freshness with",
+        "`python hack/gen_metric_docs.py --check`).",
+        "",
+        "Naming follows the reference (`docs/user/metrics.md` upstream):",
+        "`kepler_<level>_<device>_<metric>[_total]`, energy in joules",
+        "(cumulative counters), power in watts (gauges).",
+        "",
+    ]
+    emitted = set()
+
+    def group_of(name: str) -> str:
+        if name in ("kepler_build_info",):
+            return "Exporter self-metrics"
+        if name == "kepler_node_cpu_info":
+            return "Node info"
+        for title, prefix in _GROUPS:
+            if name.startswith(prefix):
+                return title
+        return "Other"
+
+    order = ["Node", "Process", "Container", "Virtual Machine", "Pod",
+             "Node info", "Exporter self-metrics", "Other"]
+    by_group: dict[str, list[str]] = {g: [] for g in order}
+    for name in families:
+        by_group.setdefault(group_of(name), []).append(name)
+    for title in order:
+        names = by_group.get(title, [])
+        if not names:
+            continue
+        lines += [f"## {title}", ""]
+        for name in names:
+            if name in emitted:
+                continue
+            emitted.add(name)
+            ftype, doc, labels = families[name]
+            exposed = name + _SUFFIX.get(ftype, "")
+            lines += [f"### `{exposed}`", "",
+                      f"{doc.strip().rstrip('.')}.", "",
+                      f"- **Type**: {ftype.capitalize()}"]
+            if labels:
+                label_list = ", ".join(f"`{label}`" for label in labels)
+                lines.append(f"- **Labels**: {label_list}")
+            lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main() -> int:
+    text = render(harvest())
+    if "--check" in sys.argv:
+        try:
+            with open(OUT_PATH, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print(f"{OUT_PATH} is stale; run python hack/gen_metric_docs.py",
+                  file=sys.stderr)
+            return 1
+        print(f"{OUT_PATH} is up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"wrote {OUT_PATH} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

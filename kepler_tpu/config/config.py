@@ -249,6 +249,18 @@ class Config:
             errs.append("tpu.workload_bucket must be > 0")
         if self.tpu.node_bucket <= 0:
             errs.append("tpu.node_bucket must be > 0")
+        # fail at startup, not on the first aggregation window (YAML values
+        # bypass the CLI flags' choices= checks)
+        if self.tpu.platform not in ("auto", "tpu", "cpu"):
+            errs.append(f"invalid tpu.platform: {self.tpu.platform!r}")
+        if self.tpu.fleet_backend not in ("einsum", "pallas"):
+            errs.append(
+                f"invalid tpu.fleetBackend: {self.tpu.fleet_backend!r}")
+        if self.aggregator.model not in ("", "linear", "mlp"):
+            errs.append(f"invalid aggregator.model: {self.aggregator.model!r}")
+        if self.aggregator.node_mode not in ("ratio", "model"):
+            errs.append(
+                f"invalid aggregator.nodeMode: {self.aggregator.node_mode!r}")
         if errs:
             raise ValueError("invalid configuration: " + "; ".join(errs))
 

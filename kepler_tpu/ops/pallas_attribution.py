@@ -37,13 +37,23 @@ from kepler_tpu.ops.attribution import (
 )
 
 
-def _tile(n: int, preferred: int) -> int:
-    """Largest divisor of ``n`` that is ≤ preferred (fleet batches are
-    bucketed, so this is almost always ``preferred`` itself)."""
-    t = min(preferred, n)
-    while n % t:
-        t -= 1
-    return t
+def _tile(n: int, preferred: int, align: int) -> int:
+    """Largest Mosaic-legal tile for a dim of size ``n``.
+
+    Legal means: a divisor of ``n`` that is a multiple of ``align`` (lane
+    dim must be 128-divisible, sublane 8-divisible) — or ``n`` itself, since
+    a block spanning the whole array dim is always accepted. Fleet batches
+    are bucketed so the aligned-divisor case is the norm; the full-dim
+    fallback keeps odd shapes correct at worst a little more VMEM.
+    """
+    if n <= preferred:
+        return n
+    t = preferred - preferred % align
+    while t > 0:
+        if n % t == 0:
+            return t
+        t -= align
+    return n
 
 
 def _outer_kernel(ratio_ref, a_ref, p_ref, energy_ref, power_ref):
@@ -63,8 +73,8 @@ def outer_product_attribution(
     """→ (energy_uj [N,W,Z], power_uw [N,W,Z]) in one fused kernel pass."""
     n, w = ratio.shape
     z = active_uj.shape[1]
-    tn = _tile(n, 8)
-    tw = _tile(w, 512)  # wide lanes amortize the per-program overhead
+    tn = _tile(n, 8, 8)
+    tw = _tile(w, 512, 128)  # wide lanes amortize the per-program overhead
     grid = (z, n // tn, w // tw)
 
     # zone columns as [Z, N, 1] so each program's block is a legal tile

@@ -128,6 +128,20 @@ def resolve_attribute_fn(mesh: Mesh, backend: str):
                      "valid: einsum, pallas")
 
 
+def shard_by_node(fn, mesh: Mesh, in_specs):
+    """shard_map ``fn`` over the node axis (pallas-backend program builders).
+
+    pallas_call has no SPMD partitioning rule, so the kernel must run
+    per-shard; the fleet forward has no cross-node math, so this changes
+    layout, not semantics. check_vma=False because pallas_call defeats the
+    varying-axes checker.
+    """
+    from jax import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=P(NODE_AXIS), check_vma=False)
+
+
 def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
                        backend: str = "einsum"):
     """jit the fleet program with node-axis shardings over ``mesh``.
@@ -148,25 +162,14 @@ def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
     replicated = NamedSharding(mesh, P())
 
     attribute_fn = resolve_attribute_fn(mesh, backend)
+    fn = functools.partial(fleet_attribution_program,
+                           predict_fn=predict_fn,
+                           attribute_fn=attribute_fn)
     if backend == "pallas":
-        from jax import shard_map
-
-        inner = functools.partial(fleet_attribution_program,
-                                  predict_fn=predict_fn,
-                                  attribute_fn=attribute_fn)
         data_specs = (P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
                       P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
                       P(NODE_AXIS), P(NODE_AXIS))
-        fn = shard_map(
-            inner, mesh=mesh,
-            in_specs=(P(),) + data_specs,
-            out_specs=P(NODE_AXIS),
-            check_vma=False,  # pallas_call defeats the varying-axes checker
-        )
-    else:
-        fn = functools.partial(fleet_attribution_program,
-                               predict_fn=predict_fn,
-                               attribute_fn=attribute_fn)
+        fn = shard_by_node(fn, mesh, in_specs=(P(),) + data_specs)
     return jax.jit(
         fn,
         in_shardings=(

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from kepler_tpu.parallel.aggregator_core import (
     fleet_attribution_program,
     resolve_attribute_fn,
+    shard_by_node,
 )
 from kepler_tpu.parallel.fleet import FleetBatch
 from kepler_tpu.parallel.mesh import NODE_AXIS
@@ -81,14 +82,7 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
 
     fn = unpack_and_attribute
     if backend == "pallas":
-        # pallas_call has no SPMD partitioning rule — run per-shard
-        from jax import shard_map
-        fn = shard_map(
-            unpack_and_attribute, mesh=mesh,
-            in_specs=(P(), P(NODE_AXIS, None)),
-            out_specs=P(NODE_AXIS),
-            check_vma=False,
-        )
+        fn = shard_by_node(fn, mesh, in_specs=(P(), P(NODE_AXIS, None)))
     return jax.jit(
         fn,
         in_shardings=(NamedSharding(mesh, P()),

@@ -151,6 +151,26 @@ class TestValidation:
         with pytest.raises(ValueError, match="interval"):
             cfg.validate(skip=["host"])
 
+    def test_bad_fleet_backend_rejected_at_startup(self):
+        # YAML bypasses the CLI choices= check; validate() must catch the
+        # typo instead of the aggregator failing every window forever
+        cfg = default_config()
+        cfg.tpu.fleet_backend = "pallsa"
+        with pytest.raises(ValueError, match="fleetBackend"):
+            cfg.validate(skip=["host"])
+
+    def test_bad_tpu_platform_rejected(self):
+        cfg = default_config()
+        cfg.tpu.platform = "cuda"
+        with pytest.raises(ValueError, match="tpu.platform"):
+            cfg.validate(skip=["host"])
+
+    def test_bad_aggregator_model_rejected(self):
+        cfg = default_config()
+        cfg.aggregator.model = "transformer"
+        with pytest.raises(ValueError, match="aggregator.model"):
+            cfg.validate(skip=["host"])
+
 
 class TestLevel:
     def test_parse_single(self):

@@ -1,0 +1,56 @@
+"""Metric-docs generator tests.
+
+Mirrors reference ``hack/gen-metric-docs/main_test.go`` — the generated
+``docs/user/metrics.md`` must match what the live collectors emit, so the
+doc can never silently drift from the code.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_metric_docs", os.path.join(REPO, "hack", "gen_metric_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestGenMetricDocs:
+    def test_doc_is_fresh(self):
+        gen = load_generator()
+        with open(gen.OUT_PATH, encoding="utf-8") as f:
+            current = f.read()
+        assert current == gen.render(gen.harvest()), (
+            "docs/user/metrics.md is stale; "
+            "run: python hack/gen_metric_docs.py")
+
+    def test_all_power_families_documented(self):
+        gen = load_generator()
+        families = gen.harvest()
+        for name in (
+            "kepler_node_cpu_joules",
+            "kepler_node_cpu_watts",
+            "kepler_node_cpu_usage_ratio",
+            "kepler_process_cpu_joules",
+            "kepler_process_cpu_seconds",
+            "kepler_container_cpu_joules",
+            "kepler_vm_cpu_joules",
+            "kepler_pod_cpu_joules",
+            "kepler_build_info",
+            "kepler_node_cpu_info",
+        ):
+            assert name in families, f"missing family {name}"
+
+    def test_label_sets_match_reference(self):
+        gen = load_generator()
+        families = gen.harvest()
+        _, _, labels = families["kepler_container_cpu_joules"]
+        assert labels == ("container_id", "container_name", "runtime",
+                          "pod_id", "state", "zone", "node_name")
+        _, _, labels = families["kepler_pod_cpu_joules"]
+        assert labels == ("pod_id", "pod_name", "pod_namespace", "state",
+                          "zone", "node_name")

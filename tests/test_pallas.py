@@ -111,3 +111,38 @@ def test_unknown_backend_rejected():
 
     with pytest.raises(ValueError, match="backend"):
         make_fleet_program(make_mesh(), backend="cuda")
+
+
+@pytest.mark.parametrize("n", [1, 6, 8, 12, 100, 256, 1024, 1280, 1408, 700])
+def test_tile_sizes_mosaic_legal(n):
+    # Mosaic accepts a block dim that is align-divisible OR equal to the
+    # array dim; anything else fails to compile on real TPU (tests run
+    # interpret mode and would never catch it)
+    from kepler_tpu.ops.pallas_attribution import _tile
+
+    for preferred, align in ((8, 8), (512, 128)):
+        t = _tile(n, preferred, align)
+        assert n % t == 0, f"tile {t} must divide dim {n}"
+        assert t % align == 0 or t == n, (
+            f"tile {t} for dim {n} is neither {align}-aligned nor full-dim")
+
+
+def test_odd_padded_widths_still_compute():
+    # W=1280 (a node with >1024 pods under the default 256 bucket) used to
+    # produce an illegal 320-wide tile; verify numerical parity end-to-end
+    import jax
+    import jax.numpy as jnp
+
+    from kepler_tpu.ops.pallas_attribution import outer_product_attribution
+
+    key = jax.random.PRNGKey(0)
+    n, w, z = 12, 1280, 4
+    ratio = jax.random.uniform(key, (n, w))
+    active = jax.random.uniform(key, (n, z)) * 1e6
+    power = jax.random.uniform(key, (n, z)) * 1e5
+    energy, watts = outer_product_attribution(ratio, active, power,
+                                              interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(energy), np.einsum("nw,nz->nwz", ratio, active), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(watts), np.einsum("nw,nz->nwz", ratio, power), rtol=1e-6)
