@@ -15,7 +15,6 @@ from typing import Sequence
 from kepler_tpu import version
 from kepler_tpu.config import parse_args_and_config
 from kepler_tpu.fleet import Aggregator
-from kepler_tpu.server.http import APIServer
 from kepler_tpu.service.lifecycle import (
     CancelContext,
     SignalHandler,
@@ -45,7 +44,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         log.info("loaded %s params from %s", cfg.aggregator.model,
                  cfg.aggregator.params_path)
 
-    server = APIServer(listen_addresses=[cfg.aggregator.listen_address])
+    from kepler_tpu.server.webconfig import make_api_server
+    server = make_api_server([cfg.aggregator.listen_address],
+                             cfg.web.config_file)
     aggregator = Aggregator(
         server,
         interval=cfg.aggregator.interval,

@@ -26,7 +26,7 @@ from kepler_tpu.exporter.stdout import StdoutExporter
 from kepler_tpu.monitor.monitor import PowerMonitor
 from kepler_tpu.resource import ResourceInformer, make_proc_reader
 from kepler_tpu.server.debug import DebugService
-from kepler_tpu.server.http import APIServer
+from kepler_tpu.server.webconfig import make_api_server
 from kepler_tpu.service.lifecycle import (
     CancelContext,
     SignalHandler,
@@ -69,7 +69,7 @@ def create_services(cfg: Config) -> list:
             cfg.monitor.min_terminated_energy_threshold * 1e6),
         workload_bucket=cfg.tpu.workload_bucket,
     )
-    server = APIServer(listen_addresses=cfg.web.listen_addresses)
+    server = make_api_server(cfg.web.listen_addresses, cfg.web.config_file)
     services: list = []
     if pod_lookup is not None:
         services.append(pod_lookup)
@@ -97,6 +97,7 @@ def create_services(cfg: Config) -> list:
             node_name=cfg.kube.node_name,
             mode=(MODE_MODEL if cfg.aggregator.node_mode == "model"
                   else MODE_RATIO),
+            tls_skip_verify=cfg.aggregator.tls_skip_verify,
         ))
     if cfg.aggregator.enabled:
         log.warning("aggregator.enabled is set — the aggregator role runs "

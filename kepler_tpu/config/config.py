@@ -190,8 +190,12 @@ class AggregatorConfig:
 
     enabled: bool = False
     listen_address: str = ":28283"
-    # node-agent side: where to stream feature rows ("" = standalone mode)
+    # node-agent side: where to stream feature rows ("" = standalone mode);
+    # https:// scheme + URL userinfo carry TLS and basic-auth credentials
+    # (https://user:pw@agg:28283) when the aggregator sets web.config-file
     endpoint: str = ""
+    # accept the aggregator's TLS cert without verification (self-signed dev)
+    tls_skip_verify: bool = False
     # aggregation cadence and how long a silent node stays in the batch
     interval: float = 5.0
     stale_after: float = 15.0
@@ -284,6 +288,7 @@ _YAML_KEYS: dict[str, str] = {
     "staleAfter": "stale_after",
     "stale-after": "stale_after",
     "paramsPath": "params_path",
+    "tlsSkipVerify": "tls_skip_verify",
     "nodeMode": "node_mode",
     "workloadBucket": "workload_bucket",
     "nodeBucket": "node_bucket",
@@ -395,6 +400,8 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         action=argparse.BooleanOptionalAction)
     add("--aggregator.listen-address", dest="aggregator_listen", default=None)
     add("--aggregator.endpoint", dest="aggregator_endpoint", default=None)
+    add("--aggregator.tls-skip-verify", dest="aggregator_tls_skip_verify",
+        default=None, action=argparse.BooleanOptionalAction)
     add("--aggregator.model", dest="aggregator_model", default=None,
         choices=["", "linear", "mlp"])
     add("--aggregator.params-path", dest="aggregator_params_path",
@@ -439,6 +446,7 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "enabled"), args.aggregator_enable)
     set_if(("aggregator", "listen_address"), args.aggregator_listen)
     set_if(("aggregator", "endpoint"), args.aggregator_endpoint)
+    set_if(("aggregator", "tls_skip_verify"), args.aggregator_tls_skip_verify)
     set_if(("aggregator", "model"), args.aggregator_model)
     set_if(("aggregator", "params_path"), args.aggregator_params_path)
     set_if(("aggregator", "node_mode"), args.aggregator_node_mode)

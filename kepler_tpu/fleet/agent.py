@@ -14,10 +14,12 @@ the aggregator pads/masks missing nodes out of the batch anyway.
 
 from __future__ import annotations
 
+import base64
 import collections
 import http.client
 import logging
 import socket
+import ssl
 import threading
 import urllib.parse
 
@@ -38,6 +40,7 @@ class FleetAgent:
         mode: int = MODE_RATIO,
         timeout_s: float = 2.0,
         queue_max: int = 8,
+        tls_skip_verify: bool = False,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
@@ -56,14 +59,26 @@ class FleetAgent:
                 f"aggregator endpoint needs host:port, got {endpoint!r}")
         self._host, self._port = u.hostname, u.port
         self._path = (u.path.rstrip("/") or "") + "/v1/report"
+        self._tls = u.scheme == "https"
+        self._tls_skip_verify = tls_skip_verify
+        # aggregator behind basic auth (webconfig.py): credentials ride in
+        # the endpoint URL userinfo — https://user:pw@agg:28283
+        self._auth_header = ""
+        if u.username is not None:
+            creds = f"{urllib.parse.unquote(u.username)}:" \
+                    f"{urllib.parse.unquote(u.password or '')}"
+            self._auth_header = "Basic " + base64.b64encode(
+                creds.encode()).decode()
 
     def name(self) -> str:
         return "fleet-agent"
 
     def init(self) -> None:
         self._monitor.add_window_listener(self._on_window)
-        log.info("fleet agent: node=%s → http://%s:%d%s",
-                 self._node_name, self._host, self._port, self._path)
+        log.info("fleet agent: node=%s → %s://%s:%d%s%s",
+                 self._node_name, "https" if self._tls else "http",
+                 self._host, self._port, self._path,
+                 " (basic auth)" if self._auth_header else "")
 
     def _on_window(self, sample: WindowSample) -> None:
         # runs inside the monitor's refresh lock: enqueue only
@@ -104,11 +119,24 @@ class FleetAgent:
         )
         self._seq += 1
         body = encode_report(report, list(sample.zone_names), seq=self._seq)
-        conn = http.client.HTTPConnection(self._host, self._port,
-                                          timeout=self._timeout)
+        if self._tls:
+            if self._tls_skip_verify:
+                tls_ctx = ssl.create_default_context()
+                tls_ctx.check_hostname = False
+                tls_ctx.verify_mode = ssl.CERT_NONE
+            else:
+                tls_ctx = ssl.create_default_context()
+            conn = http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout,
+                context=tls_ctx)
+        else:
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=self._timeout)
+        headers = {"Content-Type": "application/octet-stream"}
+        if self._auth_header:
+            headers["Authorization"] = self._auth_header
         try:
-            conn.request("POST", self._path, body=body,
-                         headers={"Content-Type": "application/octet-stream"})
+            conn.request("POST", self._path, body=body, headers=headers)
             resp = conn.getresponse()
             resp.read()
             if resp.status >= 300:

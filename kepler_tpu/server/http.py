@@ -3,8 +3,9 @@
 Reference parity: ``internal/server/server.go`` — an HTTP mux where services
 ``register(endpoint, name, description, handler)`` themselves; an HTML
 landing page listing registered endpoints (:109-131); graceful shutdown with
-a 5 s bound (:158-165). TLS/basic-auth web-config (exporter-toolkit) is
-supported via optional cert/key paths.
+a 5 s bound (:158-165). TLS and basic auth mirror the reference's
+exporter-toolkit web config (``server.go:136-156``): cert/key paths plus an
+authenticator from ``kepler_tpu.server.webconfig``.
 
 Handlers return ``(status, headers, body_bytes)`` — kept framework-free so
 tests can call them directly.
@@ -45,10 +46,12 @@ class APIServer:
         listen_addresses: list[str] | None = None,
         tls_cert: str = "",
         tls_key: str = "",
+        basic_auth_check: Callable[[str | None], bool] | None = None,
     ) -> None:
         self._addresses = listen_addresses or [":28282"]
         self._tls_cert = tls_cert
         self._tls_key = tls_key
+        self._auth_check = basic_auth_check
         self._endpoints: dict[str, Endpoint] = {}
         self._servers: list[ThreadingHTTPServer] = []
         self._threads: list[threading.Thread] = []
@@ -74,6 +77,17 @@ class APIServer:
                 log.debug("http: " + fmt, *args)
 
             def _dispatch(self):
+                if outer._auth_check is not None and not outer._auth_check(
+                        self.headers.get("Authorization")):
+                    # body (if any) was never read — drop the connection so
+                    # keep-alive can't desync
+                    self.close_connection = True
+                    self._respond(
+                        401,
+                        {"Content-Type": "text/plain",
+                         "WWW-Authenticate": 'Basic realm="kepler-tpu"'},
+                        b"unauthorized\n")
+                    return
                 path = self.path.split("?", 1)[0]
                 endpoint = outer._match(path)
                 try:

@@ -1,0 +1,153 @@
+"""Web config file: TLS + basic auth for the API server.
+
+Reference parity: ``internal/server/server.go:136-156`` hands the listener
+to ``prometheus/exporter-toolkit`` when ``web.config-file`` is set. This
+module reads the same file format (the exporter-toolkit subset that the
+reference's ``server_tls_test.go`` exercises):
+
+.. code-block:: yaml
+
+    tls_server_config:
+      cert_file: /path/server.crt
+      key_file: /path/server.key
+    basic_auth_users:
+      alice: $2y$10$...       # bcrypt (needs the optional bcrypt module)
+      bob: $5$rounds=...      # or crypt(3) sha256/sha512 from stdlib
+
+Password hashes: exporter-toolkit mandates bcrypt; that module is optional
+here, so crypt(3) ``$5$``/``$6$`` hashes (``python -c "import crypt;
+print(crypt.crypt('pw', crypt.mksalt(crypt.METHOD_SHA512)))"``) are
+accepted as the always-available alternative.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hmac
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Mapping
+
+import yaml
+
+log = logging.getLogger("kepler.server")
+
+
+@dataclass
+class WebConfigFile:
+    cert_file: str = ""
+    key_file: str = ""
+    basic_auth_users: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def has_tls(self) -> bool:
+        return bool(self.cert_file and self.key_file)
+
+
+def load_web_config(path: str) -> WebConfigFile:
+    """Parse + validate a web config file (exporter-toolkit subset)."""
+    with open(path, encoding="utf-8") as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, Mapping):
+        raise ValueError(f"web config {path!r}: root must be a mapping")
+    known = {"tls_server_config", "basic_auth_users"}
+    unknown = set(data) - known
+    if unknown:
+        raise ValueError(f"web config {path!r}: unknown keys {sorted(unknown)}"
+                         f" (supported: {sorted(known)})")
+    tls = data.get("tls_server_config") or {}
+    if not isinstance(tls, Mapping):
+        raise ValueError("tls_server_config must be a mapping")
+    cert = str(tls.get("cert_file", "") or "")
+    key = str(tls.get("key_file", "") or "")
+    if bool(cert) != bool(key):
+        raise ValueError("tls_server_config needs both cert_file and key_file")
+    users = data.get("basic_auth_users") or {}
+    if not isinstance(users, Mapping):
+        raise ValueError("basic_auth_users must be a mapping")
+    users = {str(u): str(h) for u, h in users.items()}
+    for user, h in users.items():
+        _verify_hash_supported(user, h)
+    return WebConfigFile(cert_file=cert, key_file=key,
+                         basic_auth_users=users)
+
+
+def _verify_hash_supported(user: str, h: str) -> None:
+    if h.startswith(("$2a$", "$2b$", "$2y$")):
+        try:
+            import bcrypt  # noqa: F401
+        except ImportError:
+            raise ValueError(
+                f"basic_auth_users[{user!r}]: bcrypt hash but the bcrypt "
+                "module is not installed; use a crypt(3) $5$/$6$ hash "
+                "instead") from None
+        return
+    if h.startswith(("$5$", "$6$")):
+        try:
+            import crypt  # noqa: F401
+        except ImportError:
+            raise ValueError(
+                f"basic_auth_users[{user!r}]: crypt(3) hash but the crypt "
+                "module is unavailable (removed in Python 3.13); install "
+                "bcrypt and use a $2*$ hash") from None
+        return
+    raise ValueError(
+        f"basic_auth_users[{user!r}]: unsupported hash format "
+        f"{h[:4]!r}… (supported: bcrypt $2*$, crypt(3) $5$/$6$)")
+
+
+def _check_password(password: str, hashed: str) -> bool:
+    if hashed.startswith(("$2a$", "$2b$", "$2y$")):
+        import bcrypt
+
+        return bcrypt.checkpw(password.encode(), hashed.encode())
+    import crypt  # deprecated but present through 3.12; gated by load-time
+
+    return hmac.compare_digest(crypt.crypt(password, hashed), hashed)
+
+
+def make_authenticator(users: Mapping[str, str]
+                       ) -> Callable[[str | None], bool] | None:
+    """→ fn(Authorization header) -> allowed, or None when auth is off."""
+    if not users:
+        return None
+
+    def check(header: str | None) -> bool:
+        if not header or not header.startswith("Basic "):
+            return False
+        try:
+            raw = base64.b64decode(header[6:], validate=True).decode()
+            user, _, password = raw.partition(":")
+        except (binascii.Error, UnicodeDecodeError):
+            return False
+        hashed = users.get(user)
+        try:
+            if hashed is None:
+                # burn the same work as a real verify (one of the configured
+                # hashes, same scheme/cost) so a timing probe can't
+                # enumerate usernames
+                _check_password(password, next(iter(users.values())))
+                return False
+            return _check_password(password, hashed)
+        except Exception:
+            log.exception("basic-auth check failed for user %r", user)
+            return False
+
+    return check
+
+
+def make_api_server(listen_addresses: list[str], config_file: str = ""):
+    """API server honouring a web config file (TLS + basic auth) —
+    reference ``server.go:136-156`` via exporter-toolkit. Shared by the
+    node-agent and aggregator entry points."""
+    from kepler_tpu.server.http import APIServer
+
+    web = load_web_config(config_file) if config_file else None
+    return APIServer(
+        listen_addresses=listen_addresses,
+        tls_cert=web.cert_file if web else "",
+        tls_key=web.key_file if web else "",
+        basic_auth_check=(make_authenticator(web.basic_auth_users)
+                          if web else None),
+    )

@@ -335,6 +335,50 @@ class TestAgent:
         # workload kinds survive the wire
         assert [w["kind"] for w in res["workloads"]] == [0, 1]
 
+    def test_agent_authenticates_to_protected_aggregator(self):
+        # aggregator behind web-config basic auth: creds ride in the
+        # endpoint URL userinfo (kepler_tpu/server/webconfig.py)
+        import base64
+        import crypt
+        import http.client
+
+        from kepler_tpu.server.webconfig import make_authenticator
+
+        hashed = crypt.crypt("pw", crypt.mksalt(crypt.METHOD_SHA256))
+        s = APIServer(listen_addresses=["127.0.0.1:0"],
+                      basic_auth_check=make_authenticator({"agent": hashed}))
+        s.init()
+        ctx = CancelContext()
+        import threading
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            agg = Aggregator(s, model_mode=None, node_bucket=8,
+                             workload_bucket=16)
+            agg.init()
+            monitor = FakeMeterMonitor()
+            host, port = s.addresses[0]
+            # without credentials: 401 surfaces as HTTPException
+            bare = FleetAgent(monitor, endpoint=f"{host}:{port}",
+                              node_name="n1")
+            bare.init()
+            monitor.emit(make_sample())
+            with pytest.raises(http.client.HTTPException, match="401"):
+                bare._send(bare._queue.popleft())
+            # with credentials in the URL: accepted
+            authed = FleetAgent(monitor,
+                                endpoint=f"http://agent:pw@{host}:{port}",
+                                node_name="n1")
+            assert authed._auth_header == "Basic " + base64.b64encode(
+                b"agent:pw").decode()
+            authed.init()
+            monitor.emit(make_sample())
+            authed._send(authed._queue.popleft())
+            assert agg.aggregate_once() is not None
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
     def test_agent_survives_down_aggregator(self):
         monitor = FakeMeterMonitor()
         agent = FleetAgent(monitor, endpoint="127.0.0.1:9",  # discard port
