@@ -200,7 +200,7 @@ class AggregatorConfig:
     interval: float = 5.0
     stale_after: float = 15.0
     # learned estimator for non-RAPL nodes: "" = ratio-only, else
-    # "linear"/"mlp"; params_path = .npz from models.estimator.save_params
+    # "linear"/"mlp"/"moe"; params_path = .npz from models.estimator.save_params
     model: str = "mlp"
     params_path: str = ""
     # node-agent side: report as a model-estimated node (no trustworthy
@@ -260,7 +260,7 @@ class Config:
         if self.tpu.fleet_backend not in ("einsum", "pallas"):
             errs.append(
                 f"invalid tpu.fleetBackend: {self.tpu.fleet_backend!r}")
-        if self.aggregator.model not in ("", "linear", "mlp"):
+        if self.aggregator.model not in ("", "linear", "mlp", "moe"):
             errs.append(f"invalid aggregator.model: {self.aggregator.model!r}")
         if self.aggregator.node_mode not in ("ratio", "model"):
             errs.append(
@@ -403,7 +403,7 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.tls-skip-verify", dest="aggregator_tls_skip_verify",
         default=None, action=argparse.BooleanOptionalAction)
     add("--aggregator.model", dest="aggregator_model", default=None,
-        choices=["", "linear", "mlp"])
+        choices=["", "linear", "mlp", "moe"])
     add("--aggregator.params-path", dest="aggregator_params_path",
         default=None)
     add("--aggregator.node-mode", dest="aggregator_node_mode", default=None,

@@ -60,7 +60,6 @@ class FleetAgent:
         self._host, self._port = u.hostname, u.port
         self._path = (u.path.rstrip("/") or "") + "/v1/report"
         self._tls = u.scheme == "https"
-        self._tls_skip_verify = tls_skip_verify
         # aggregator behind basic auth (webconfig.py): credentials ride in
         # the endpoint URL userinfo — https://user:pw@agg:28283
         self._auth_header = ""
@@ -69,6 +68,19 @@ class FleetAgent:
                     f"{urllib.parse.unquote(u.password or '')}"
             self._auth_header = "Basic " + base64.b64encode(
                 creds.encode()).decode()
+            if not self._tls:
+                log.warning(
+                    "aggregator endpoint has basic-auth credentials but no "
+                    "https:// scheme — the Authorization header will go over "
+                    "the wire in cleartext")
+        # fixed for the agent's lifetime → build the TLS context once, not
+        # per report send
+        self._tls_ctx = None
+        if self._tls:
+            self._tls_ctx = ssl.create_default_context()
+            if tls_skip_verify:
+                self._tls_ctx.check_hostname = False
+                self._tls_ctx.verify_mode = ssl.CERT_NONE
 
     def name(self) -> str:
         return "fleet-agent"
@@ -120,15 +132,9 @@ class FleetAgent:
         self._seq += 1
         body = encode_report(report, list(sample.zone_names), seq=self._seq)
         if self._tls:
-            if self._tls_skip_verify:
-                tls_ctx = ssl.create_default_context()
-                tls_ctx.check_hostname = False
-                tls_ctx.verify_mode = ssl.CERT_NONE
-            else:
-                tls_ctx = ssl.create_default_context()
             conn = http.client.HTTPSConnection(
                 self._host, self._port, timeout=self._timeout,
-                context=tls_ctx)
+                context=self._tls_ctx)
         else:
             conn = http.client.HTTPConnection(self._host, self._port,
                                               timeout=self._timeout)

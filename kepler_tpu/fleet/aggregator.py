@@ -47,6 +47,16 @@ log = logging.getLogger("kepler.fleet.aggregator")
 # body is buffered
 MAX_REPORT_BYTES = 64 << 20
 
+# per-mode checkpoint layout: required keys, and which key's last axis is
+# the zone count Z. Temporal is deliberately absent — it needs history
+# windows the fleet wire format doesn't carry (see models.estimator).
+_REQUIRED_PARAM_KEYS = {
+    "mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
+    "linear": ("weight", "bias"),
+    "moe": ("gate_w", "w0", "b0", "w1", "b1"),
+}
+_OUTPUT_BIAS_KEY = {"mlp": "b2", "linear": "bias", "moe": "b1"}
+
 
 @dataclass
 class _Stored:
@@ -115,6 +125,9 @@ class Aggregator:
         if self._node_bucket % n_dev:
             self._node_bucket = ((self._node_bucket // n_dev) + 1) * n_dev
         if self._model_mode:
+            from kepler_tpu.models.estimator import predictor
+
+            predictor(self._model_mode)  # fail at startup on unservable mode
             self._check_params_shape()
             if self._params is None:
                 log.warning("no trained %s params given; estimates will use "
@@ -284,8 +297,11 @@ class Aggregator:
         """Fail at startup (not first window) on params/model mismatch."""
         if self._params is None:
             return
-        required = {"mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
-                    "linear": ("weight", "bias")}[self._model_mode]
+        required = _REQUIRED_PARAM_KEYS.get(self._model_mode)
+        if required is None:
+            raise ValueError(
+                f"unknown aggregator model {self._model_mode!r}; valid: "
+                f"{', '.join(_REQUIRED_PARAM_KEYS)}")
         missing = [k for k in required if k not in self._params]
         if missing:
             raise ValueError(
@@ -296,11 +312,12 @@ class Aggregator:
     def _model_out_dim(self) -> int | None:
         if self._params is None:
             return None
-        # output bias: "b2" (mlp) / "bias" (linear) — its length is Z
-        for key in ("b2", "bias"):
-            if key in self._params:
-                return int(np.asarray(self._params[key]).shape[-1])
-        return None
+        # the mode's output bias — its LAST axis length is Z (moe's b1 is
+        # [E, Z], so probing by key alone would confuse it with mlp's b1)
+        key = _OUTPUT_BIAS_KEY.get(self._model_mode)
+        if key is None or key not in self._params:
+            return None
+        return int(np.asarray(self._params[key]).shape[-1])
 
     # -- read endpoints ----------------------------------------------------
 

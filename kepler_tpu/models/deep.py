@@ -1,0 +1,112 @@
+"""Deep residual power estimator (the pipeline-parallel model family).
+
+For large heterogeneous fleets a single shallow MLP underfits (the
+kepler-model-server ecosystem answers this with per-type models — see
+`kepler_tpu.models.moe`; this family instead scales **depth**): a stack of
+S identical pre-LN residual GELU blocks between a feature embedding and a
+zone head. Identical blocks are deliberate — uniform stages are what a
+GPipe-style pipeline wants (`kepler_tpu.parallel.pipeline` shards the
+stack's leading S axis over the ``stage`` mesh axis and streams
+microbatches through with ppermute).
+
+Dense evaluation below is the single-chip reference the pipelined program
+must match exactly (`tests/test_pipeline.py`).
+"""
+
+from __future__ import annotations
+
+from typing import TypedDict
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import NUM_FEATURES
+from kepler_tpu.models.nn import glorot, layer_norm
+
+
+class BlockParams(TypedDict):
+    ln_scale: jax.Array  # [S, D]
+    ln_bias: jax.Array  # [S, D]
+    w0: jax.Array  # [S, D, 4D]
+    b0: jax.Array  # [S, 4D]
+    w1: jax.Array  # [S, 4D, D]
+    b1: jax.Array  # [S, D]
+
+
+class DeepParams(TypedDict):
+    in_proj: jax.Array  # [F, D]
+    in_bias: jax.Array  # [D]
+    blocks: BlockParams  # leading S axis = pipeline stages
+    w_head: jax.Array  # [D, Z]
+    b_head: jax.Array  # [Z]
+
+
+def init_deep(
+    key: jax.Array,
+    n_zones: int,
+    n_stages: int = 4,
+    d_model: int = 128,
+    n_features: int = NUM_FEATURES,
+) -> DeepParams:
+    k_in, k0, k1, _ = jax.random.split(key, 4)
+    d4 = 4 * d_model
+    return DeepParams(
+        in_proj=glorot(k_in, (n_features, d_model)),
+        in_bias=jnp.zeros((d_model,), jnp.float32),
+        blocks=BlockParams(
+            ln_scale=jnp.ones((n_stages, d_model), jnp.float32),
+            ln_bias=jnp.zeros((n_stages, d_model), jnp.float32),
+            w0=glorot(k0, (n_stages, d_model, d4)),
+            b0=jnp.zeros((n_stages, d4), jnp.float32),
+            w1=glorot(k1, (n_stages, d4, d_model)),
+            b1=jnp.zeros((n_stages, d_model), jnp.float32),
+        ),
+        w_head=jnp.zeros((d_model, n_zones), jnp.float32),
+        b_head=jnp.zeros((n_zones,), jnp.float32),
+    )
+
+
+def block_fn(block, x: jax.Array,
+             compute_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """One residual block: x [.., D] → [.., D]. ``block`` has NO stage axis —
+    this is the uniform stage function the pipeline applies per device."""
+    y = layer_norm(x, block["ln_scale"], block["ln_bias"])
+    y = y.astype(compute_dtype)
+    y = jax.nn.gelu(y @ block["w0"].astype(compute_dtype)
+                    + block["b0"].astype(compute_dtype))
+    return x + (y @ block["w1"].astype(compute_dtype)).astype(jnp.float32) \
+        + block["b1"]
+
+
+def embed(params: DeepParams, features: jax.Array,
+          compute_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """[.., F] → [.., D] (runs OUTSIDE the pipeline; it is one tiny matmul)."""
+    x = features.astype(compute_dtype) @ params["in_proj"].astype(
+        compute_dtype)
+    return x.astype(jnp.float32) + params["in_bias"]
+
+
+def head(params: DeepParams, x: jax.Array, workload_valid: jax.Array,
+         clamp: bool = True) -> jax.Array:
+    """[.., D] → watts [.., Z] (also outside the pipeline)."""
+    watts = x @ params["w_head"] + params["b_head"]
+    if clamp:
+        watts = jnp.maximum(watts, 0.0)
+    return jnp.where(workload_valid[..., None], watts, 0.0)
+
+
+def predict_deep(
+    params: DeepParams,
+    features: jax.Array,  # f32 [..., W, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    clamp: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Dense single-device reference: scan the block stack in order."""
+    x = embed(params, features, compute_dtype)
+
+    def body(x, block):
+        return block_fn(block, x, compute_dtype), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return head(params, x, workload_valid, clamp)

@@ -6,9 +6,14 @@ star puts ratio + learned models behind one switchable backend
 watts [W, Z]; the ratio backend additionally needs zone deltas.
 
 Modes (BASELINE configs):
-  "ratio"  — RAPL proportional attribution (configs 1-2)
-  "linear" — linear regression from features  (config 3)
-  "mlp"    — MLP from features                (config 4)
+  "ratio"    — RAPL proportional attribution (configs 1-2)
+  "linear"   — linear regression from features  (config 3)
+  "mlp"      — MLP from features                (config 4)
+  "temporal" — causal attention over feature HISTORY windows
+               (features carry an extra trailing time axis [.., W, T, F];
+               see kepler_tpu.models.temporal / kepler_tpu.monitor.history)
+  "moe"      — mixture of per-node-type experts (expert-parallel capable;
+               see kepler_tpu.models.moe)
 Mixed fleets evaluate ratio and model in the same device program and select
 per node (config 5; see ``kepler_tpu.parallel.aggregator``).
 """
@@ -24,19 +29,30 @@ import jax.numpy as jnp
 from kepler_tpu.models.features import build_features
 from kepler_tpu.models.linear import init_linear, predict_linear
 from kepler_tpu.models.mlp import init_mlp, predict_mlp
+from kepler_tpu.models.moe import init_moe, predict_moe
+from kepler_tpu.models.temporal import init_temporal, predict_temporal
 
 RATIO = "ratio"
 LINEAR = "linear"
 MLP = "mlp"
+TEMPORAL = "temporal"
+MOE = "moe"
 
+# registry contract: a predictor is callable as (params, features[.., W, F],
+# workload_valid[.., W]) → watts — single-tick features. TEMPORAL is NOT
+# here: it consumes [.., W, T, F] history windows and must be served via
+# predict_temporal / parallel.make_temporal_program + monitor.HistoryBuffer.
 _PREDICTORS: dict[str, Callable] = {
     LINEAR: predict_linear,
     MLP: predict_mlp,
+    MOE: predict_moe,
 }
 
 _INITIALIZERS: dict[str, Callable] = {
     LINEAR: init_linear,
     MLP: init_mlp,
+    TEMPORAL: init_temporal,
+    MOE: init_moe,
 }
 
 
@@ -55,6 +71,12 @@ def predictor(mode: str) -> Callable | None:
     """→ predict fn for a learned mode; None for RATIO (no model to run)."""
     if mode == RATIO:
         return None
+    if mode == TEMPORAL:
+        raise ValueError(
+            "the temporal estimator needs [.., W, T, F] history windows, "
+            "not single-tick features — serve it via "
+            "models.temporal.predict_temporal (or "
+            "parallel.make_temporal_program) fed by monitor.HistoryBuffer")
     if mode not in _PREDICTORS:
         raise ValueError(f"unknown estimator mode {mode!r}; "
                          f"valid: {RATIO}, {', '.join(_PREDICTORS)}")

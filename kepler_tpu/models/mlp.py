@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from kepler_tpu.models.features import NUM_FEATURES
+from kepler_tpu.models.nn import glorot
 
 
 class MLPParams(TypedDict):
@@ -41,11 +42,6 @@ def init_mlp(
     n_features: int = NUM_FEATURES,
 ) -> MLPParams:
     k0, k1, k2 = jax.random.split(key, 3)
-
-    def glorot(k, shape):
-        scale = jnp.sqrt(2.0 / (shape[0] + shape[1]))
-        return jax.random.normal(k, shape, jnp.float32) * scale
-
     return MLPParams(
         w0=glorot(k0, (n_features, hidden)),
         b0=jnp.zeros((hidden,), jnp.float32),

@@ -1,0 +1,108 @@
+"""Mixture-of-experts power estimator (one expert per node type).
+
+The reference ecosystem's kepler-model-server publishes a *different*
+trained model per platform (machine spec / CPU family) and each node
+downloads its own. A heterogeneous fleet evaluated centrally therefore
+needs per-node-type models inside ONE device program — which is exactly a
+mixture of experts: expert ``e`` is the power model for node type ``e``,
+and routing is either explicit (the aggregator knows each node's type) or
+learned from the feature vector (softmax gate) when the type is unknown.
+
+Each expert is a small ``F → H → Z`` GELU MLP; expert weights stack on a
+leading ``E`` axis so the whole mixture is three batched einsums on the
+MXU. Dense evaluation (every expert on every row, gate-weighted) is the
+single-chip serving path; `kepler_tpu.parallel.expert` shards the ``E``
+axis over devices and dispatches rows with ``all_to_all`` — real expert
+parallelism for many/large experts.
+"""
+
+from __future__ import annotations
+
+from typing import TypedDict
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import NUM_FEATURES
+from kepler_tpu.models.nn import glorot
+
+
+class MoEParams(TypedDict):
+    gate_w: jax.Array  # [F, E] learned router (used when no explicit type)
+    w0: jax.Array  # [E, F, H]
+    b0: jax.Array  # [E, H]
+    w1: jax.Array  # [E, H, Z]
+    b1: jax.Array  # [E, Z]
+
+
+def init_moe(
+    key: jax.Array,
+    n_zones: int,
+    n_experts: int = 8,
+    hidden: int = 128,
+    n_features: int = NUM_FEATURES,
+) -> MoEParams:
+    kg, k0, k1 = jax.random.split(key, 3)
+    return MoEParams(
+        gate_w=glorot(kg, (n_features, n_experts)),
+        w0=glorot(k0, (n_experts, n_features, hidden)),
+        b0=jnp.zeros((n_experts, hidden), jnp.float32),
+        w1=glorot(k1, (n_experts, hidden, n_zones)),
+        b1=jnp.zeros((n_experts, n_zones), jnp.float32),
+    )
+
+
+def expert_forward(
+    params: MoEParams,
+    x: jax.Array,  # [E, C, F] rows already grouped per expert
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Batched per-expert MLP → f32 [E, C, Z]. Shared by dense and EP paths."""
+    cd = compute_dtype
+    h = jax.nn.gelu(
+        jnp.einsum("ecf,efh->ech", x.astype(cd), params["w0"].astype(cd),
+                   preferred_element_type=jnp.float32)
+        + params["b0"][:, None, :])
+    return (
+        jnp.einsum("ech,ehz->ecz", h.astype(cd), params["w1"].astype(cd),
+                   preferred_element_type=jnp.float32)
+        + params["b1"][:, None, :])
+
+
+def gate_logits(params: MoEParams, features: jax.Array) -> jax.Array:
+    """[..., F] → router logits [..., E] (f32 — routing wants full precision)."""
+    return features.astype(jnp.float32) @ params["gate_w"]
+
+
+def predict_moe(
+    params: MoEParams,
+    features: jax.Array,  # f32 [..., W, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    clamp: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    expert_id: jax.Array | None = None,  # int32 [...] explicit node type
+) -> jax.Array:
+    """Dense MoE → watts f32 [..., W, Z].
+
+    With ``expert_id`` (the aggregator's per-node type column) routing is a
+    hard one-hot; otherwise the learned gate soft-mixes experts. Dense =
+    every expert runs on every row; the ``E``-fold FLOP cost is fine on one
+    chip (experts are tiny) and is what the EP path's output must match.
+    """
+    lead = features.shape[:-1]
+    x = features.reshape(1, -1, features.shape[-1])  # [1, N, F]
+    e = params["w0"].shape[0]
+    per_expert = expert_forward(
+        params, jnp.broadcast_to(x, (e, *x.shape[1:])), compute_dtype)
+    if expert_id is not None:
+        wl = features.ndim - expert_id.ndim - 1  # workload axes to broadcast
+        gates = jax.nn.one_hot(expert_id.reshape(*expert_id.shape,
+                                                 *([1] * wl)), e)
+        gates = jnp.broadcast_to(gates, (*lead, e))
+    else:
+        gates = jax.nn.softmax(gate_logits(params, features), axis=-1)
+    watts = jnp.einsum("enz,ne->nz", per_expert, gates.reshape(-1, e))
+    watts = watts.reshape(*lead, -1)
+    if clamp:
+        watts = jnp.maximum(watts, 0.0)
+    return jnp.where(workload_valid[..., None], watts, 0.0)

@@ -1,0 +1,25 @@
+"""Shared NN building blocks for the estimator families.
+
+One definition of weight init and layer norm so the families (mlp, moe,
+temporal, deep) can't drift apart on fan conventions or epsilons.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LN_EPS = 1e-6
+
+
+def glorot(key: jax.Array, shape: tuple[int, ...]) -> jax.Array:
+    """Glorot-normal over the LAST two dims (leading dims = stacked experts
+    or stages, which share the per-matrix fan)."""
+    scale = jnp.sqrt(2.0 / (shape[-2] + shape[-1]))
+    return jax.random.normal(key, shape, jnp.float32) * scale
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array) -> jax.Array:
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + LN_EPS) * scale + bias

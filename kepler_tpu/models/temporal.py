@@ -1,0 +1,169 @@
+"""Temporal power estimator: causal attention over feature history.
+
+The reference attributes power from the *last* tick's deltas only
+(`internal/monitor/process.go:123-145` — a single ratio per window). A
+single tick is noisy: procfs sampling jitter and RAPL wraparound leave
+per-window spikes that Prometheus rate() can only smooth after the fact.
+This estimator instead conditions on a **history window** of the last T
+ticks per workload (`kepler_tpu.monitor.history` maintains the window) and
+predicts the current-tick watts from the whole trajectory — the learned
+analog of a cross-tick smoother, and the subsystem that introduces the
+sequence axis (SURVEY §5: "if per-workload feature history windows are
+added … a time axis appears").
+
+Architecture (shaped for the MXU — all dims lane-width multiples):
+
+    [.., T, F] → in-proj F→D → +learned positional embedding
+               → pre-LN causal self-attention (H heads) + residual
+               → pre-LN GELU MLP (D→4D→D) + residual
+               → LN → head D→Z on the LAST timestep → watts [.., Z]
+
+Short windows (serving default, T≤128) evaluate dense attention on one
+chip; long windows shard T over the ``seq`` mesh axis and run ring
+attention (`kepler_tpu.parallel.ring`) — same maths, verified equivalent
+in tests/test_ring.py.
+"""
+
+from __future__ import annotations
+
+from typing import TypedDict
+
+import jax
+import jax.numpy as jnp
+
+from kepler_tpu.models.features import NUM_FEATURES
+from kepler_tpu.models.nn import glorot, layer_norm
+from kepler_tpu.ops.attention import full_attention
+
+
+class TemporalParams(TypedDict):
+    in_proj: jax.Array  # [F, D]
+    pos_emb: jax.Array  # [T_max, D]
+    ln1_scale: jax.Array  # [D]
+    ln1_bias: jax.Array  # [D]
+    wq: jax.Array  # [D, D]
+    wk: jax.Array  # [D, D]
+    wv: jax.Array  # [D, D]
+    wo: jax.Array  # [D, D]
+    ln2_scale: jax.Array  # [D]
+    ln2_bias: jax.Array  # [D]
+    w_mlp0: jax.Array  # [D, 4D]
+    b_mlp0: jax.Array  # [4D]
+    w_mlp1: jax.Array  # [4D, D]
+    b_mlp1: jax.Array  # [D]
+    ln_f_scale: jax.Array  # [D]
+    ln_f_bias: jax.Array  # [D]
+    w_head: jax.Array  # [D, Z]
+    b_head: jax.Array  # [Z]
+
+
+N_HEADS = 4
+
+
+def init_temporal(
+    key: jax.Array,
+    n_zones: int,
+    d_model: int = 128,
+    t_max: int = 128,
+    n_features: int = NUM_FEATURES,
+) -> TemporalParams:
+    ks = jax.random.split(key, 8)
+    d4 = 4 * d_model
+    return TemporalParams(
+        in_proj=glorot(ks[0], (n_features, d_model)),
+        pos_emb=jax.random.normal(ks[1], (t_max, d_model), jnp.float32) * 0.02,
+        ln1_scale=jnp.ones((d_model,), jnp.float32),
+        ln1_bias=jnp.zeros((d_model,), jnp.float32),
+        wq=glorot(ks[2], (d_model, d_model)),
+        wk=glorot(ks[3], (d_model, d_model)),
+        wv=glorot(ks[4], (d_model, d_model)),
+        wo=glorot(ks[5], (d_model, d_model)),
+        ln2_scale=jnp.ones((d_model,), jnp.float32),
+        ln2_bias=jnp.zeros((d_model,), jnp.float32),
+        w_mlp0=glorot(ks[6], (d_model, d4)),
+        b_mlp0=jnp.zeros((d4,), jnp.float32),
+        w_mlp1=glorot(ks[7], (d4, d_model)),
+        b_mlp1=jnp.zeros((d_model,), jnp.float32),
+        ln_f_scale=jnp.ones((d_model,), jnp.float32),
+        ln_f_bias=jnp.zeros((d_model,), jnp.float32),
+        w_head=jnp.zeros((d_model, n_zones), jnp.float32),
+        b_head=jnp.zeros((n_zones,), jnp.float32),
+    )
+
+
+def temporal_trunk(
+    params: TemporalParams,
+    feat_hist: jax.Array,  # f32 [B, T, F]
+    t_valid: jax.Array,  # bool [B, T]
+    attention_fn=None,  # (q, k, v, t_valid) → out; default dense causal
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Shared trunk → hidden states f32 [B, T, D].
+
+    ``attention_fn`` is the seam where ring attention plugs in: the
+    sequence-parallel program passes the shard-mapped ring kernel, serving
+    passes nothing and gets dense causal attention.
+    """
+    b, t, _ = feat_hist.shape
+    d = params["in_proj"].shape[1]
+    h = N_HEADS
+    cd = compute_dtype
+
+    x = feat_hist.astype(cd) @ params["in_proj"].astype(cd)
+    x = x.astype(jnp.float32) + params["pos_emb"][:t]
+    x = jnp.where(t_valid[..., None], x, 0.0)
+
+    # -- attention block (pre-LN, residual) --------------------------------
+    y = layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+    y16 = y.astype(cd)
+    q = (y16 @ params["wq"].astype(cd)).reshape(b, t, h, d // h)
+    k = (y16 @ params["wk"].astype(cd)).reshape(b, t, h, d // h)
+    v = (y16 @ params["wv"].astype(cd)).reshape(b, t, h, d // h)
+    if attention_fn is None:
+        attn = full_attention(q, k, v, causal=True, t_valid=t_valid,
+                              compute_dtype=cd)
+    else:
+        attn = attention_fn(q, k, v, t_valid)
+    attn = attn.reshape(b, t, d)
+    x = x + (attn.astype(cd) @ params["wo"].astype(cd)).astype(jnp.float32)
+
+    # -- MLP block ---------------------------------------------------------
+    y = layer_norm(x, params["ln2_scale"], params["ln2_bias"]).astype(cd)
+    y = jax.nn.gelu(y @ params["w_mlp0"].astype(cd)
+                    + params["b_mlp0"].astype(cd))
+    x = x + (y @ params["w_mlp1"].astype(cd)).astype(jnp.float32) \
+        + params["b_mlp1"]
+
+    return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+
+
+def predict_temporal(
+    params: TemporalParams,
+    feat_hist: jax.Array,  # f32 [..., W, T, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    t_valid: jax.Array | None = None,  # bool [..., W, T]
+    clamp: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    attention_fn=None,  # override for sequence-parallel ring attention
+) -> jax.Array:
+    """→ watts f32 [..., W, Z] predicted from each workload's history.
+
+    Leading axes flatten into the attention batch; the LAST valid timestep's
+    hidden state feeds the head (ragged histories right-pad, so that is the
+    last ``t_valid`` position, falling back to position 0 when empty).
+    """
+    lead = feat_hist.shape[:-2]
+    t, f = feat_hist.shape[-2:]
+    x = feat_hist.reshape(-1, t, f)
+    tv = (jnp.ones(x.shape[:2], bool) if t_valid is None
+          else t_valid.reshape(-1, t))
+    hidden = temporal_trunk(params, x, tv, attention_fn=attention_fn,
+                            compute_dtype=compute_dtype)
+    last = jnp.maximum(jnp.sum(tv, axis=-1) - 1, 0)  # index of last tick
+    pooled = jnp.take_along_axis(
+        hidden, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    watts = pooled @ params["w_head"] + params["b_head"]
+    watts = watts.reshape(*lead, -1)
+    if clamp:
+        watts = jnp.maximum(watts, 0.0)
+    return jnp.where(workload_valid[..., None], watts, 0.0)

@@ -1,0 +1,87 @@
+"""Blockwise attention primitives (flash-style online softmax).
+
+Pure jnp — no mesh/model dependencies, so both the models layer (dense
+serving attention in `kepler_tpu.models.temporal`) and the parallel layer
+(ring attention in `kepler_tpu.parallel.ring`) build on it without import
+cycles. The online-softmax merge is what makes attention computable one
+KV block at a time:
+
+    m_new = max(m, rowmax(scores))
+    o     = o * e^(m - m_new) + e^(scores - m_new) @ V
+    l     = l * e^(m - m_new) + rowsum(e^(scores - m_new))
+
+Matmuls run in the caller's compute dtype (bf16 on TPU → MXU); softmax
+statistics stay f32.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30  # large-but-finite: keeps exp() exactly 0 without NaN risk
+
+
+def block_attn(q, k, v, mask, scale, compute_dtype):
+    """Scores for one (q-block, kv-block) pair → (p @ v, rowmax, rowsum).
+
+    q [B, Tq, H, D] × k [B, Tk, H, D] → scores [B, H, Tq, Tk]; f32 softmax
+    statistics regardless of the matmul dtype.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(compute_dtype),
+        k.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B, H, Tq]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask, p, 0.0)  # fully-masked rows: force exact 0
+    l = jnp.sum(p, axis=-1)  # noqa: E741  [B, H, Tq]
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        p.astype(compute_dtype),
+        v.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return pv, m, l
+
+
+def merge_blocks(o, m, l, pv, m_blk, l_blk):  # noqa: E741
+    """Fold one block's partials into the running online-softmax state."""
+    m_new = jnp.maximum(m, m_blk)
+    corr_old = jnp.exp(m - m_new)
+    corr_blk = jnp.exp(m_blk - m_new)
+    o = o * stats_to_out(corr_old) + pv * stats_to_out(corr_blk)
+    l_new = l * corr_old + l_blk * corr_blk
+    return o, m_new, l_new
+
+
+def stats_to_out(x):
+    """[B, H, Tq] softmax stats → [B, Tq, H, 1] for scaling o."""
+    return jnp.moveaxis(x, -2, -1)[..., None]
+
+
+def full_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    t_valid: jax.Array | None = None,  # bool [B, T] keys to attend to
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Dense single-device attention; also the serving path for short T."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    tq, tk = q.shape[1], k.shape[1]
+    mask = jnp.ones((1, 1, tq, tk), bool)
+    if causal:
+        mask = mask & (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+    if t_valid is not None:
+        mask = mask & t_valid[:, None, None, :]
+    pv, m, l = block_attn(q, k, v, mask, scale, compute_dtype)  # noqa: E741
+    l_safe = jnp.maximum(l, 1e-30)
+    return (pv / stats_to_out(l_safe)).astype(q.dtype)

@@ -13,7 +13,23 @@ from kepler_tpu.parallel.fleet import (
     NodeReport,
     assemble_fleet_batch,
 )
+from kepler_tpu.parallel.expert import (
+    EXPERT_AXIS,
+    make_expert_parallel_moe,
+    top1_route,
+)
 from kepler_tpu.parallel.mesh import MODEL_AXIS, NODE_AXIS, make_mesh
+from kepler_tpu.parallel.pipeline import (
+    STAGE_AXIS,
+    make_pipeline,
+    make_pipelined_deep,
+)
+from kepler_tpu.parallel.ring import (
+    SEQ_AXIS,
+    full_attention,
+    make_ring_attention,
+)
+from kepler_tpu.parallel.sequence import make_temporal_program
 from kepler_tpu.parallel.trainer import (
     make_distributed_train_step,
     mlp_param_shardings,
@@ -21,6 +37,16 @@ from kepler_tpu.parallel.trainer import (
 )
 
 __all__ = [
+    "EXPERT_AXIS",
+    "SEQ_AXIS",
+    "STAGE_AXIS",
+    "full_attention",
+    "make_expert_parallel_moe",
+    "make_pipeline",
+    "make_pipelined_deep",
+    "make_ring_attention",
+    "make_temporal_program",
+    "top1_route",
     "FleetBatch",
     "FleetResult",
     "MODE_MODEL",

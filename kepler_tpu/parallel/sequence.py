@@ -1,0 +1,50 @@
+"""Sequence/context parallelism for the temporal estimator.
+
+Long feature-history windows (`kepler_tpu.models.temporal`) shard their
+time axis over the ``seq`` mesh axis: the pointwise trunk ops (in-proj,
+LayerNorms, MLP, head) are per-timestep and shard trivially via GSPMD
+sharding annotations, while attention — the only cross-timestep op —
+runs as the shard-mapped ring kernel (`kepler_tpu.parallel.ring`), so no
+device ever holds the full K/V sequence. The last-valid-timestep pooling
+gathers one row per workload across shards, which XLA lowers to a tiny
+collective.
+
+`tests/test_ring.py` asserts this program matches single-device dense
+attention on an 8-way virtual mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kepler_tpu.models.temporal import TemporalParams, predict_temporal
+from kepler_tpu.parallel.ring import SEQ_AXIS, ring_attention_shardmap
+
+
+def make_temporal_program(
+    mesh: Mesh,
+    *,
+    axis_name: str = SEQ_AXIS,
+    clamp: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """→ jitted ``(params, feat_hist[W,T,F], workload_valid[W], t_valid[W,T])
+    → watts [W,Z]`` with T sharded over ``axis_name``.
+
+    T must divide by the mesh's ``axis_name`` size. Params replicate (the
+    model is tiny; memory pressure lives in the sequence, not the weights).
+    """
+    hist = NamedSharding(mesh, P(None, axis_name))
+    rep = NamedSharding(mesh, P())
+    ring = ring_attention_shardmap(mesh, axis_name=axis_name, causal=True,
+                                   compute_dtype=compute_dtype)
+
+    def fn(params: TemporalParams, feat_hist, workload_valid, t_valid):
+        return predict_temporal(params, feat_hist, workload_valid, t_valid,
+                                clamp=clamp, compute_dtype=compute_dtype,
+                                attention_fn=ring)
+
+    return jax.jit(fn, in_shardings=(rep, hist, rep, hist),
+                   out_shardings=rep)
