@@ -56,6 +56,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         node_bucket=cfg.tpu.node_bucket,
         workload_bucket=cfg.tpu.workload_bucket,
         backend=cfg.tpu.fleet_backend,
+        history_window=cfg.aggregator.history_window,
     )
     services: list = [server, aggregator]
 

@@ -200,9 +200,13 @@ class AggregatorConfig:
     interval: float = 5.0
     stale_after: float = 15.0
     # learned estimator for non-RAPL nodes: "" = ratio-only, else
-    # "linear"/"mlp"/"moe"; params_path = .npz from models.estimator.save_params
+    # "linear"/"mlp"/"moe"/"temporal"; params_path = .npz from
+    # models.estimator.save_params
     model: str = "mlp"
     params_path: str = ""
+    # temporal mode: ticks of per-workload feature history the aggregator
+    # accretes per node (the model's attention window)
+    history_window: int = 16
     # node-agent side: report as a model-estimated node (no trustworthy
     # RAPL — e.g. a VM guest); the aggregator then uses the estimator
     node_mode: str = "ratio"  # ratio | model
@@ -260,7 +264,10 @@ class Config:
         if self.tpu.fleet_backend not in ("einsum", "pallas"):
             errs.append(
                 f"invalid tpu.fleetBackend: {self.tpu.fleet_backend!r}")
-        if self.aggregator.model not in ("", "linear", "mlp", "moe"):
+        if self.aggregator.history_window < 1:
+            errs.append("aggregator.historyWindow must be >= 1")
+        if self.aggregator.model not in ("", "linear", "mlp", "moe",
+                                         "temporal"):
             errs.append(f"invalid aggregator.model: {self.aggregator.model!r}")
         if self.aggregator.node_mode not in ("ratio", "model"):
             errs.append(
@@ -296,6 +303,7 @@ _YAML_KEYS: dict[str, str] = {
     "meshAxes": "mesh_axes",
     "fleetBackend": "fleet_backend",
     "fleet-backend": "fleet_backend",
+    "historyWindow": "history_window",
 }
 
 _DURATION_FIELDS = {"interval", "staleness", "stale_after"}
@@ -403,7 +411,7 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.tls-skip-verify", dest="aggregator_tls_skip_verify",
         default=None, action=argparse.BooleanOptionalAction)
     add("--aggregator.model", dest="aggregator_model", default=None,
-        choices=["", "linear", "mlp", "moe"])
+        choices=["", "linear", "mlp", "moe", "temporal"])
     add("--aggregator.params-path", dest="aggregator_params_path",
         default=None)
     add("--aggregator.node-mode", dest="aggregator_node_mode", default=None,

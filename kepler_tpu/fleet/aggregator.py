@@ -30,12 +30,15 @@ from typing import Mapping
 import numpy as np
 
 from kepler_tpu.fleet.wire import WireError, decode_report
+from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.parallel.aggregator_core import (
     FleetResult,
     make_fleet_program,
+    make_temporal_fleet_program,
     run_fleet_attribution,
 )
-from kepler_tpu.parallel.fleet import NodeReport, assemble_fleet_batch
+from kepler_tpu.parallel.fleet import (MODE_MODEL, NodeReport,
+                                       assemble_fleet_batch)
 from kepler_tpu.parallel.mesh import make_mesh
 from kepler_tpu.server.http import APIServer
 from kepler_tpu.service.lifecycle import CancelContext
@@ -48,14 +51,18 @@ log = logging.getLogger("kepler.fleet.aggregator")
 MAX_REPORT_BYTES = 64 << 20
 
 # per-mode checkpoint layout: required keys, and which key's last axis is
-# the zone count Z. Temporal is deliberately absent — it needs history
-# windows the fleet wire format doesn't carry (see models.estimator).
+# the zone count Z. Temporal params serve through the dedicated history
+# program (make_temporal_fleet_program), not the single-tick predictor
+# registry — the aggregator accretes each workload's window itself.
 _REQUIRED_PARAM_KEYS = {
     "mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
     "linear": ("weight", "bias"),
     "moe": ("gate_w", "w0", "b0", "w1", "b1"),
+    "temporal": ("in_proj", "pos_emb", "wq", "wk", "wv", "wo",
+                 "w_mlp0", "w_mlp1", "w_head", "b_head"),
 }
-_OUTPUT_BIAS_KEY = {"mlp": "b2", "linear": "bias", "moe": "b1"}
+_OUTPUT_BIAS_KEY = {"mlp": "b2", "linear": "bias", "moe": "b1",
+                    "temporal": "b_head"}
 
 
 @dataclass
@@ -79,6 +86,7 @@ class Aggregator:
         node_bucket: int = 8,
         workload_bucket: int = 256,
         backend: str = "einsum",
+        history_window: int = 16,
         clock=None,
         mesh=None,
     ) -> None:
@@ -92,6 +100,10 @@ class Aggregator:
         self._backend = backend
         self._clock = clock or _time.time
         self._mesh = mesh
+        # temporal mode: per-node feature-history ring buffers, fed on
+        # report receipt so the window advances at each node's own cadence
+        self._history_window = history_window
+        self._history: dict[str, "HistoryBuffer"] = {}
 
         self._lock = threading.Lock()
         self._reports: dict[str, _Stored] = {}
@@ -125,9 +137,12 @@ class Aggregator:
         if self._node_bucket % n_dev:
             self._node_bucket = ((self._node_bucket // n_dev) + 1) * n_dev
         if self._model_mode:
-            from kepler_tpu.models.estimator import predictor
+            if self._model_mode != "temporal":
+                from kepler_tpu.models.estimator import predictor
 
-            predictor(self._model_mode)  # fail at startup on unservable mode
+                # fail at startup on unservable mode; temporal serves via
+                # its dedicated history program instead of the registry
+                predictor(self._model_mode)
             self._check_params_shape()
             if self._params is None:
                 log.warning("no trained %s params given; estimates will use "
@@ -174,8 +189,35 @@ class Aggregator:
             # reordering within one agent run
             if prev is None or stored.seq >= prev.seq or stored.seq == 1:
                 self._reports[report.node_name] = stored
+                # history push is NOT idempotent (a dup would shift the
+                # window) → require a seq CHANGE, not >=; and ratio nodes'
+                # estimator output is always discarded, so skip their
+                # windows entirely
+                if (self._model_mode == "temporal"
+                        and report.mode == MODE_MODEL
+                        and (prev is None or stored.seq != prev.seq)):
+                    self._push_history(report)
             self._stats["reports_total"] += 1
         return 204, {}, b""
+
+    def _push_history(self, report: NodeReport) -> None:
+        """Advance the node's feature-history window (temporal mode; caller
+        holds the lock). The window accretes at the node's report cadence."""
+        from kepler_tpu.resource.informer import FeatureBatch
+
+        buf = self._history.get(report.node_name)
+        if buf is None:
+            buf = HistoryBuffer(window=self._history_window)
+            self._history[report.node_name] = buf
+        kinds = (report.workload_kinds if report.workload_kinds is not None
+                 else np.zeros(len(report.workload_ids), np.int8))
+        buf.push(FeatureBatch(
+            kinds=kinds,
+            ids=list(report.workload_ids),
+            cpu_deltas=np.asarray(report.cpu_deltas, np.float32),
+            node_cpu_delta=float(report.node_cpu_delta),
+            usage_ratio=float(report.usage_ratio),
+        ), dt_s=float(report.dt_s))
 
     # -- aggregation -------------------------------------------------------
 
@@ -186,6 +228,8 @@ class Aggregator:
             live = {name: s for name, s in self._reports.items()
                     if now - s.received <= self._stale_after}
             self._reports = dict(live)
+            for name in [n for n in self._history if n not in live]:
+                del self._history[name]
         if not live:
             return None
         # canonical zone axis = sorted union of reported zone names; nodes
@@ -213,13 +257,22 @@ class Aggregator:
             aligned, n_zones=n_zones, node_bucket=self._node_bucket,
             workload_bucket=self._workload_bucket)
         if self._program is None:
-            self._program = make_fleet_program(self._mesh,
-                                               model_mode=self._model_mode,
-                                               backend=self._backend)
+            if self._model_mode == "temporal":
+                self._program = make_temporal_fleet_program(
+                    self._mesh, backend=self._backend)
+            else:
+                self._program = make_fleet_program(
+                    self._mesh, model_mode=self._model_mode,
+                    backend=self._backend)
         program = self._program
         params = self._params_for_zones(n_zones)
         t0 = _time.perf_counter()
-        result = run_fleet_attribution(program, batch, params)
+        if self._model_mode == "temporal":
+            feat_hist, t_valid = self._history_windows(batch)
+            result = run_fleet_attribution(program, batch, params,
+                                           feat_hist, t_valid)
+        else:
+            result = run_fleet_attribution(program, batch, params)
         node_power = np.asarray(result.node_power_uw)
         node_energy = np.asarray(result.node_energy_uj)
         wl_power = np.asarray(result.workload_power_uw)
@@ -288,26 +341,57 @@ class Aggregator:
             log.warning("model output dim %s != fleet zones %d; using "
                         "untrained %s fallback for this window",
                         self._model_out_dim(), n_zones, self._model_mode)
+            kwargs = {}
+            if self._model_mode == "temporal":
+                # the fallback's positional table must cover the window
+                kwargs["t_max"] = max(128, self._history_window)
             fallback = initializer(self._model_mode)(
-                jax.random.PRNGKey(0), n_zones=n_zones)
+                jax.random.PRNGKey(0), n_zones=n_zones, **kwargs)
             self._fallback_params[n_zones] = fallback
         return fallback
 
+    def _history_windows(self, batch) -> tuple[np.ndarray, np.ndarray]:
+        """→ (feat_hist [N, W, T, F], t_valid [N, W, T]) aligned with the
+        padded fleet batch's (node, workload) layout."""
+        from kepler_tpu.models.features import NUM_FEATURES
+
+        n, w = batch.cpu_deltas.shape
+        t = self._history_window
+        hist = np.zeros((n, w, t, NUM_FEATURES), np.float32)
+        tv = np.zeros((n, w, t), bool)
+        with self._lock:
+            for i in range(batch.n_nodes):
+                buf = self._history.get(batch.node_names[i])
+                ids = batch.workload_ids[i]
+                if buf is None or not ids:
+                    continue
+                f, v = buf.window_arrays(ids)
+                hist[i, :len(ids)] = f
+                tv[i, :len(ids)] = v
+        return hist, tv
+
     def _check_params_shape(self) -> None:
         """Fail at startup (not first window) on params/model mismatch."""
-        if self._params is None:
-            return
-        required = _REQUIRED_PARAM_KEYS.get(self._model_mode)
-        if required is None:
+        if self._model_mode not in _REQUIRED_PARAM_KEYS:
             raise ValueError(
                 f"unknown aggregator model {self._model_mode!r}; valid: "
                 f"{', '.join(_REQUIRED_PARAM_KEYS)}")
+        if self._params is None:
+            return
+        required = _REQUIRED_PARAM_KEYS[self._model_mode]
         missing = [k for k in required if k not in self._params]
         if missing:
             raise ValueError(
                 f"params are missing {missing} for model "
                 f"{self._model_mode!r} — were they saved from a different "
                 "model kind?")
+        if self._model_mode == "temporal":
+            t_max = int(np.asarray(self._params["pos_emb"]).shape[0])
+            if t_max < self._history_window:
+                raise ValueError(
+                    f"temporal params were trained with t_max={t_max} < "
+                    f"aggregator.historyWindow={self._history_window} — "
+                    "shrink the window or retrain with a longer t_max")
 
     def _model_out_dim(self) -> int | None:
         if self._params is None:

@@ -1,9 +1,13 @@
 """Learned power models (kepler-model-server capability)."""
 
+from kepler_tpu.models.checkpoint import TrainCheckpointer
+from kepler_tpu.models.deep import DeepParams, init_deep, predict_deep
 from kepler_tpu.models.estimator import (
     LINEAR,
     MLP,
+    MOE,
     RATIO,
+    TEMPORAL,
     ModelEstimator,
     initializer,
     predictor,
@@ -11,11 +15,18 @@ from kepler_tpu.models.estimator import (
 from kepler_tpu.models.features import NUM_FEATURES, build_features
 from kepler_tpu.models.linear import LinearParams, init_linear, predict_linear
 from kepler_tpu.models.mlp import MLPParams, init_mlp, predict_mlp
+from kepler_tpu.models.moe import MoEParams, init_moe, predict_moe
+from kepler_tpu.models.temporal import (
+    TemporalParams,
+    init_temporal,
+    predict_temporal,
+)
 from kepler_tpu.models.train import (
     TrainState,
     create_train_state,
     fit,
     make_optimizer,
+    make_temporal_train_step,
     make_train_step,
     masked_mse,
 )
@@ -25,20 +36,33 @@ __all__ = [
     "LinearParams",
     "MLP",
     "MLPParams",
+    "MOE",
     "ModelEstimator",
+    "MoEParams",
     "NUM_FEATURES",
     "RATIO",
+    "TEMPORAL",
+    "DeepParams",
+    "TemporalParams",
+    "TrainCheckpointer",
     "TrainState",
     "build_features",
     "create_train_state",
     "fit",
+    "init_deep",
     "init_linear",
     "init_mlp",
+    "init_moe",
+    "init_temporal",
     "initializer",
     "make_optimizer",
+    "make_temporal_train_step",
     "make_train_step",
     "masked_mse",
+    "predict_deep",
     "predict_linear",
     "predict_mlp",
+    "predict_moe",
+    "predict_temporal",
     "predictor",
 ]

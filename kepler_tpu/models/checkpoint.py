@@ -1,0 +1,80 @@
+"""Training checkpoint/resume (orbax-backed).
+
+The reference has NO checkpointing — all its state re-seeds from the
+hardware's cumulative RAPL counters on restart (SURVEY §5,
+`internal/monitor/monitor.go:326-330`), and this framework keeps that
+property for the attribution path. The one place durable state *does*
+exist here is estimator training: a long fit on fleet history should
+survive preemption (TPU pools get preempted as a matter of course). This
+wraps `orbax.checkpoint.CheckpointManager` around the trainer's
+``TrainState`` (params + optimizer moments + step), so resume continues
+mid-run rather than refitting from scratch.
+
+Serve-time handoff stays `estimator.save_params`/`load_params` (.npz —
+arrays only, no pickle); orbax checkpoints are the *training* artifact.
+Restore is sharding-aware: pass the abstract state built from your
+sharded TrainState and orbax lays shards out directly on device.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from kepler_tpu.models.train import TrainState
+
+
+class TrainCheckpointer:
+    """Periodic save / latest-restore for a training run.
+
+    ``directory`` is created on first save; ``max_to_keep`` bounds disk
+    (old steps are garbage-collected by orbax).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, state: TrainState, force: bool = False) -> bool:
+        """Persist ``state`` under its own step number. → saved?"""
+        import orbax.checkpoint as ocp
+
+        return self._mgr.save(int(state.step), args=ocp.args.StandardSave(
+            state._asdict()), force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, state_like: TrainState) -> TrainState | None:
+        """→ the newest checkpoint laid out like ``state_like`` (shapes,
+        dtypes, shardings), or None if the directory has none."""
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct,
+                                state_like._asdict())
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        return TrainState(**restored)
+
+    def wait(self) -> None:
+        """Block until async saves are durable (call before exiting)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

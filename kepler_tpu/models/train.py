@@ -82,6 +82,35 @@ def make_train_step(
     return train_step
 
 
+def make_temporal_train_step(
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Train step for the TEMPORAL estimator (history-window inputs).
+
+    (state, feat_hist [.., W, T, F], workload_valid [.., W],
+    t_valid [.., W, T], target_watts [.., W, Z]) → (state, loss).
+    Targets are the current tick's RAPL-ratio watts — the model learns to
+    reproduce them from the trajectory (same labels as the single-tick
+    models, richer conditioning).
+    """
+    from kepler_tpu.models.temporal import predict_temporal
+
+    @jax.jit
+    def train_step(state, feat_hist, workload_valid, t_valid, target_watts):
+        def loss_fn(params):
+            pred = predict_temporal(params, feat_hist, workload_valid,
+                                    t_valid, clamp=False)
+            return masked_mse(pred, target_watts, workload_valid)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, opt_state = optimizer.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    return train_step
+
+
 def fit(
     predict_fn: Callable,
     params: Params,

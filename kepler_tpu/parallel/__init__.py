@@ -4,7 +4,9 @@ from kepler_tpu.parallel.aggregator_core import (
     FleetResult,
     fleet_attribution_program,
     make_fleet_program,
+    make_temporal_fleet_program,
     run_fleet_attribution,
+    temporal_fleet_program,
 )
 from kepler_tpu.parallel.fleet import (
     MODE_MODEL,
@@ -44,6 +46,8 @@ __all__ = [
     "make_expert_parallel_moe",
     "make_pipeline",
     "make_pipelined_deep",
+    "make_temporal_fleet_program",
+    "temporal_fleet_program",
     "make_ring_attention",
     "make_temporal_program",
     "top1_route",

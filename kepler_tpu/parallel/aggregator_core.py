@@ -45,6 +45,55 @@ class FleetResult(NamedTuple):
     workload_power_uw: jax.Array  # [N, W, Z]
 
 
+def _ratio_only_result(ratio: AttributionResult) -> FleetResult:
+    return FleetResult(
+        node_energy_uj=ratio.node.energy_uj,
+        node_active_uj=ratio.node.active_uj,
+        node_idle_uj=ratio.node.idle_uj,
+        node_power_uw=ratio.node.power_uw,
+        node_active_power_uw=ratio.node.active_power_uw,
+        node_idle_power_uw=ratio.node.idle_power_uw,
+        workload_energy_uj=ratio.workloads.energy_uj,
+        workload_power_uw=ratio.workloads.power_uw,
+    )
+
+
+def mix_model_watts(
+    ratio: AttributionResult,
+    model_watts: jax.Array,  # f32 [N, W, Z] estimator output (watts)
+    mode: jax.Array,  # int32 [N]
+    dt_s: jax.Array,  # f32 [N]
+) -> FleetResult:
+    """Per-node select: RAPL nodes keep ratio watts, MODE_MODEL nodes take
+    the estimator's. Shared by the single-tick and temporal fleet programs."""
+    model_power_uw = model_watts * 1e6  # watts → µW
+    model_energy_uj = model_power_uw * dt_s[:, None, None]  # µW·s = µJ
+    is_model = (mode == MODE_MODEL)[:, None, None]
+    wl_power = jnp.where(is_model, model_power_uw, ratio.workloads.power_uw)
+    wl_energy = jnp.where(is_model, model_energy_uj,
+                          ratio.workloads.energy_uj)
+    # model-mode nodes have no RAPL; their node totals are the sum of
+    # model-estimated workload power (active == total, idle unknown → 0)
+    est_node_power = jnp.sum(model_power_uw, axis=1)  # [N, Z]
+    est_node_energy = jnp.sum(model_energy_uj, axis=1)
+    is_model_nz = (mode == MODE_MODEL)[:, None]
+    return FleetResult(
+        node_energy_uj=jnp.where(is_model_nz, est_node_energy,
+                                 ratio.node.energy_uj),
+        node_active_uj=jnp.where(is_model_nz, est_node_energy,
+                                 ratio.node.active_uj),
+        node_idle_uj=jnp.where(is_model_nz, 0.0, ratio.node.idle_uj),
+        node_power_uw=jnp.where(is_model_nz, est_node_power,
+                                ratio.node.power_uw),
+        node_active_power_uw=jnp.where(is_model_nz, est_node_power,
+                                       ratio.node.active_power_uw),
+        node_idle_power_uw=jnp.where(is_model_nz, 0.0,
+                                     ratio.node.idle_power_uw),
+        workload_energy_uj=wl_energy,
+        workload_power_uw=wl_power,
+    )
+
+
 def fleet_attribution_program(
     model_params: Any,
     zone_deltas_uj: jax.Array,  # f32 [N, Z]
@@ -64,51 +113,40 @@ def fleet_attribution_program(
         zone_deltas_uj, zone_valid, usage_ratio, cpu_deltas,
         workload_valid, node_cpu_delta, dt_s,
     )
-    if predict_fn is not None:
-        feats = build_features(cpu_deltas, workload_valid, node_cpu_delta,
-                               usage_ratio, dt_s)
-        model_watts = predict_fn(model_params, feats, workload_valid)
-        model_power_uw = model_watts * 1e6  # watts → µW
-        model_energy_uj = model_power_uw * dt_s[:, None, None]  # µW·s = µJ
-        is_model = (mode == MODE_MODEL)[:, None, None]
-        wl_power = jnp.where(is_model, model_power_uw,
-                             ratio.workloads.power_uw)
-        wl_energy = jnp.where(is_model, model_energy_uj,
-                              ratio.workloads.energy_uj)
-        # model-mode nodes have no RAPL; their node totals are the sum of
-        # model-estimated workload power (active == total, idle unknown → 0)
-        est_node_power = jnp.sum(model_power_uw, axis=1)  # [N, Z]
-        est_node_energy = jnp.sum(model_energy_uj, axis=1)
-        is_model_nz = (mode == MODE_MODEL)[:, None]
-        node_power = jnp.where(is_model_nz, est_node_power,
-                               ratio.node.power_uw)
-        node_energy = jnp.where(is_model_nz, est_node_energy,
-                                ratio.node.energy_uj)
-        node_active = jnp.where(is_model_nz, est_node_energy,
-                                ratio.node.active_uj)
-        node_idle = jnp.where(is_model_nz, 0.0, ratio.node.idle_uj)
-        node_active_p = jnp.where(is_model_nz, est_node_power,
-                                  ratio.node.active_power_uw)
-        node_idle_p = jnp.where(is_model_nz, 0.0, ratio.node.idle_power_uw)
-    else:
-        wl_power = ratio.workloads.power_uw
-        wl_energy = ratio.workloads.energy_uj
-        node_power = ratio.node.power_uw
-        node_energy = ratio.node.energy_uj
-        node_active = ratio.node.active_uj
-        node_idle = ratio.node.idle_uj
-        node_active_p = ratio.node.active_power_uw
-        node_idle_p = ratio.node.idle_power_uw
-    return FleetResult(
-        node_energy_uj=node_energy,
-        node_active_uj=node_active,
-        node_idle_uj=node_idle,
-        node_power_uw=node_power,
-        node_active_power_uw=node_active_p,
-        node_idle_power_uw=node_idle_p,
-        workload_energy_uj=wl_energy,
-        workload_power_uw=wl_power,
+    if predict_fn is None:
+        return _ratio_only_result(ratio)
+    feats = build_features(cpu_deltas, workload_valid, node_cpu_delta,
+                           usage_ratio, dt_s)
+    model_watts = predict_fn(model_params, feats, workload_valid)
+    return mix_model_watts(ratio, model_watts, mode, dt_s)
+
+
+def temporal_fleet_program(
+    model_params: Any,
+    zone_deltas_uj: jax.Array,  # f32 [N, Z]
+    zone_valid: jax.Array,  # bool [N, Z]
+    usage_ratio: jax.Array,  # f32 [N]
+    cpu_deltas: jax.Array,  # f32 [N, W]
+    workload_valid: jax.Array,  # bool [N, W]
+    node_cpu_delta: jax.Array,  # f32 [N]
+    dt_s: jax.Array,  # f32 [N]
+    mode: jax.Array,  # int32 [N]
+    feat_hist: jax.Array,  # f32 [N, W, T, F] per-workload history windows
+    t_valid: jax.Array,  # bool [N, W, T]
+    *,
+    attribute_fn=attribute_fleet,
+) -> FleetResult:
+    """Mixed fleet with the TEMPORAL estimator: the aggregator accretes each
+    workload's feature history (`kepler_tpu.monitor.history`) and the model
+    predicts from the whole window instead of the last tick."""
+    from kepler_tpu.models.temporal import predict_temporal
+
+    ratio = attribute_fn(
+        zone_deltas_uj, zone_valid, usage_ratio, cpu_deltas,
+        workload_valid, node_cpu_delta, dt_s,
     )
+    watts = predict_temporal(model_params, feat_hist, workload_valid, t_valid)
+    return mix_model_watts(ratio, watts, mode, dt_s)
 
 
 def resolve_attribute_fn(mesh: Mesh, backend: str):
@@ -187,13 +225,36 @@ def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
     )
 
 
+def make_temporal_fleet_program(mesh: Mesh, backend: str = "einsum"):
+    """jit the TEMPORAL fleet program (extra ``feat_hist``/``t_valid``
+    inputs, node-axis sharded). Params replicate — the model is tiny; for
+    very long windows serve through ``parallel.sequence`` instead."""
+    by_node = NamedSharding(mesh, P(NODE_AXIS))
+    replicated = NamedSharding(mesh, P())
+    fn = functools.partial(temporal_fleet_program,
+                           attribute_fn=resolve_attribute_fn(mesh, backend))
+    if backend == "pallas":
+        data_specs = (P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
+                      P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
+                      P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+                      P(NODE_AXIS))
+        fn = shard_by_node(fn, mesh, in_specs=(P(),) + data_specs)
+    return jax.jit(
+        fn,
+        in_shardings=(replicated,) + (by_node,) * 10,
+        out_shardings=by_node,
+    )
+
+
 def run_fleet_attribution(
     program,
     batch: FleetBatch,
     model_params: Any = None,
+    feat_hist=None,  # [N, W, T, F] — temporal programs only
+    t_valid=None,  # [N, W, T]
 ) -> FleetResult:
     """Host entry: device_put the padded batch and run one sharded step."""
-    return program(
+    args = [
         model_params if model_params is not None else jnp.zeros(()),
         jnp.asarray(batch.zone_deltas_uj),
         jnp.asarray(batch.zone_valid),
@@ -203,4 +264,7 @@ def run_fleet_attribution(
         jnp.asarray(batch.node_cpu_delta),
         jnp.asarray(batch.dt_s),
         jnp.asarray(batch.mode),
-    )
+    ]
+    if feat_hist is not None:
+        args += [jnp.asarray(feat_hist), jnp.asarray(t_valid)]
+    return program(*args)

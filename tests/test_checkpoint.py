@@ -1,0 +1,143 @@
+"""Training for the new estimator families + orbax checkpoint/resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kepler_tpu.models import build_features
+from kepler_tpu.models.checkpoint import TrainCheckpointer
+from kepler_tpu.models.deep import init_deep, predict_deep
+from kepler_tpu.models.moe import init_moe, predict_moe
+from kepler_tpu.models.temporal import init_temporal
+from kepler_tpu.models.train import (
+    create_train_state,
+    fit,
+    make_optimizer,
+    make_temporal_train_step,
+    make_train_step,
+)
+
+Z = 2
+
+
+def synthetic_batch(b=64, seed=0):
+    """Features + ratio-ground-truth watts (share × 20 W per zone)."""
+    rng = np.random.default_rng(seed)
+    cpu = rng.uniform(0.1, 5.0, (b,)).astype(np.float32)
+    valid = jnp.ones((b,), bool)
+    node = jnp.asarray(cpu.sum())
+    feats = build_features(jnp.asarray(cpu), valid, node,
+                           jnp.asarray(0.5), jnp.asarray(5.0))
+    targets = jnp.repeat((jnp.asarray(cpu) / node * 20.0)[:, None], Z, axis=1)
+    return feats, valid, targets
+
+
+class TestFamilyTraining:
+    @pytest.mark.parametrize("family", ["moe", "deep"])
+    def test_fit_reduces_loss(self, family):
+        feats, valid, targets = synthetic_batch()
+        if family == "moe":
+            params = init_moe(jax.random.PRNGKey(0), Z, n_experts=4,
+                              hidden=32)
+            predict = predict_moe
+        else:
+            params = init_deep(jax.random.PRNGKey(0), Z, n_stages=2,
+                               d_model=32)
+            predict = predict_deep
+        opt = make_optimizer(1e-2)
+        state = create_train_state(params, opt)
+        step = make_train_step(predict, opt)
+        state, first = step(state, feats, valid, targets)
+        for _ in range(100):
+            state, loss = step(state, feats, valid, targets)
+        assert float(loss) < float(first) * 0.5
+
+    def test_temporal_fit_reduces_loss(self):
+        feats, valid, targets = synthetic_batch(b=32)
+        t = 8
+        hist = jnp.repeat(feats[:, None, :], t, axis=1)  # constant history
+        t_valid = jnp.ones((32, t), bool)
+        params = init_temporal(jax.random.PRNGKey(0), Z, d_model=32, t_max=t)
+        opt = make_optimizer(1e-3)
+        state = create_train_state(params, opt)
+        step = make_temporal_train_step(opt)
+        state, first = step(state, hist, valid, t_valid, targets)
+        for _ in range(60):
+            state, loss = step(state, hist, valid, t_valid, targets)
+        assert float(loss) < float(first) * 0.7
+
+    def test_fit_helper_works_for_moe(self):
+        feats, valid, targets = synthetic_batch()
+        params = init_moe(jax.random.PRNGKey(0), Z, n_experts=2, hidden=16)
+        trained, loss = fit(predict_moe, params, feats, valid, targets,
+                            steps=50)
+        assert np.isfinite(loss)
+
+
+class TestCheckpointer:
+    def make_state(self, steps=0):
+        feats, valid, targets = synthetic_batch(b=16)
+        from kepler_tpu.models import init_mlp
+
+        opt = make_optimizer(1e-2)
+        state = create_train_state(
+            init_mlp(jax.random.PRNGKey(0), Z, hidden=32), opt)
+        step = make_train_step(
+            __import__("kepler_tpu.models.mlp", fromlist=["predict_mlp"]
+                       ).predict_mlp, opt)
+        for _ in range(steps):
+            state, _ = step(state, feats, valid, targets)
+        return state
+
+    def test_roundtrip(self, tmp_path):
+        state = self.make_state(steps=3)
+        with TrainCheckpointer(str(tmp_path / "ckpt")) as ck:
+            assert ck.latest_step() is None
+            assert ck.restore_latest(state) is None
+            ck.save(state)
+            ck.wait()
+            assert ck.latest_step() == 3
+            restored = ck.restore_latest(state)
+        assert int(restored.step) == 3
+        jax.tree.map(np.testing.assert_array_equal, restored.params,
+                     state.params)
+        jax.tree.map(np.testing.assert_array_equal, restored.opt_state,
+                     state.opt_state)
+
+    def test_resume_continues_training(self, tmp_path):
+        """Preemption mid-fit: restore + continue == training state advances
+        from the checkpointed step, not from scratch."""
+        feats, valid, targets = synthetic_batch(b=16)
+        state = self.make_state(steps=5)
+        with TrainCheckpointer(str(tmp_path / "ckpt")) as ck:
+            ck.save(state)
+            ck.wait()
+        # "new process": fresh initial state, restore latest
+        fresh = self.make_state(steps=0)
+        with TrainCheckpointer(str(tmp_path / "ckpt")) as ck:
+            resumed = ck.restore_latest(fresh)
+        assert int(resumed.step) == 5
+        from kepler_tpu.models.mlp import predict_mlp
+
+        opt = make_optimizer(1e-2)
+        step = make_train_step(predict_mlp, opt)
+        resumed, loss = step(resumed, feats, valid, targets)
+        assert int(resumed.step) == 6
+        assert np.isfinite(float(loss))
+
+    def test_max_to_keep_gc(self, tmp_path):
+        state = self.make_state(steps=0)
+        feats, valid, targets = synthetic_batch(b=16)
+        from kepler_tpu.models.mlp import predict_mlp
+
+        opt = make_optimizer(1e-2)
+        step = make_train_step(predict_mlp, opt)
+        with TrainCheckpointer(str(tmp_path / "ckpt"), max_to_keep=2) as ck:
+            for _ in range(4):
+                state, _ = step(state, feats, valid, targets)
+                ck.save(state)
+            ck.wait()
+            assert ck.latest_step() == 4
+            steps = ck._mgr.all_steps()
+        assert len(steps) <= 2

@@ -168,7 +168,7 @@ class TestRegistry:
         from kepler_tpu.fleet.aggregator import Aggregator
         from kepler_tpu.server.http import APIServer
 
-        agg = Aggregator(APIServer(), model_mode="temporal",
+        agg = Aggregator(APIServer(), model_mode="switch-transformer",
                          model_params={"w": np.zeros(2)})
         with pytest.raises(ValueError, match="unknown aggregator model"):
             agg._check_params_shape()

@@ -434,3 +434,85 @@ class TestParamsPersistence:
         for k in params:
             np.testing.assert_array_equal(np.asarray(loaded[k]),
                                           np.asarray(params[k]))
+
+
+class TestTemporalAggregator:
+    def test_history_accretes_per_node(self, server):
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        for seq in range(1, 4):
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=seq)
+        buf = agg._history["node-a"]
+        feats, tv = buf.window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, True, True, False]
+
+    def test_temporal_attribution_end_to_end(self, server):
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        # mixed fleet: ratio node + model node, several windows of history
+        for seq in range(1, 4):
+            post_report(server, make_report("node-r", mode=MODE_RATIO),
+                        seq=seq)
+            post_report(server, make_report("node-m", mode=MODE_MODEL,
+                                            seed=seq), seq=seq)
+        result = agg.aggregate_once()
+        assert result is not None
+        host, port = server.addresses[0]
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/v1/results", timeout=5) as r:
+            payload = json.loads(r.read())
+        # ratio node unaffected by the estimator: conservation holds
+        rnode = payload["nodes"]["node-r"]
+        assert rnode["mode"] == MODE_RATIO
+        assert all(np.isfinite(w["power_uw"]).all()
+                   for w in rnode["workloads"])
+        mnode = payload["nodes"]["node-m"]
+        assert mnode["mode"] == MODE_MODEL
+        assert all(np.isfinite(w["power_uw"]).all()
+                   for w in mnode["workloads"])
+        # node totals for the model node = Σ workload power
+        total = np.sum([w["power_uw"] for w in mnode["workloads"]], axis=0)
+        np.testing.assert_allclose(total, mnode["node_power_uw"], rtol=1e-3)
+
+    def test_stale_node_history_pruned(self, server):
+        clock = [1000.0]
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4,
+                         stale_after=10.0, clock=lambda: clock[0])
+        agg.init()
+        post_report(server, make_report("node-a", mode=MODE_MODEL))
+        assert "node-a" in agg._history
+        clock[0] += 60.0
+        agg.aggregate_once()
+        assert "node-a" not in agg._history
+
+    def test_duplicate_seq_does_not_duplicate_history(self, server):
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        for _ in range(2):  # LB retry redelivers the same seq
+            post_report(server, make_report("node-a", mode=MODE_MODEL), seq=1)
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, False, False, False]
+
+    def test_ratio_nodes_accrete_no_history(self, server):
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        post_report(server, make_report("metal", mode=MODE_RATIO))
+        assert "metal" not in agg._history
+
+    def test_window_longer_than_params_rejected_at_startup(self, server):
+        import jax
+
+        from kepler_tpu.models import init_temporal
+
+        params = {k: np.asarray(v) for k, v in init_temporal(
+            jax.random.PRNGKey(0), 2, d_model=32, t_max=8).items()}
+        agg = Aggregator(server, model_mode="temporal", history_window=16,
+                         model_params=params)
+        with pytest.raises(ValueError, match="t_max"):
+            agg.init()
