@@ -1,0 +1,148 @@
+"""The five BASELINE.json benchmark scenarios.
+
+The reference publishes no numbers (SURVEY §6) — this suite defines them
+for the TPU build. One JSON line per scenario, same shape as the headline
+``bench.py`` metric:
+
+  1 single-zone-ratio     1 node, package zone only (bare-metal minimal)
+  2 multi-zone-ratio      1 node, package/core/dram/uncore
+  3 linear-no-rapl        model-mode node, linear regression from features
+  4 mlp-estimator         model-mode node, MLP estimator
+  5 cluster-mixed         1k nodes × ~100 pods, ratio+MLP mixed (headline)
+
+All scenarios run the packed-transfer path (`parallel/packed.py`) end to
+end: pack → ONE H2D → fused program → ONE f16 D2H → unpack. The extra
+``device_p50_ms``/``sync_floor_p50_ms`` fields separate program cost from
+the platform's fixed RPC latency (dominant on a network-tunnelled chip).
+
+Usage: ``python benchmarks/scenarios.py [--iters N]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # runnable from any cwd
+
+
+def make_batch(n_nodes: int, n_workloads: int, n_zones: int, mode: int,
+               seed: int = 0, ragged: bool = False):
+    from kepler_tpu.parallel.fleet import FleetBatch
+
+    rng = np.random.default_rng(seed)
+    cpu = rng.uniform(0.0, 5.0, (n_nodes, n_workloads)).astype(np.float32)
+    valid = np.ones((n_nodes, n_workloads), bool)
+    if ragged:
+        valid[:] = False
+        for i in range(n_nodes):
+            valid[i, : rng.integers(80, min(121, n_workloads + 1))] = True
+    cpu = np.where(valid, cpu, 0.0).astype(np.float32)
+    if mode == -1:  # mixed fleet
+        modes = (np.arange(n_nodes) % 2).astype(np.int32)
+    else:
+        modes = np.full(n_nodes, mode, np.int32)
+    return FleetBatch(
+        node_names=[f"node-{i}" for i in range(n_nodes)],
+        n_nodes=n_nodes,
+        workload_counts=valid.sum(axis=1).tolist(),
+        workload_ids=[[] for _ in range(n_nodes)],
+        zone_deltas_uj=rng.uniform(
+            1e7, 5e8, (n_nodes, n_zones)).astype(np.float32),
+        zone_valid=np.ones((n_nodes, n_zones), bool),
+        usage_ratio=rng.uniform(0.2, 0.9, n_nodes).astype(np.float32),
+        cpu_deltas=cpu,
+        workload_valid=valid,
+        node_cpu_delta=cpu.sum(axis=1).astype(np.float32),
+        dt_s=np.full(n_nodes, 5.0, np.float32),
+        mode=modes,
+    )
+
+
+SCENARIOS = [
+    # (name, nodes, workloads, zones, mode, model, ragged)
+    ("single-zone-ratio", 1, 128, 1, 0, None, False),
+    ("multi-zone-ratio", 1, 128, 4, 0, None, False),
+    ("linear-no-rapl", 1, 128, 4, 1, "linear", False),
+    ("mlp-estimator", 1, 128, 4, 1, "mlp", False),
+    ("cluster-mixed", 1024, 128, 4, -1, "mlp", True),
+]
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--backend", default="einsum",
+                   help="einsum | pallas (pallas needs TPU or interpret)")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kepler_tpu.models import initializer
+    from kepler_tpu.parallel import make_mesh
+    from kepler_tpu.parallel.packed import (
+        make_packed_fleet_program,
+        pack_fleet_inputs,
+        unpack_fleet_watts,
+    )
+
+    mesh = make_mesh(devices=jax.devices()[:1])
+    platform = jax.devices()[0].platform
+
+    def percentiles(fn, iters):
+        for _ in range(3):
+            fn()
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        return (times[math.ceil(0.99 * len(times)) - 1],
+                times[len(times) // 2])
+
+    for name, n, w, z, mode, model, ragged in SCENARIOS:
+        batch = make_batch(n, w, z, mode, ragged=ragged)
+        params = (initializer(model)(jax.random.PRNGKey(0), z)
+                  if model else None)
+        program = make_packed_fleet_program(
+            mesh, n_workloads=w, n_zones=z, model_mode=model,
+            backend=args.backend)
+        packed_host = pack_fleet_inputs(batch)
+
+        def step():
+            out = program(params, jnp.asarray(packed_host))
+            unpack_fleet_watts(np.asarray(out))
+
+        packed_dev = jnp.asarray(packed_host)
+
+        def device_step():
+            jax.block_until_ready(program(params, packed_dev))
+
+        p99, p50 = percentiles(step, args.iters)
+        dev_p99, dev_p50 = percentiles(device_step, args.iters)
+        pods = int(batch.workload_valid.sum())
+        print(json.dumps({
+            "scenario": name,
+            "p99_ms": round(p99, 4),
+            "p50_ms": round(p50, 4),
+            "device_p99_ms": round(dev_p99, 4),
+            "device_p50_ms": round(dev_p50, 4),
+            "nodes": n,
+            "pods": pods,
+            "pods_per_sec": round(pods / (p50 / 1e3)),
+            "platform": platform,
+            "backend": args.backend,
+        }))
+
+
+if __name__ == "__main__":
+    main()

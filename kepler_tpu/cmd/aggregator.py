@@ -57,6 +57,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         workload_bucket=cfg.tpu.workload_bucket,
         backend=cfg.tpu.fleet_backend,
         history_window=cfg.aggregator.history_window,
+        training_dump_dir=cfg.aggregator.training_dump_dir,
+        training_dump_max_files=cfg.aggregator.training_dump_max_files,
     )
     services: list = [server, aggregator]
 

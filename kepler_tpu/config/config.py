@@ -200,13 +200,17 @@ class AggregatorConfig:
     interval: float = 5.0
     stale_after: float = 15.0
     # learned estimator for non-RAPL nodes: "" = ratio-only, else
-    # "linear"/"mlp"/"moe"/"temporal"; params_path = .npz from
+    # "linear"/"mlp"/"moe"/"deep"/"temporal"; params_path = .npz from
     # models.estimator.save_params
     model: str = "mlp"
     params_path: str = ""
     # temporal mode: ticks of per-workload feature history the aggregator
     # accretes per node (the model's attention window)
     history_window: int = 16
+    # capture RAPL nodes' windows + ratio-watt labels as training files for
+    # cmd/train ("" = off); oldest files pruned beyond the cap
+    training_dump_dir: str = ""
+    training_dump_max_files: int = 1000
     # node-agent side: report as a model-estimated node (no trustworthy
     # RAPL — e.g. a VM guest); the aggregator then uses the estimator
     node_mode: str = "ratio"  # ratio | model
@@ -266,8 +270,10 @@ class Config:
                 f"invalid tpu.fleetBackend: {self.tpu.fleet_backend!r}")
         if self.aggregator.history_window < 1:
             errs.append("aggregator.historyWindow must be >= 1")
+        if self.aggregator.training_dump_max_files < 1:
+            errs.append("aggregator.trainingDumpMaxFiles must be >= 1")
         if self.aggregator.model not in ("", "linear", "mlp", "moe",
-                                         "temporal"):
+                                         "deep", "temporal"):
             errs.append(f"invalid aggregator.model: {self.aggregator.model!r}")
         if self.aggregator.node_mode not in ("ratio", "model"):
             errs.append(
@@ -304,6 +310,8 @@ _YAML_KEYS: dict[str, str] = {
     "fleetBackend": "fleet_backend",
     "fleet-backend": "fleet_backend",
     "historyWindow": "history_window",
+    "trainingDumpDir": "training_dump_dir",
+    "trainingDumpMaxFiles": "training_dump_max_files",
 }
 
 _DURATION_FIELDS = {"interval", "staleness", "stale_after"}
@@ -411,11 +419,17 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.tls-skip-verify", dest="aggregator_tls_skip_verify",
         default=None, action=argparse.BooleanOptionalAction)
     add("--aggregator.model", dest="aggregator_model", default=None,
-        choices=["", "linear", "mlp", "moe", "temporal"])
+        choices=["", "linear", "mlp", "moe", "deep", "temporal"])
     add("--aggregator.params-path", dest="aggregator_params_path",
         default=None)
     add("--aggregator.node-mode", dest="aggregator_node_mode", default=None,
         choices=["ratio", "model"])
+    add("--aggregator.history-window", dest="aggregator_history_window",
+        default=None, type=int)
+    add("--aggregator.training-dump-dir", dest="aggregator_dump_dir",
+        default=None)
+    add("--aggregator.training-dump-max-files",
+        dest="aggregator_dump_max_files", default=None, type=int)
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
@@ -458,6 +472,10 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "model"), args.aggregator_model)
     set_if(("aggregator", "params_path"), args.aggregator_params_path)
     set_if(("aggregator", "node_mode"), args.aggregator_node_mode)
+    set_if(("aggregator", "history_window"), args.aggregator_history_window)
+    set_if(("aggregator", "training_dump_dir"), args.aggregator_dump_dir)
+    set_if(("aggregator", "training_dump_max_files"),
+           args.aggregator_dump_max_files)
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     return cfg

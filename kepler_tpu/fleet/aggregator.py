@@ -58,11 +58,12 @@ _REQUIRED_PARAM_KEYS = {
     "mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
     "linear": ("weight", "bias"),
     "moe": ("gate_w", "w0", "b0", "w1", "b1"),
+    "deep": ("in_proj", "in_bias", "blocks", "w_head", "b_head"),
     "temporal": ("in_proj", "pos_emb", "wq", "wk", "wv", "wo",
                  "w_mlp0", "w_mlp1", "w_head", "b_head"),
 }
 _OUTPUT_BIAS_KEY = {"mlp": "b2", "linear": "bias", "moe": "b1",
-                    "temporal": "b_head"}
+                    "deep": "b_head", "temporal": "b_head"}
 
 
 @dataclass
@@ -87,6 +88,8 @@ class Aggregator:
         workload_bucket: int = 256,
         backend: str = "einsum",
         history_window: int = 16,
+        training_dump_dir: str = "",
+        training_dump_max_files: int = 1000,
         clock=None,
         mesh=None,
     ) -> None:
@@ -104,6 +107,13 @@ class Aggregator:
         # report receipt so the window advances at each node's own cadence
         self._history_window = history_window
         self._history: dict[str, "HistoryBuffer"] = {}
+        # training-data capture: RAPL nodes' windows + their ratio watts
+        # become (features, labels) files for cmd/train (the
+        # kepler-model-server train→serve loop, BASELINE configs 3-4)
+        self._dump_dir = training_dump_dir
+        self._dump_max_files = max(1, training_dump_max_files)
+        self._dump_seq = 0
+        self._dump_files: list[str] | None = None  # seeded on first dump
 
         self._lock = threading.Lock()
         self._reports: dict[str, _Stored] = {}
@@ -322,6 +332,12 @@ class Aggregator:
         log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms",
                   batch.n_nodes, self._stats["last_batch_workloads"],
                   elapsed_ms)
+        if self._dump_dir:
+            # AFTER results publication — file I/O must not delay /v1/results
+            try:
+                self._dump_training_window(batch, wl_power, zone_names, now)
+            except OSError as err:
+                log.warning("training dump failed: %s", err)
         return result
 
     def _params_for_zones(self, n_zones: int):
@@ -349,6 +365,56 @@ class Aggregator:
                 jax.random.PRNGKey(0), n_zones=n_zones, **kwargs)
             self._fallback_params[n_zones] = fallback
         return fallback
+
+    def _dump_training_window(self, batch, wl_power_uw: np.ndarray,
+                              zone_names: list[str], now: float) -> None:
+        """Write one training file: RAPL rows' inputs + their ratio watts.
+
+        Only MODE_RATIO rows carry trustworthy labels (the estimator's own
+        output would be circular); rows keep the padded [n, W] layout with
+        ``workload_valid`` masking. The file records its OWN zone axis
+        (``zone_names``) and per-row ``zone_valid`` — the zone union varies
+        across rounds as fleet membership changes, so cmd/train aligns
+        columns by name and masks zones a node didn't report (their 0-watt
+        rows are absence, not labels). Oldest files beyond the cap are
+        pruned so a long-running aggregator bounds its disk."""
+        import os
+
+        ratio_rows = np.flatnonzero(
+            (np.asarray(batch.mode[:batch.n_nodes]) != MODE_MODEL))
+        if ratio_rows.size == 0:
+            return
+        os.makedirs(self._dump_dir, exist_ok=True)
+        self._dump_seq += 1
+        path = os.path.join(
+            self._dump_dir, f"window-{int(now * 1e3):014d}-"
+            f"{self._dump_seq:06d}.npz")
+        r = ratio_rows
+        np.savez_compressed(
+            path,
+            zone_names=np.asarray(zone_names),
+            zone_valid=batch.zone_valid[r],
+            cpu_deltas=batch.cpu_deltas[r],
+            workload_valid=batch.workload_valid[r],
+            node_cpu_delta=batch.node_cpu_delta[r],
+            usage_ratio=batch.usage_ratio[r],
+            dt_s=batch.dt_s[r],
+            target_watts=wl_power_uw[r] / 1e6,  # labels in watts
+        )
+        # prune via an in-process ledger (seeded from disk once) — no
+        # per-dump directory scan
+        if self._dump_files is None:
+            self._dump_files = sorted(
+                os.path.join(self._dump_dir, f)
+                for f in os.listdir(self._dump_dir)
+                if f.startswith("window-") and f.endswith(".npz"))
+        else:
+            self._dump_files.append(path)
+        while len(self._dump_files) > self._dump_max_files:
+            try:
+                os.unlink(self._dump_files.pop(0))
+            except OSError:
+                pass
 
     def _history_windows(self, batch) -> tuple[np.ndarray, np.ndarray]:
         """→ (feat_hist [N, W, T, F], t_valid [N, W, T]) aligned with the

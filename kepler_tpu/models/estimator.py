@@ -26,6 +26,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from kepler_tpu.models.deep import init_deep, predict_deep
 from kepler_tpu.models.features import build_features
 from kepler_tpu.models.linear import init_linear, predict_linear
 from kepler_tpu.models.mlp import init_mlp, predict_mlp
@@ -37,6 +38,7 @@ LINEAR = "linear"
 MLP = "mlp"
 TEMPORAL = "temporal"
 MOE = "moe"
+DEEP = "deep"
 
 # registry contract: a predictor is callable as (params, features[.., W, F],
 # workload_valid[.., W]) → watts — single-tick features. TEMPORAL is NOT
@@ -46,6 +48,7 @@ _PREDICTORS: dict[str, Callable] = {
     LINEAR: predict_linear,
     MLP: predict_mlp,
     MOE: predict_moe,
+    DEEP: predict_deep,
 }
 
 _INITIALIZERS: dict[str, Callable] = {
@@ -53,6 +56,7 @@ _INITIALIZERS: dict[str, Callable] = {
     MLP: init_mlp,
     TEMPORAL: init_temporal,
     MOE: init_moe,
+    DEEP: init_deep,
 }
 
 
@@ -112,18 +116,34 @@ class ModelEstimator:
 
 
 def save_params(path: str, params: Any) -> None:
-    """Persist flat dict-of-arrays params (LinearParams/MLPParams) as .npz —
-    the train→serve handoff for the fleet aggregator. No pickle: arrays
-    only, loadable on any host."""
+    """Persist params as .npz — the train→serve handoff for the fleet
+    aggregator. One level of nesting (DeepParams' ``blocks``) flattens to
+    "outer/inner" keys. No pickle: arrays only, loadable on any host."""
     import numpy as np
 
-    np.savez(path, **{k: np.asarray(v) for k, v in params.items()})
+    flat = {}
+    for k, v in params.items():
+        if isinstance(v, dict):
+            for k2, v2 in v.items():
+                flat[f"{k}/{k2}"] = np.asarray(v2)
+        else:
+            flat[k] = np.asarray(v)
+    np.savez(path, **flat)
 
 
 def load_params(path: str) -> dict:
-    """Load params saved by :func:`save_params` (allow_pickle stays off —
-    checkpoint files may come from untrusted storage)."""
+    """Load params saved by :func:`save_params`, rebuilding "outer/inner"
+    keys into nested dicts (allow_pickle stays off — checkpoint files may
+    come from untrusted storage)."""
     import numpy as np
 
+    out: dict = {}
     with np.load(path, allow_pickle=False) as data:
-        return {k: jnp.asarray(data[k]) for k in data.files}
+        for k in data.files:
+            arr = jnp.asarray(data[k])
+            if "/" in k:
+                outer, inner = k.split("/", 1)
+                out.setdefault(outer, {})[inner] = arr
+            else:
+                out[k] = arr
+    return out

@@ -32,9 +32,15 @@ def masked_mse(
     pred_watts: jax.Array,  # [..., W, Z]
     target_watts: jax.Array,  # [..., W, Z]
     workload_valid: jax.Array,  # bool [..., W]
+    label_valid: jax.Array | None = None,  # bool [..., W, Z] per-zone mask
 ) -> jax.Array:
+    """``label_valid`` excludes zones a node never reported: the aggregator
+    writes 0 W there (absence, not a measurement), and counting those rows
+    as labels would drag predictions for that zone toward zero."""
     err = (pred_watts - target_watts) ** 2
     mask = workload_valid[..., None].astype(err.dtype)
+    if label_valid is not None:
+        mask = mask * label_valid.astype(err.dtype)
     total = jnp.sum(err * mask)
     count = jnp.maximum(jnp.sum(mask), 1.0)
     return total / count
@@ -68,10 +74,12 @@ def make_train_step(
         features: jax.Array,  # [B, F] or [N, W, F]
         workload_valid: jax.Array,
         target_watts: jax.Array,
+        label_valid: jax.Array | None = None,  # bool [..., W, Z]
     ) -> tuple[TrainState, jax.Array]:
         def loss_fn(params):
             pred = train_predict(params, features, workload_valid)
-            return masked_mse(pred, target_watts, workload_valid)
+            return masked_mse(pred, target_watts, workload_valid,
+                              label_valid)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state,
