@@ -29,6 +29,7 @@ class AggregatedZone:
         self._lock = threading.Lock()
         self._last: dict[int, int] = {}  # per-zone previous raw reading
         self._total: int = 0  # accumulated aggregate µJ
+        self._path_counts: list[int] | None = None  # per-subzone, cached
 
     def name(self) -> str:
         return self._name
@@ -48,24 +49,64 @@ class AggregatedZone:
         return Energy(total)
 
     def energy(self) -> Energy:
+        # subzone reads happen INSIDE the lock: an interleaved pair of
+        # readers could otherwise regress a subzone counter and fake a
+        # wraparound (the documented concurrent-reader guarantee)
         with self._lock:
-            for i, z in enumerate(self._zones):
-                current = int(z.energy())
-                if i in self._last:
-                    prev = self._last[i]
-                    if current >= prev:
-                        delta = current - prev
-                    else:  # wraparound of this subzone
-                        delta = (int(z.max_energy()) - prev) + current
-                    self._total += delta
-                else:
-                    # First read seeds the aggregate at the sum of current
-                    # readings so restarts resume from hardware counters.
-                    self._total += current
-                self._last[i] = current
-            # The aggregate itself wraps at combined max_energy so downstream
-            # wraparound math (ops.deltas) stays uniform across zone kinds.
-            max_e = int(self.max_energy())
-            if max_e and self._total >= max_e:
-                self._total %= max_e
-            return Energy(self._total)
+            return self._combine_locked([int(z.energy())
+                                         for z in self._zones])
+
+    def _combine_locked(self, currents: Sequence[int]) -> Energy:
+        for i, (z, current) in enumerate(zip(self._zones, currents)):
+            if i in self._last:
+                prev = self._last[i]
+                if current >= prev:
+                    delta = current - prev
+                else:  # wraparound of this subzone
+                    delta = (int(z.max_energy()) - prev) + current
+                self._total += delta
+            else:
+                # First read seeds the aggregate at the sum of current
+                # readings so restarts resume from hardware counters.
+                self._total += current
+            self._last[i] = current
+        # The aggregate itself wraps at combined max_energy so downstream
+        # wraparound math (ops.deltas) stays uniform across zone kinds.
+        max_e = int(self.max_energy())
+        if max_e and self._total >= max_e:
+            self._total %= max_e
+        return Energy(self._total)
+
+    # -- batched-read support (native fast path) ---------------------------
+
+    def energy_paths(self) -> list[str]:
+        """Concatenated subzone counter files (order matches
+        :meth:`energy_from_raw`'s expectation). Raises AttributeError when
+        a subzone can't be batch-read — callers treat that as 'no fast
+        path' and fall back to :meth:`energy`."""
+        if self._path_counts is None:
+            per_zone = [z.energy_paths() for z in self._zones]
+            self._path_counts = [len(p) for p in per_zone]
+            return [p for zone_paths in per_zone for p in zone_paths]
+        paths: list[str] = []
+        for z in self._zones:
+            paths.extend(z.energy_paths())
+        return paths
+
+    def energy_from_raw(self, values: Sequence[int]) -> Energy:
+        """Combine raw batch-read subzone values with the same per-subzone
+        wraparound handling as :meth:`energy`.
+
+        The values were read OUTSIDE the lock (one native call covering
+        every zone) — safe because batched reads come only from the
+        monitor's single refresh task (singleflight); the lock still
+        serialises against any concurrent :meth:`energy` caller."""
+        if self._path_counts is None:
+            self.energy_paths()  # populate the per-subzone counts once
+        currents = []
+        offset = 0
+        for z, n in zip(self._zones, self._path_counts):
+            currents.append(int(z.energy_from_raw(values[offset:offset + n])))
+            offset += n
+        with self._lock:
+            return self._combine_locked(currents)

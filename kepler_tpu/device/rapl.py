@@ -29,6 +29,9 @@ log = logging.getLogger("kepler.device.rapl")
 
 _ZONE_DIR_RE = re.compile(r"^intel-rapl(:\d+)+$")
 
+# sentinel the native batch reader writes for unreadable counter files
+_READ_FAILED = 2**64 - 1
+
 
 class SysfsRaplZone:
     """A single powercap zone directory (reference sysfsRaplZone, :259-287)."""
@@ -60,6 +63,20 @@ class SysfsRaplZone:
 
     def max_energy(self) -> Energy:
         return Energy(self._max_energy)
+
+    # -- batched-read support (native fast path) ---------------------------
+
+    def energy_paths(self) -> list[str]:
+        """Counter files backing this zone — lets the monitor batch ALL
+        zones' reads into one native call (native.read_counters)."""
+        return [os.path.join(self._path, "energy_uj")]
+
+    def energy_from_raw(self, values: Sequence[int]) -> Energy:
+        """Interpret raw values batch-read from :meth:`energy_paths`."""
+        (v,) = values
+        if v == _READ_FAILED:
+            raise OSError(f"batched read of {self._path}/energy_uj failed")
+        return Energy(int(v))
 
 
 def canonical_zone_key(name: str) -> str:

@@ -39,6 +39,8 @@ from kepler_tpu.service.lifecycle import CancelContext
 
 log = logging.getLogger("kepler.monitor")
 
+_UNSET = object()  # "batch plan not yet computed" (None = computed, absent)
+
 _KINDS = ("processes", "containers", "virtual_machines", "pods")
 _KIND_CODES = (
     FeatureBatch.KIND_PROCESS,
@@ -92,6 +94,7 @@ class PowerMonitor:
         self._zones: list[EnergyZone] = []
         self._zone_names: tuple[str, ...] = ()
         self._prev_counters: list[int | None] = []
+        self._batch_plan = _UNSET  # lazily-resolved native zone-read plan
         self._last_read_ts: float | None = None
 
         # cumulative f64 accumulators: kind → id → [Z] µJ
@@ -126,6 +129,7 @@ class PowerMonitor:
             self._meter.init()
         self._zones = list(self._meter.zones())
         self._zone_names = tuple(z.name() for z in self._zones)
+        self._batch_plan = _UNSET  # re-resolve against the new zone list
         z = len(self._zones)
         self._prev_counters = [None] * z
         self._node_energy = np.zeros(z)
@@ -263,15 +267,65 @@ class PowerMonitor:
                     log.exception("window listener failed")
         log.debug("refresh done in %.2f ms", (_time.perf_counter() - start) * 1e3)
 
+    def _zone_batch_plan(self):
+        """(paths, per-zone slices) when EVERY zone supports batched raw
+        reads AND the native library is present — else None. Computed once;
+        one C call then replaces Z×(open+read+close) Python file reads per
+        tick."""
+        if self._batch_plan is not _UNSET:
+            return self._batch_plan
+        plan = None
+        if not all(hasattr(z, "energy_paths") for z in self._zones):
+            self._batch_plan = None  # fake/mock zones: no fast path
+            return None
+        try:
+            from kepler_tpu.native import scanner
+
+            native = scanner()
+            if native is not None:
+                paths: list[str] = []
+                slices: list[slice] = []
+                for zone in self._zones:
+                    zp = zone.energy_paths()
+                    slices.append(slice(len(paths), len(paths) + len(zp)))
+                    paths.extend(zp)
+                if paths:
+                    plan = (native, paths, slices)
+        except Exception as err:  # native build failure etc.
+            log.debug("no batched zone reads: %s", err)
+            plan = None
+        self._batch_plan = plan
+        return plan
+
+    def _read_zone_energies(self) -> list[int | None]:
+        """Current raw counter per zone (None = failed read this tick)."""
+        out: list[int | None] = []
+        plan = self._zone_batch_plan()
+        if plan is not None:
+            native, paths, slices = plan
+            raw = native.read_counters(paths)
+            for zone, sl in zip(self._zones, slices):
+                try:
+                    out.append(int(zone.energy_from_raw(raw[sl].tolist())))
+                except (OSError, ValueError) as err:
+                    log.warning("zone %s read failed: %s", zone.name(), err)
+                    out.append(None)
+            return out
+        for zone in self._zones:
+            try:
+                out.append(int(zone.energy()))
+            except (OSError, ValueError) as err:
+                log.warning("zone %s read failed: %s", zone.name(), err)
+                out.append(None)
+        return out
+
     def _read_zone_deltas(self) -> tuple[np.ndarray, np.ndarray]:
         z = len(self._zones)
         deltas = np.zeros(z, np.float64)
         valid = np.zeros(z, bool)
-        for i, zone in enumerate(self._zones):
-            try:
-                current = int(zone.energy())
-            except (OSError, ValueError) as err:
-                log.warning("zone %s read failed: %s", zone.name(), err)
+        for i, (zone, current) in enumerate(
+                zip(self._zones, self._read_zone_energies())):
+            if current is None:
                 continue  # stays masked this window
             prev = self._prev_counters[i]
             self._prev_counters[i] = current

@@ -1,0 +1,166 @@
+"""Pallas TPU kernel for the temporal estimator's attention hot op.
+
+One fused kernel per ``(batch × head)`` program computes the whole
+blockwise-attention partial — scores, causal/validity masking, the
+online-softmax statistics, and the value contraction — in a single VMEM
+round trip:
+
+    s  = q @ kᵀ · scale          (MXU, bf16 in / f32 out)
+    m  = rowmax(s),  p = e^(s−m),  l = rowsum(p)
+    pv = p @ v                   (MXU)
+
+XLA's fusion of the jnp path (`ops.attention.block_attn`) materialises the
+[T, T] score matrix in HBM between the two matmuls once T grows; here it
+never leaves VMEM (history windows are T ≤ a few hundred ticks, so a
+[T, T] f32 tile fits comfortably in 16 MB VMEM).
+
+Layout: heads fold into the grid axis — inputs reshape to ``[B·H, T, D]``
+so each block is a clean rank-2 ``(T, D)`` tile (Mosaic requires the
+trailing block dims to align to (8, 128) or span the array; a
+``(1, T, 1, D)`` block on a 4-D array does not). The transposes live
+outside the kernel where XLA fuses them with the surrounding projections.
+
+The kernel returns the SAME (pv, m, l) partials contract as
+``block_attn``, so it drops into both consumers:
+
+- dense serving: :func:`pallas_attention_fn` → an ``attention_fn`` for
+  ``models.temporal.temporal_trunk``'s seam;
+- ring attention: ``parallel.ring`` calls :func:`flash_block_pallas` per
+  KV rotation (positions arrive as scalar block starts, so the causal
+  mask is recomputed from iota inside the kernel — the [T, T] mask is
+  never materialised in HBM either).
+
+Masking matches `ops.attention` exactly: fully-masked rows force p = 0
+(m stays at −1e30, l = 0) and the caller's l-clamp yields zero output.
+CPU tests run ``interpret=True`` (tests/conftest.py forces CPU); on TPU
+it compiles with Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kepler_tpu.ops.attention import _NEG_INF, stats_to_out
+
+
+def _flash_kernel(qs_ref, ks_ref, q_ref, k_ref, v_ref, kvv_ref,
+                  o_ref, m_ref, l_ref, *, scale, causal, compute_dtype):
+    q = q_ref[0].astype(compute_dtype)  # [Tq, D]
+    k = k_ref[0].astype(compute_dtype)  # [Tk, D]
+    v = v_ref[0].astype(compute_dtype)  # [Tk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [Tq, Tk]
+    mask = kvv_ref[0, 0][None, :] > 0.5  # [1, Tk] KV validity
+    if causal:
+        qp = qs_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kp = ks_ref[0] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = mask & (qp >= kp)
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=1)  # [Tq]
+    p = jnp.exp(s - m[:, None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=1)  # noqa: E741
+    pv = jax.lax.dot_general(
+        p.astype(compute_dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[0] = pv
+    m_ref[0, 0] = m
+    l_ref[0, 0] = l
+
+
+def flash_block_pallas(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, Tk, H, D]
+    v: jax.Array,  # [B, Tk, H, D]
+    kv_valid: jax.Array,  # bool/float [B, Tk]
+    q_start,  # int scalar: global position of q row 0
+    kv_start,  # int scalar: global position of k row 0
+    *,
+    causal: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    interpret: bool | None = None,
+):
+    """One fused (q-block × kv-block) partial → (pv [B,Tq,H,D],
+    m [B,H,Tq], l [B,H,Tq]) — the ``block_attn`` contract on the MXU."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               compute_dtype=compute_dtype)
+
+    def fold(x, t):  # [B, T, H, D] → [B·H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qs = jnp.asarray(q_start, jnp.int32).reshape(1)
+    ks = jnp.asarray(kv_start, jnp.int32).reshape(1)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    pv, m, l = pl.pallas_call(  # noqa: E741
+        kernel,
+        grid=(b * h,),
+        in_specs=[
+            smem, smem,
+            pl.BlockSpec((1, tq, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i: (i, 0, 0)),
+            # [B, 1, Tk]: a rank-3 mask keeps the trailing block
+            # dims (1, Tk) Mosaic-aligned (rank-2 (1, Tk) on [B, Tk]
+            # would put block dim 1 against array dim B)
+            pl.BlockSpec((1, 1, tk), lambda i: (i // h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tq, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qs, ks, fold(q, tq), fold(k, tk), fold(v, tk),
+      kv_valid.astype(jnp.float32)[:, None, :])
+    pv = pv.reshape(b, h, tq, d).transpose(0, 2, 1, 3)  # → [B, Tq, H, D]
+    return pv, m.reshape(b, h, tq), l.reshape(b, h, tq)
+
+
+def full_attention_pallas(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    t_valid: jax.Array | None = None,  # bool [B, T]
+    *,
+    causal: bool = True,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Dense attention via the fused kernel (drop-in for
+    ``ops.attention.full_attention``)."""
+    if t_valid is None:
+        t_valid = jnp.ones(q.shape[:2], bool)
+    pv, _, l = flash_block_pallas(  # noqa: E741
+        q, k, v, t_valid, 0, 0, causal=causal,
+        compute_dtype=compute_dtype, interpret=interpret)
+    l_safe = jnp.maximum(l, 1e-30)
+    return (pv / stats_to_out(l_safe)).astype(q.dtype)
+
+
+def pallas_attention_fn(causal: bool = True,
+                        compute_dtype: jnp.dtype = jnp.bfloat16,
+                        interpret: bool | None = None):
+    """→ an ``attention_fn`` for ``temporal_trunk``'s plug-in seam."""
+
+    def fn(q, k, v, t_valid):
+        return full_attention_pallas(q, k, v, t_valid, causal=causal,
+                                     compute_dtype=compute_dtype,
+                                     interpret=interpret)
+
+    return fn

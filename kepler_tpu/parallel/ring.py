@@ -42,7 +42,8 @@ __all__ = ["SEQ_AXIS", "full_attention", "make_ring_attention",
            "ring_attention_shardmap"]
 
 
-def _ring_shard(q, k, v, t_valid, *, axis_name, causal, compute_dtype):
+def _ring_shard(q, k, v, t_valid, *, axis_name, causal, compute_dtype,
+                backend="einsum"):
     """Per-device body: local q block resident, KV ring-rotates n times."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -59,15 +60,27 @@ def _ring_shard(q, k, v, t_valid, *, axis_name, causal, compute_dtype):
     l = vary(jnp.zeros((b, h, t_loc), jnp.float32))  # noqa: E741
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(s, carry):
-        o, m, l, k, v, kv_val = carry  # noqa: E741
-        src = (idx - s) % n  # shard this KV block originated from
+    def block_partials(k, v, kv_val, src):
+        if backend == "pallas":
+            from kepler_tpu.ops.pallas_attention import flash_block_pallas
+
+            # positions reach the kernel as scalar block starts; the
+            # causal mask is rebuilt from iota inside VMEM — the [T, T]
+            # mask never exists in HBM
+            return flash_block_pallas(
+                q, k, v, kv_val, idx * t_loc, src * t_loc, causal=causal,
+                compute_dtype=compute_dtype)
         kv_pos = src * t_loc + jnp.arange(t_loc)
         mask = jnp.broadcast_to(kv_val[:, None, None, :],
                                 (b, 1, t_loc, t_loc))
         if causal:
             mask = mask & (q_pos[:, None] >= kv_pos[None, :])
-        pv, m_blk, l_blk = block_attn(q, k, v, mask, scale, compute_dtype)
+        return block_attn(q, k, v, mask, scale, compute_dtype)
+
+    def step(s, carry):
+        o, m, l, k, v, kv_val = carry  # noqa: E741
+        src = (idx - s) % n  # shard this KV block originated from
+        pv, m_blk, l_blk = block_partials(k, v, kv_val, src)
         o, m, l = merge_blocks(o, m, l, pv, m_blk, l_blk)  # noqa: E741
         # rotate KV (+validity) one hop; after n steps it is home again
         k = jax.lax.ppermute(k, axis_name, perm)
@@ -87,6 +100,7 @@ def ring_attention_shardmap(
     axis_name: str = SEQ_AXIS,
     causal: bool = True,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    backend: str = "einsum",
 ):
     """Un-jitted shard-mapped ring kernel ``(q, k, v, t_valid) → out``.
 
@@ -95,13 +109,17 @@ def ring_attention_shardmap(
     :func:`make_ring_attention`.
     """
     body = functools.partial(_ring_shard, axis_name=axis_name,
-                             causal=causal, compute_dtype=compute_dtype)
+                             causal=causal, compute_dtype=compute_dtype,
+                             backend=backend)
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name),
                   P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
+        # pallas_call defeats the varying-axes checker (same caveat as
+        # aggregator_core.shard_by_node)
+        check_vma=backend != "pallas",
     )
 
 
@@ -111,14 +129,18 @@ def make_ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = True,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    backend: str = "einsum",
 ):
     """→ jitted ``(q, k, v, t_valid) → out`` with T sharded over the mesh.
 
     Inputs are ``[B, T, H, D]`` (+ ``t_valid`` bool ``[B, T]``); T must
     divide by the ``axis_name`` mesh size. Output shards like q.
+    ``backend="pallas"`` computes each block partial with the fused VMEM
+    kernel (`ops.pallas_attention`); "einsum" lets XLA fuse the jnp path.
     """
     seq = NamedSharding(mesh, P(None, axis_name))
     shard = ring_attention_shardmap(mesh, axis_name=axis_name, causal=causal,
-                                    compute_dtype=compute_dtype)
+                                    compute_dtype=compute_dtype,
+                                    backend=backend)
     return jax.jit(shard, in_shardings=(seq, seq, seq, seq),
                    out_shardings=seq)
