@@ -62,8 +62,17 @@ class AggregatedZone:
                 prev = self._last[i]
                 if current >= prev:
                     delta = current - prev
-                else:  # wraparound of this subzone
+                elif prev - current > int(z.max_energy()) // 2:
+                    # genuine wraparound: counters wrap from near-max to
+                    # near-zero, so the regression spans most of the range
                     delta = (int(z.max_energy()) - prev) + current
+                else:
+                    # small regression = a stale reading (e.g. a batched
+                    # raw value sampled before a concurrent energy() call
+                    # advanced _last) — counting it as a wrap would inject
+                    # ~max_energy of phantom µJ; skip the window instead
+                    delta = 0
+                    current = prev  # keep the newer reading as the anchor
                 self._total += delta
             else:
                 # First read seeds the aggregate at the sum of current
@@ -84,14 +93,10 @@ class AggregatedZone:
         :meth:`energy_from_raw`'s expectation). Raises AttributeError when
         a subzone can't be batch-read — callers treat that as 'no fast
         path' and fall back to :meth:`energy`."""
+        per_zone = [z.energy_paths() for z in self._zones]
         if self._path_counts is None:
-            per_zone = [z.energy_paths() for z in self._zones]
             self._path_counts = [len(p) for p in per_zone]
-            return [p for zone_paths in per_zone for p in zone_paths]
-        paths: list[str] = []
-        for z in self._zones:
-            paths.extend(z.energy_paths())
-        return paths
+        return [p for zone_paths in per_zone for p in zone_paths]
 
     def energy_from_raw(self, values: Sequence[int]) -> Energy:
         """Combine raw batch-read subzone values with the same per-subzone

@@ -220,3 +220,29 @@ class TestZoneRank:
         assert zone_rank("core") < zone_rank("dram")
         assert zone_rank("dram") < zone_rank("uncore")
         assert zone_rank("mystery") > zone_rank("uncore")
+
+
+class TestAggregatedStaleReads:
+    def test_small_regression_is_not_a_wraparound(self):
+        """A stale batched reading slightly behind _last must contribute
+        zero delta, not a phantom near-max_energy wrap."""
+        from kepler_tpu.device.aggregated import AggregatedZone
+
+        z = FakeCounterZone("package", [1000, 5000, 4000, 6000],
+                           max_uj=2**32)
+        agg = AggregatedZone([z])
+        assert int(agg.energy()) == 1000  # seed
+        assert int(agg.energy()) == 5000  # +4000
+        assert int(agg.energy()) == 5000  # stale 4000 → delta 0, anchor 5000
+        assert int(agg.energy()) == 6000  # resumes from the newer anchor
+
+    def test_genuine_wraparound_still_detected(self):
+        from kepler_tpu.device.aggregated import AggregatedZone
+
+        max_uj = 1000
+        z = FakeCounterZone("package", [900, 100], max_uj=max_uj)
+        agg = AggregatedZone([z])
+        assert int(agg.energy()) == 900
+        # 900 → 100 with max 1000: wrap of (1000-900)+100 = 200
+        # (aggregate itself wraps at combined max 1000 → 1100 % 1000 = 100)
+        assert int(agg.energy()) == 100

@@ -516,3 +516,36 @@ class TestTemporalAggregator:
                          model_params=params)
         with pytest.raises(ValueError, match="t_max"):
             agg.init()
+
+
+class TestWireFuzz:
+    def test_random_mutations_never_crash(self):
+        """Any corrupted report must raise WireError/ValueError — never
+        segfault, hang, or propagate random exceptions into the server."""
+        rng = np.random.default_rng(0)
+        blob = bytearray(encode_report(make_report(w=6, z=3),
+                                       ["a", "b", "c"], seq=3))
+        for _ in range(300):
+            mutated = bytearray(blob)
+            for _ in range(rng.integers(1, 8)):
+                op = rng.integers(0, 3)
+                if op == 0 and len(mutated) > 1:  # flip byte
+                    mutated[rng.integers(0, len(mutated))] = rng.integers(
+                        0, 256)
+                elif op == 1 and len(mutated) > 8:  # truncate
+                    mutated = mutated[: rng.integers(1, len(mutated))]
+                else:  # append garbage
+                    mutated += bytes(rng.integers(0, 256, 16).tolist())
+            try:
+                report, header = decode_report(bytes(mutated))
+            except (WireError, ValueError):
+                continue
+            # a mutation that still decodes must yield a well-formed report
+            assert len(report.workload_ids) == report.cpu_deltas.shape[0]
+            assert report.zone_deltas_uj.shape == report.zone_valid.shape
+
+    def test_truncation_sweep_never_crashes(self):
+        blob = encode_report(make_report(), ["package", "dram"])
+        for n in range(len(blob)):
+            with pytest.raises((WireError, ValueError)):
+                decode_report(blob[:n])
