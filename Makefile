@@ -29,6 +29,10 @@ test-verbose:
 bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
 	$(PYTHON) bench.py
 
+.PHONY: bench-scenarios
+bench-scenarios: ## all five BASELINE.json config scenarios (JSON per line)
+	$(PYTHON) benchmarks/scenarios.py
+
 .PHONY: dryrun
 dryrun: ## compile-check driver entry points on a virtual 8-device mesh
 	$(PYTHON) __graft_entry__.py
