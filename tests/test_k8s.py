@@ -1,0 +1,211 @@
+"""Pod informer against a scripted KubeClient — no cluster needed (the
+reference's mock_utils_test.go strategy: fake the cache/manager layer and
+test index extraction, incl. containerd:// stripping and init/ephemeral
+containers; pod_test.go:433)."""
+
+import io
+import json
+import threading
+
+import pytest
+
+from kepler_tpu.k8s.pod import PodInformer, _strip_scheme
+from kepler_tpu.service.lifecycle import CancelContext
+
+UID_A = "aaaaaaaa-0000-0000-0000-000000000001"
+UID_B = "bbbbbbbb-0000-0000-0000-000000000002"
+
+
+def pod_obj(uid, name, namespace="default", containers=(), init=(),
+            ephemeral=(), rv="1"):
+    def statuses(specs):
+        return [{"name": n, "containerID": cid} for n, cid in specs]
+
+    return {
+        "metadata": {"uid": uid, "name": name, "namespace": namespace,
+                     "resourceVersion": rv},
+        "status": {
+            "containerStatuses": statuses(containers),
+            "initContainerStatuses": statuses(init),
+            "ephemeralContainerStatuses": statuses(ephemeral),
+        },
+    }
+
+
+class ScriptedClient:
+    """Replays canned list/watch responses; records requested paths."""
+
+    def __init__(self, list_response, watch_events=()):
+        self.list_response = list_response
+        self.watch_events = list(watch_events)
+        self.paths = []
+
+    def get(self, path, timeout=30.0):
+        self.paths.append(path)
+        if "watch=true" in path:
+            body = b"".join(json.dumps(e).encode() + b"\n"
+                            for e in self.watch_events)
+        else:
+            body = json.dumps(self.list_response).encode()
+        return io.BytesIO(body)
+
+
+def make_informer(list_response, watch_events=()):
+    client = ScriptedClient(list_response, watch_events)
+    inf = PodInformer("node-1", client=client)
+    inf.init()
+    return inf, client
+
+
+class TestStripScheme:
+    @pytest.mark.parametrize("raw,want", [
+        ("containerd://abc123", "abc123"),
+        ("docker://deadbeef", "deadbeef"),
+        ("cri-o://ffff", "ffff"),
+        ("abc123", "abc123"),  # no scheme
+        ("", ""),
+    ])
+    def test_strip(self, raw, want):
+        assert _strip_scheme(raw) == want
+
+
+class TestRelist:
+    def test_indexes_all_container_classes(self):
+        inf, _ = make_informer({
+            "metadata": {"resourceVersion": "41"},
+            "items": [pod_obj(
+                UID_A, "web", "prod",
+                containers=[("app", "containerd://c-app")],
+                init=[("init-db", "containerd://c-init")],
+                ephemeral=[("debugger", "containerd://c-dbg")])],
+        })
+        for cid, cname in (("c-app", "app"), ("c-init", "init-db"),
+                           ("c-dbg", "debugger")):
+            got = inf.lookup_by_container_id(cid)
+            assert got == (UID_A, "web", "prod", cname), cid
+
+    def test_unknown_container_returns_none(self):
+        inf, _ = make_informer({"items": []})
+        assert inf.lookup_by_container_id("nope") is None
+
+    def test_node_field_selector_in_path(self):
+        _, client = make_informer({"items": []})
+        assert "fieldSelector=spec.nodeName%3Dnode-1" in client.paths[0]
+
+    def test_containers_without_id_skipped(self):
+        inf, _ = make_informer({
+            "items": [pod_obj(UID_A, "web",
+                              containers=[("pending", ""),
+                                          ("up", "docker://c-up")])],
+        })
+        assert inf.lookup_by_container_id("c-up") is not None
+        assert inf.lookup_by_container_id("") is None
+
+    def test_relist_replaces_stale_index(self):
+        inf, client = make_informer({
+            "items": [pod_obj(UID_A, "old",
+                              containers=[("a", "containerd://c-old")])],
+        })
+        client.list_response = {
+            "items": [pod_obj(UID_B, "new",
+                              containers=[("b", "containerd://c-new")])],
+        }
+        inf.relist()
+        assert inf.lookup_by_container_id("c-old") is None
+        assert inf.lookup_by_container_id("c-new") == (
+            UID_B, "new", "default", "b")
+
+
+class TestWatch:
+    def run_watch(self, inf):
+        ctx = CancelContext()
+        t = threading.Thread(target=inf._watch, args=(ctx,))
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        ctx.cancel()
+
+    def test_added_and_deleted_events(self):
+        inf, client = make_informer({"items": []})
+        client.watch_events = [
+            {"type": "ADDED", "object": pod_obj(
+                UID_A, "web", containers=[("app", "containerd://c1")],
+                rv="43")},
+            {"type": "DELETED", "object": pod_obj(
+                UID_A, "web", containers=[("app", "containerd://c1")],
+                rv="44")},
+            {"type": "ADDED", "object": pod_obj(
+                UID_B, "db", containers=[("pg", "containerd://c2")],
+                rv="45")},
+        ]
+        self.run_watch(inf)
+        assert inf.lookup_by_container_id("c1") is None
+        assert inf.lookup_by_container_id("c2") == (
+            UID_B, "db", "default", "pg")
+        assert inf._resource_version == "45"
+
+    def test_modified_rebinds_containers(self):
+        """A restarted container gets a new ID; the old one must unbind."""
+        inf, client = make_informer({
+            "items": [pod_obj(UID_A, "web",
+                              containers=[("app", "containerd://gen1")])],
+        })
+        client.watch_events = [
+            {"type": "MODIFIED", "object": pod_obj(
+                UID_A, "web", containers=[("app", "containerd://gen2")],
+                rv="50")},
+        ]
+        self.run_watch(inf)
+        assert inf.lookup_by_container_id("gen1") is None
+        assert inf.lookup_by_container_id("gen2") == (
+            UID_A, "web", "default", "app")
+
+    def test_garbage_frames_skipped(self):
+        inf, client = make_informer({"items": []})
+        good = json.dumps({"type": "ADDED", "object": pod_obj(
+            UID_A, "web", containers=[("app", "containerd://ok")])})
+
+        class GarbageClient(ScriptedClient):
+            def get(self, path, timeout=30.0):
+                if "watch=true" in path:
+                    return io.BytesIO(b"{not json}\n" + good.encode()
+                                      + b"\n")
+                return super().get(path, timeout)
+
+        inf._client = GarbageClient({"items": []})
+        self.run_watch(inf)
+        assert inf.lookup_by_container_id("ok") is not None
+
+    def test_watch_path_carries_resource_version(self):
+        inf, client = make_informer({
+            "metadata": {"resourceVersion": "99"}, "items": [],
+        })
+        self.run_watch(inf)
+        watch_paths = [p for p in client.paths if "watch=true" in p]
+        assert watch_paths and "resourceVersion=99" in watch_paths[0]
+
+
+class TestResourceLayerIntegration:
+    def test_informer_feeds_pod_lookup(self):
+        """ResourceInformer resolves container → pod via the k8s index
+        (reference refreshPods → LookupByContainerID)."""
+        from kepler_tpu.resource import ResourceInformer
+        from tests.test_resource import CID_A, MockProc, MockReader
+
+        pod_inf, _ = make_informer({
+            "items": [pod_obj(
+                UID_A, "web", "prod",
+                containers=[("app", f"containerd://{CID_A}")])],
+        })
+        procs = [MockProc(10, cpu=3.0, cgroups=[
+            f"/kubepods.slice/cri-containerd-{CID_A}.scope"])]
+        informer = ResourceInformer(
+            reader=MockReader(procs),
+            pod_lookup=pod_inf)
+        informer.refresh()
+        procs[0].cpu = 5.0
+        informer.refresh()
+        pods = informer.pods().running
+        assert len(pods) == 1
+        pod = next(iter(pods.values()))
+        assert (pod.id, pod.name, pod.namespace) == (UID_A, "web", "prod")
