@@ -90,24 +90,39 @@ def make_train_step(
     return train_step
 
 
-def make_temporal_train_step(
+def temporal_step_fn(
     optimizer: optax.GradientTransformation,
+    compute_dtype=None,
+    attention_fn: Callable | None = None,
+    remat: bool = False,
 ) -> Callable:
-    """Train step for the TEMPORAL estimator (history-window inputs).
+    """UNJITTED temporal train-step body — the single definition the local
+    (:func:`make_temporal_train_step`) and sequence-parallel
+    (``parallel.sequence.make_sequence_parallel_train_step``) variants jit
+    with their own shardings.
 
-    (state, feat_hist [.., W, T, F], workload_valid [.., W],
-    t_valid [.., W, T], target_watts [.., W, Z]) → (state, loss).
-    Targets are the current tick's RAPL-ratio watts — the model learns to
-    reproduce them from the trajectory (same labels as the single-tick
-    models, richer conditioning).
+    ``attention_fn`` is the trunk's plug-in seam (None = dense causal;
+    the SP variant passes the shard-mapped ring kernel). ``remat`` wraps
+    the forward in ``jax.checkpoint`` (recompute activations in backward —
+    the FLOPs-for-memory trade for long windows).
     """
+    import jax.numpy as jnp
+
     from kepler_tpu.models.temporal import predict_temporal
 
-    @jax.jit
+    cd = jnp.bfloat16 if compute_dtype is None else compute_dtype
+
+    def forward(params, feat_hist, workload_valid, t_valid):
+        return predict_temporal(params, feat_hist, workload_valid, t_valid,
+                                clamp=False, compute_dtype=cd,
+                                attention_fn=attention_fn)
+
+    if remat:
+        forward = jax.checkpoint(forward)
+
     def train_step(state, feat_hist, workload_valid, t_valid, target_watts):
         def loss_fn(params):
-            pred = predict_temporal(params, feat_hist, workload_valid,
-                                    t_valid, clamp=False)
+            pred = forward(params, feat_hist, workload_valid, t_valid)
             return masked_mse(pred, target_watts, workload_valid)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -117,6 +132,21 @@ def make_temporal_train_step(
         return TrainState(params, opt_state, state.step + 1), loss
 
     return train_step
+
+
+def make_temporal_train_step(
+    optimizer: optax.GradientTransformation,
+    compute_dtype=None,
+) -> Callable:
+    """Train step for the TEMPORAL estimator (history-window inputs).
+
+    (state, feat_hist [.., W, T, F], workload_valid [.., W],
+    t_valid [.., W, T], target_watts [.., W, Z]) → (state, loss).
+    Targets are the current tick's RAPL-ratio watts — the model learns to
+    reproduce them from the trajectory (same labels as the single-tick
+    models, richer conditioning).
+    """
+    return jax.jit(temporal_step_fn(optimizer, compute_dtype))
 
 
 def fit(

@@ -31,7 +31,10 @@ from kepler_tpu.parallel.ring import (
     full_attention,
     make_ring_attention,
 )
-from kepler_tpu.parallel.sequence import make_temporal_program
+from kepler_tpu.parallel.sequence import (
+    make_sequence_parallel_train_step,
+    make_temporal_program,
+)
 from kepler_tpu.parallel.trainer import (
     make_distributed_train_step,
     mlp_param_shardings,
@@ -49,6 +52,7 @@ __all__ = [
     "make_temporal_fleet_program",
     "temporal_fleet_program",
     "make_ring_attention",
+    "make_sequence_parallel_train_step",
     "make_temporal_program",
     "top1_route",
     "FleetBatch",

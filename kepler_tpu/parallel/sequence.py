@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kepler_tpu.models.temporal import TemporalParams, predict_temporal
@@ -48,3 +49,41 @@ def make_temporal_program(
 
     return jax.jit(fn, in_shardings=(rep, hist, rep, hist),
                    out_shardings=rep)
+
+
+def make_sequence_parallel_train_step(
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    *,
+    axis_name: str = SEQ_AXIS,
+    compute_dtype: jnp.dtype = jnp.float32,
+    remat: bool = False,
+):
+    """Long-context TRAINING: gradients flow through ring attention.
+
+    → jitted ``(state, feat_hist [W, T, F], workload_valid [W],
+    t_valid [W, T], target_watts [W, Z]) → (state, loss)`` with T sharded
+    over ``axis_name`` — the backward pass reverses the KV ring (ppermute's
+    transpose is the opposite rotation; the blockwise fori_loop has a
+    static trip count, so it lowers to a differentiable scan).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: activations of
+    the trunk recompute in the backward instead of living in HBM for the
+    whole window — the standard FLOPs-for-memory trade once T is long.
+
+    The input ``state`` is DONATED (its buffers are reused for the updated
+    state, halving optimizer memory) — do not read it after the call;
+    step repeatedly as ``state, loss = step(state, ...)``. The step body
+    is `models.train.temporal_step_fn` — identical maths to the local
+    :func:`make_temporal_train_step`, jitted here with seq shardings.
+    """
+    from kepler_tpu.models.train import temporal_step_fn
+
+    hist = NamedSharding(mesh, P(None, axis_name))
+    rep = NamedSharding(mesh, P())
+    ring = ring_attention_shardmap(mesh, axis_name=axis_name, causal=True,
+                                   compute_dtype=compute_dtype)
+    step = temporal_step_fn(optimizer, compute_dtype, attention_fn=ring,
+                            remat=remat)
+    return jax.jit(step, in_shardings=(None, hist, rep, hist, rep),
+                   donate_argnums=(0,))
