@@ -30,7 +30,7 @@ bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
 	$(PYTHON) bench.py
 
 .PHONY: bench-scenarios
-bench-scenarios: ## all five BASELINE.json config scenarios (JSON per line)
+bench-scenarios: ## five BASELINE.json scenarios + temporal-fleet (JSON per line)
 	$(PYTHON) benchmarks/scenarios.py
 
 .PHONY: dryrun

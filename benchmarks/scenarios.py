@@ -10,8 +10,14 @@ for the TPU build. One JSON line per scenario, same shape as the headline
   4 mlp-estimator         model-mode node, MLP estimator
   5 cluster-mixed         1k nodes × ~100 pods, ratio+MLP mixed (headline)
 
-All scenarios run the packed-transfer path (`parallel/packed.py`) end to
-end: pack → ONE H2D → fused program → ONE f16 D2H → unpack. The extra
+plus one extension row beyond BASELINE's list:
+
+  6 temporal-fleet        mixed fleet with [N, W, T, F] feature-history
+                          windows through the temporal attention program
+
+The five BASELINE scenarios run the packed-transfer path
+(`parallel/packed.py`) end to end: pack → ONE H2D → fused program → ONE
+f16 D2H → unpack. The extra
 ``device_p50_ms``/``sync_floor_p50_ms`` fields separate program cost from
 the platform's fixed RPC latency (dominant on a network-tunnelled chip).
 
@@ -74,6 +80,56 @@ SCENARIOS = [
     ("mlp-estimator", 1, 128, 4, 1, "mlp", False),
     ("cluster-mixed", 1024, 128, 4, -1, "mlp", True),
 ]
+
+HISTORY_T = 16  # temporal scenario: ticks of feature history per workload
+
+
+def run_temporal_scenario(mesh, backend, percentiles, iters):
+    """Extension beyond the five BASELINE configs: the temporal estimator
+    over a mixed fleet — [N, W, T, F] history windows through the
+    dedicated fleet program. Same measurement contract as the five
+    BASELINE rows: full-path timings re-transfer the host batch per
+    iteration; device_* timings run with every input device-resident."""
+    import jax
+    import jax.numpy as jnp
+
+    from kepler_tpu.models import init_temporal
+    from kepler_tpu.models.features import NUM_FEATURES
+    from kepler_tpu.parallel import make_temporal_fleet_program
+    from kepler_tpu.parallel.aggregator_core import run_fleet_attribution
+
+    n, w, z = 256, 64, 4
+    batch = make_batch(n, w, z, -1)
+    rng = np.random.default_rng(1)
+    hist = rng.uniform(0, 2, (n, w, HISTORY_T, NUM_FEATURES)).astype(
+        np.float32)
+    tv = np.ones((n, w, HISTORY_T), bool)
+    params = init_temporal(jax.random.PRNGKey(0), z, t_max=HISTORY_T)
+    program = make_temporal_fleet_program(mesh, backend=backend)
+
+    def step():  # full path: host batch + windows re-transferred per iter
+        jax.block_until_ready(run_fleet_attribution(
+            program, batch, params, hist, tv))
+
+    dev_args = jax.tree.map(jnp.asarray, (
+        params, batch.zone_deltas_uj, batch.zone_valid, batch.usage_ratio,
+        batch.cpu_deltas, batch.workload_valid, batch.node_cpu_delta,
+        batch.dt_s, batch.mode, hist, tv))
+
+    def device_step():  # inputs resident: the program cost alone
+        jax.block_until_ready(program(*dev_args))
+
+    p99, p50 = percentiles(step, iters)
+    dev_p99, dev_p50 = percentiles(device_step, iters)
+    return {
+        "scenario": "temporal-fleet",
+        "p99_ms": round(p99, 4), "p50_ms": round(p50, 4),
+        "device_p99_ms": round(dev_p99, 4),
+        "device_p50_ms": round(dev_p50, 4),
+        "nodes": n, "pods": n * w,
+        "pods_per_sec": round(n * w / (p50 / 1e3)),
+        "history_ticks": HISTORY_T,
+    }
 
 
 def main() -> None:
@@ -142,6 +198,11 @@ def main() -> None:
             "platform": platform,
             "backend": args.backend,
         }))
+
+    out = run_temporal_scenario(mesh, args.backend, percentiles,
+                                args.iters)
+    out.update({"platform": platform, "backend": args.backend})
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
