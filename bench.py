@@ -175,6 +175,11 @@ def main() -> None:
         "device_p99_ms": round(dev_p99, 4),  # compute-only (north-star op)
         "device_p50_ms": round(dev_p50, 4),
         "sync_floor_p50_ms": round(floor_p50, 4),  # cost of ONE empty sync
+        # the attribution program's own cost, floor-subtracted: on a
+        # network-tunnelled dev chip this is the only visible estimate of
+        # the north-star quantity (on locally-attached TPU, device_p50
+        # itself is the measurement)
+        "program_p50_ms_est": round(max(0.0, dev_p50 - floor_p50), 4),
         "pods": pods,
         "nodes": N_NODES,
         "pods_per_sec": round(pods / (p50 / 1e3)),

@@ -33,6 +33,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         print(f"error: {err}", file=sys.stderr)
         return 1
     new_logger(cfg.log.level, cfg.log.format)
+    # multi-host DCN: if JAX_COORDINATOR_ADDRESS is set, join the cluster
+    # BEFORE any jax API initialises the backend (no-op single-host)
+    from kepler_tpu.parallel import initialize_multihost
+
+    initialize_multihost()
     info = version.info()
     log.info("kepler-tpu aggregator %s (%s, %s)", info.version,
              info.python_version, info.platform)

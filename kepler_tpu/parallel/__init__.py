@@ -20,7 +20,12 @@ from kepler_tpu.parallel.expert import (
     make_expert_parallel_moe,
     top1_route,
 )
-from kepler_tpu.parallel.mesh import MODEL_AXIS, NODE_AXIS, make_mesh
+from kepler_tpu.parallel.mesh import (
+    MODEL_AXIS,
+    NODE_AXIS,
+    initialize_multihost,
+    make_mesh,
+)
 from kepler_tpu.parallel.pipeline import (
     STAGE_AXIS,
     make_pipeline,
@@ -66,6 +71,7 @@ __all__ = [
     "fleet_attribution_program",
     "make_distributed_train_step",
     "make_fleet_program",
+    "initialize_multihost",
     "make_mesh",
     "mlp_param_shardings",
     "run_fleet_attribution",
