@@ -287,8 +287,11 @@ class Config:
 # ---------------------------------------------------------------------------
 
 # YAML key → (section attr, field attr) spelling map for keys whose YAML name
-# differs from the Python attribute (mirrors reference yaml tags).
-_YAML_KEYS: dict[str, str] = {
+# differs from the Python attribute (mirrors reference yaml tags). Every
+# multi-word key accepts BOTH the reference-style camelCase spelling and the
+# kebab-case spelling matching its CLI flag, so a flag line can be pasted
+# into YAML without a spelling surprise.
+_CANONICAL_YAML_KEYS: dict[str, str] = {
     "configFile": "config_file",
     "listenAddresses": "listen_addresses",
     "maxTerminated": "max_terminated",
@@ -296,10 +299,8 @@ _YAML_KEYS: dict[str, str] = {
     "debugCollectors": "debug_collectors",
     "metricsLevel": "metrics_level",
     "nodeName": "node_name",
-    "fake-cpu-meter": "fake_cpu_meter",
     "listenAddress": "listen_address",
     "staleAfter": "stale_after",
-    "stale-after": "stale_after",
     "paramsPath": "params_path",
     "tlsSkipVerify": "tls_skip_verify",
     "nodeMode": "node_mode",
@@ -308,10 +309,20 @@ _YAML_KEYS: dict[str, str] = {
     "meshShape": "mesh_shape",
     "meshAxes": "mesh_axes",
     "fleetBackend": "fleet_backend",
-    "fleet-backend": "fleet_backend",
     "historyWindow": "history_window",
     "trainingDumpDir": "training_dump_dir",
     "trainingDumpMaxFiles": "training_dump_max_files",
+    "fakeCpuMeter": "fake_cpu_meter",
+}
+
+
+def _kebab(camel: str) -> str:
+    return "".join("-" + c.lower() if c.isupper() else c for c in camel)
+
+
+_YAML_KEYS: dict[str, str] = {
+    **_CANONICAL_YAML_KEYS,
+    **{_kebab(k): v for k, v in _CANONICAL_YAML_KEYS.items()},
 }
 
 _DURATION_FIELDS = {"interval", "staleness", "stale_after"}

@@ -11,8 +11,12 @@ first read — each subzone's wrap is detected and corrected independently
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from typing import Sequence
+
+_log = logging.getLogger("kepler.device")
 
 from kepler_tpu.device.energy import Energy
 from kepler_tpu.device.meter import EnergyZone
@@ -28,6 +32,7 @@ class AggregatedZone:
         self._name = zones[0].name()
         self._lock = threading.Lock()
         self._last: dict[int, int] = {}  # per-zone previous raw reading
+        self._warn_logged = float("-inf")  # stale-read warning throttle
         self._total: int = 0  # accumulated aggregate µJ
         self._path_counts: list[int] | None = None  # per-subzone, cached
 
@@ -70,7 +75,25 @@ class AggregatedZone:
                     # small regression = a stale reading (e.g. a batched
                     # raw value sampled before a concurrent energy() call
                     # advanced _last) — counting it as a wrap would inject
-                    # ~max_energy of phantom µJ; skip the window instead
+                    # ~max_energy of phantom µJ; skip the window instead.
+                    # Ambiguity caveat: a GENUINE wrap where the subzone
+                    # accumulated more than max_energy/2 between reads
+                    # (~430 W sustained on a 2^32 µJ zone at a 5 s
+                    # interval) is indistinguishable and also lands here,
+                    # undercounting one wrap — hence the (throttled)
+                    # warning, so sustained-high-power fleets can detect
+                    # the miscount. Concurrent-reader races hit this
+                    # branch benignly, so throttle to one line per 30 s.
+                    now = time.monotonic()
+                    if now - self._warn_logged >= 30.0:
+                        self._warn_logged = now
+                        _log.warning(
+                            "zone %s subzone %d: counter regressed %d µJ "
+                            "(< half max_energy %d); treating as stale "
+                            "read and dropping the window — if this node "
+                            "sustains >max_energy/2 per interval, raise "
+                            "the read rate", self._name, i,
+                            prev - current, int(z.max_energy()))
                     delta = 0
                     current = prev  # keep the newer reading as the anchor
                 self._total += delta

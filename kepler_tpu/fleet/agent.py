@@ -22,6 +22,7 @@ import socket
 import ssl
 import threading
 import urllib.parse
+import uuid
 
 from kepler_tpu.fleet.wire import encode_report
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
@@ -51,6 +52,7 @@ class FleetAgent:
             maxlen=queue_max)
         self._wake = threading.Event()
         self._seq = 0
+        self._run_nonce = uuid.uuid4().hex[:16]  # identifies this agent run
         self._drop_logged = 0.0
         u = urllib.parse.urlsplit(endpoint if "//" in endpoint
                                   else f"http://{endpoint}")
@@ -130,7 +132,8 @@ class FleetAgent:
             workload_kinds=batch.kinds,
         )
         self._seq += 1
-        body = encode_report(report, list(sample.zone_names), seq=self._seq)
+        body = encode_report(report, list(sample.zone_names), seq=self._seq,
+                             run=self._run_nonce)
         if self._tls:
             conn = http.client.HTTPSConnection(
                 self._host, self._port, timeout=self._timeout,

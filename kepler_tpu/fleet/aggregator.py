@@ -72,6 +72,7 @@ class _Stored:
     zone_names: tuple[str, ...]
     received: float
     seq: int
+    run: str = ""  # agent-run nonce (empty for pre-nonce agents)
 
 
 class Aggregator:
@@ -192,20 +193,30 @@ class Aggregator:
         stored = _Stored(report=report,
                          zone_names=tuple(header["zone_names"]),
                          received=self._clock(),
-                         seq=int(header.get("seq", 0)))
+                         seq=int(header.get("seq", 0)),
+                         run=str(header.get("run", "")))
         with self._lock:
             prev = self._reports.get(report.node_name)
-            # tolerate agent restarts (seq resets); reject only stale
-            # reordering within one agent run
-            if prev is None or stored.seq >= prev.seq or stored.seq == 1:
+            # When BOTH sides carry a run nonce the cases are unambiguous:
+            # different nonce = fresh agent process (restart), same nonce +
+            # seq regression = network reorder (reject). Pre-nonce agents
+            # fall back to the seq==1 heuristic for restarts.
+            has_nonces = (prev is not None and bool(stored.run)
+                          and bool(prev.run))
+            restarted = has_nonces and stored.run != prev.run
+            legacy = prev is not None and not has_nonces
+            if (prev is None or restarted or stored.seq >= prev.seq
+                    or (legacy and stored.seq == 1)):
                 self._reports[report.node_name] = stored
                 # history push is NOT idempotent (a dup would shift the
-                # window) → require a seq CHANGE, not >=; and ratio nodes'
-                # estimator output is always discarded, so skip their
-                # windows entirely
+                # window) → require a seq change OR a run change (an agent
+                # restart that happens to re-send the previous run's seq
+                # value is still a new window); and ratio nodes' estimator
+                # output is always discarded, so skip their windows
                 if (self._model_mode == "temporal"
                         and report.mode == MODE_MODEL
-                        and (prev is None or stored.seq != prev.seq)):
+                        and (prev is None or restarted
+                             or stored.seq != prev.seq)):
                     self._push_history(report)
             self._stats["reports_total"] += 1
         return 204, {}, b""

@@ -32,7 +32,7 @@ _DTYPES = {"float32": np.float32, "float64": np.float64,
 
 
 def encode_report(report: NodeReport, zone_names: list[str],
-                  seq: int = 0) -> bytes:
+                  seq: int = 0, run: str = "") -> bytes:
     """Serialize one node's window for the POST /v1/report body."""
     arrays: list[tuple[str, np.ndarray]] = [
         ("zone_deltas_uj", np.ascontiguousarray(
@@ -46,6 +46,9 @@ def encode_report(report: NodeReport, zone_names: list[str],
     header = {
         "v": 1,
         "seq": seq,
+        # per-agent-run nonce: lets the aggregator tell a restarted agent
+        # re-sending the same seq value apart from a retransmission
+        "run": run,
         "node_name": report.node_name,
         "zone_names": list(zone_names),
         "usage_ratio": float(report.usage_ratio),

@@ -104,11 +104,12 @@ def server():
     s.shutdown()
 
 
-def post_report(server, report, zones=("package", "dram"), seq=1):
+def post_report(server, report, zones=("package", "dram"), seq=1, run=""):
     host, port = server.addresses[0]
     req = urllib.request.Request(
         f"http://{host}:{port}/v1/report",
-        data=encode_report(report, list(zones), seq=seq), method="POST")
+        data=encode_report(report, list(zones), seq=seq, run=run),
+        method="POST")
     return urllib.request.urlopen(req, timeout=5)
 
 
@@ -495,6 +496,45 @@ class TestTemporalAggregator:
         agg.init()
         for _ in range(2):  # LB retry redelivers the same seq
             post_report(server, make_report("node-a", mode=MODE_MODEL), seq=1)
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, False, False, False]
+
+    def test_restart_with_same_seq_still_pushes_history(self, server):
+        # an agent restart that re-sends the previous run's seq value must
+        # advance the temporal window (a new run nonce marks the restart)
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=1, run="run-1")
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=1, run="run-2")  # restarted agent, same seq
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, True, False, False]
+
+    def test_same_run_reordered_first_seq_rejected(self, server):
+        # a network-duplicated copy of seq=1 arriving after seq=3 within
+        # ONE run is a reorder, not a restart: it must neither regress the
+        # stored report nor re-push the temporal window
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        for seq in (1, 2, 3):
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=seq, run="run-1")
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=1, run="run-1")  # late duplicate of the first
+        assert agg._reports["node-a"].seq == 3
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, True, True, False]
+
+    def test_same_run_duplicate_seq_not_pushed_twice(self, server):
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        for _ in range(2):  # retransmission within ONE run
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=1, run="run-1")
         _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, False, False, False]
 
