@@ -30,7 +30,7 @@ bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
 	$(PYTHON) bench.py
 
 .PHONY: bench-scenarios
-bench-scenarios: ## five BASELINE.json scenarios + temporal-fleet (JSON per line)
+bench-scenarios: ## five BASELINE.json scenarios + temporal-fleet; budget GATE (exits nonzero on regression)
 	$(PYTHON) benchmarks/scenarios.py
 
 .PHONY: dryrun

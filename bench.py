@@ -111,7 +111,6 @@ def make_batch(n_nodes, n_workloads, pods_lo, pods_hi, seed=0):
 
 def main() -> None:
     jax, platform = _init_jax_with_timeout()
-    import functools
 
     import jax.numpy as jnp
     import numpy as np
@@ -141,59 +140,17 @@ def main() -> None:
     n_warm, n_iter = (5, 50) if on_tpu else (1, 10)
     n_iter = int(os.environ.get("KEPLER_BENCH_ITERS", n_iter))
 
+    from benchmarks.timing import measure_program_slopes, percentiles as _pct
+
     def percentiles(fn, warm=n_warm, iters=n_iter):
-        for _ in range(warm):  # warmup + compile
-            fn()
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            fn()
-            times.append((time.perf_counter() - t0) * 1e3)
-        times.sort()
-        return (times[math.ceil(0.99 * len(times)) - 1],  # nearest-rank p99
-                times[len(times) // 2])
+        return _pct(fn, warm, iters)
 
     # ---- headline: measured device program latency via loop slope -------
-    # K attribution steps inside ONE jitted fori_loop; the body feeds a
-    # runtime-zero function of the output back into the input (watts ≥ 0 ⇒
-    # min(Σwatts, 0) == 0, but XLA can't prove it), so every iteration
-    # depends on the previous one and nothing hoists. Timing the loop at
-    # two trip counts and taking the slope cancels the fixed dispatch/RPC
-    # cost exactly. The spread (k_hi − k_lo) × program_time must clear the
-    # tunnel's per-dispatch RPC jitter (± a few ms).
+    # (benchmarks/timing.py: two-trip-count fori_loop slope, value-fetch
+    # syncs; cancels the tunnel's fixed ~66 ms dispatch cost exactly)
     def measure_slopes(prog, packed, k_lo, k_hi, repeats):
-        """→ sorted ms-per-iteration slope samples for ``prog``."""
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def loop(model_params, packed, k):
-            def body(_, carry):
-                packed, acc = carry
-                out = prog(model_params, packed)
-                s = out.astype(jnp.float32).sum()
-                packed = packed + jnp.minimum(s, 0.0)
-                return packed, acc + s
-
-            return jax.lax.fori_loop(0, k, body, (packed, jnp.float32(0)))
-
-        def timed(packed, k):
-            t0 = time.perf_counter()
-            packed, acc = loop(params, packed, jnp.int32(k))
-            float(acc)  # scalar D2H: the only reliable sync on a
-            # tunnelled remote platform (block_until_ready can return
-            # with work still queued)
-            return packed, (time.perf_counter() - t0) * 1e3
-
-        # compile+warm both trip counts (k is traced → one compile),
-        # then alternate lo/hi measurements
-        packed, _ = timed(packed, k_lo)
-        packed, _ = timed(packed, k_hi)
-        slopes = []
-        for _ in range(repeats):
-            packed, t_lo = timed(packed, k_lo)
-            packed, t_hi = timed(packed, k_hi)
-            slopes.append(max(0.0, (t_hi - t_lo) / (k_hi - k_lo)))
-        slopes.sort()
-        return slopes
+        return measure_program_slopes(prog, params, (packed,), k_lo, k_hi,
+                                      repeats)
 
     k_lo, k_hi = (32, 2048) if on_tpu else (2, 10)
     n_slope = int(os.environ.get("KEPLER_BENCH_SLOPE_REPEATS",

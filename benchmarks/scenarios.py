@@ -1,8 +1,7 @@
-"""The five BASELINE.json benchmark scenarios.
+"""The five BASELINE.json benchmark scenarios — now a GATE, not a printout.
 
 The reference publishes no numbers (SURVEY §6) — this suite defines them
-for the TPU build. One JSON line per scenario, same shape as the headline
-``bench.py`` metric:
+for the TPU build. One JSON line per scenario:
 
   1 single-zone-ratio     1 node, package zone only (bare-metal minimal)
   2 multi-zone-ratio      1 node, package/core/dram/uncore
@@ -15,28 +14,48 @@ plus one extension row beyond BASELINE's list:
   6 temporal-fleet        mixed fleet with [N, W, T, F] feature-history
                           windows through the temporal attention program
 
-The five BASELINE scenarios run the packed-transfer path
-(`parallel/packed.py`) end to end: pack → ONE H2D → fused program → ONE
-f16 D2H → unpack. The extra
-``device_p50_ms``/``sync_floor_p50_ms`` fields separate program cost from
-the platform's fixed RPC latency (dominant on a network-tunnelled chip).
+Measurement: the device-program cost comes from the two-trip-count
+fori_loop slope (benchmarks/timing.py — cancels the tunnel's fixed
+dispatch cost); the e2e figures include the packed H2D/D2H legs.
 
-Usage: ``python benchmarks/scenarios.py [--iters N]``
+Teeth (exit non-zero on violation):
+  * every scenario carries a device-latency BUDGET derived from the
+    north-star (<1 ms for the cluster shapes, tighter for single-node);
+    budgets gate on real TPU — on CPU hosts they scale by --cpu-factor.
+  * with --backend pallas, each scenario also measures the einsum
+    baseline and fails if the pallas path regresses past --max-vs-einsum.
+
+Usage: ``python benchmarks/scenarios.py [--iters N] [--backend B]``
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))  # runnable from any cwd
+
+from benchmarks.timing import measure_program_slopes, percentiles
+
+HISTORY_T = 16  # temporal scenario: ticks of feature history per workload
+
+# (name, nodes, workloads, zones, mode, model, ragged, device_budget_ms)
+# Budgets: north star is <1 ms for 10k pods / 1k nodes; single-node rows
+# get 0.5 ms (they are strictly smaller programs); the temporal program
+# does attention over T=16 windows → 5 ms.
+SCENARIOS = [
+    ("single-zone-ratio", 1, 128, 1, 0, None, False, 0.5),
+    ("multi-zone-ratio", 1, 128, 4, 0, None, False, 0.5),
+    ("linear-no-rapl", 1, 128, 4, 1, "linear", False, 0.5),
+    ("mlp-estimator", 1, 128, 4, 1, "mlp", False, 0.5),
+    ("cluster-mixed", 1024, 128, 4, -1, "mlp", True, 1.0),
+]
+TEMPORAL_BUDGET_MS = 5.0
 
 
 def make_batch(n_nodes: int, n_workloads: int, n_zones: int, mode: int,
@@ -72,24 +91,25 @@ def make_batch(n_nodes: int, n_workloads: int, n_zones: int, mode: int,
     )
 
 
-SCENARIOS = [
-    # (name, nodes, workloads, zones, mode, model, ragged)
-    ("single-zone-ratio", 1, 128, 1, 0, None, False),
-    ("multi-zone-ratio", 1, 128, 4, 0, None, False),
-    ("linear-no-rapl", 1, 128, 4, 1, "linear", False),
-    ("mlp-estimator", 1, 128, 4, 1, "mlp", False),
-    ("cluster-mixed", 1024, 128, 4, -1, "mlp", True),
-]
+def slope_for(mesh, batch, w, z, model, backend, k_pair, repeats, params):
+    """Median device-program ms/iteration for one packed configuration."""
+    import jax.numpy as jnp
 
-HISTORY_T = 16  # temporal scenario: ticks of feature history per workload
+    from kepler_tpu.parallel.packed import (make_packed_fleet_program,
+                                            pack_fleet_inputs)
+
+    program = make_packed_fleet_program(
+        mesh, n_workloads=w, n_zones=z, model_mode=model, backend=backend)
+    slopes = measure_program_slopes(
+        program, params, (jnp.asarray(pack_fleet_inputs(batch)),),
+        k_pair[0], k_pair[1], repeats)
+    return program, slopes[len(slopes) // 2]
 
 
-def run_temporal_scenario(mesh, backend, percentiles, iters):
+def run_temporal_scenario(mesh, backend, on_tpu, iters, repeats):
     """Extension beyond the five BASELINE configs: the temporal estimator
     over a mixed fleet — [N, W, T, F] history windows through the
-    dedicated fleet program. Same measurement contract as the five
-    BASELINE rows: full-path timings re-transfer the host batch per
-    iteration; device_* timings run with every input device-resident."""
+    dedicated fleet program."""
     import jax
     import jax.numpy as jnp
 
@@ -107,36 +127,42 @@ def run_temporal_scenario(mesh, backend, percentiles, iters):
     params = init_temporal(jax.random.PRNGKey(0), z, t_max=HISTORY_T)
     program = make_temporal_fleet_program(mesh, backend=backend)
 
-    def step():  # full path: host batch + windows re-transferred per iter
-        jax.block_until_ready(run_fleet_attribution(
-            program, batch, params, hist, tv))
-
-    dev_args = jax.tree.map(jnp.asarray, (
-        params, batch.zone_deltas_uj, batch.zone_valid, batch.usage_ratio,
+    dev_args = tuple(jnp.asarray(a) for a in (
+        batch.zone_deltas_uj, batch.zone_valid, batch.usage_ratio,
         batch.cpu_deltas, batch.workload_valid, batch.node_cpu_delta,
         batch.dt_s, batch.mode, hist, tv))
+    k_pair = (8, 136) if on_tpu else (1, 4)
+    slopes = measure_program_slopes(program, params, dev_args,
+                                    k_pair[0], k_pair[1], repeats)
+    dev_p50 = slopes[len(slopes) // 2]
 
-    def device_step():  # inputs resident: the program cost alone
-        jax.block_until_ready(program(*dev_args))
+    def e2e():  # full path: host batch + windows re-transferred per iter
+        res = run_fleet_attribution(program, batch, params, hist, tv)
+        np.asarray(res.workload_power_uw)  # value fetch = real sync
 
-    p99, p50 = percentiles(step, iters)
-    dev_p99, dev_p50 = percentiles(device_step, iters)
-    return {
+    p99, p50 = percentiles(e2e, warm=2, iters=iters)
+    return {  # budget/within_budget are owned by main() for all rows
         "scenario": "temporal-fleet",
-        "p99_ms": round(p99, 4), "p50_ms": round(p50, 4),
-        "device_p99_ms": round(dev_p99, 4),
-        "device_p50_ms": round(dev_p50, 4),
+        "device_p50_ms": round(dev_p50, 6),
+        "e2e_p99_ms": round(p99, 4), "e2e_p50_ms": round(p50, 4),
         "nodes": n, "pods": n * w,
-        "pods_per_sec": round(n * w / (p50 / 1e3)),
+        "pods_per_sec_device": round(n * w / (max(dev_p50, 1e-9) / 1e3)),
         "history_ticks": HISTORY_T,
     }
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--iters", type=int, default=20)
     p.add_argument("--backend", default="einsum",
                    help="einsum | pallas (pallas needs TPU or interpret)")
+    p.add_argument("--repeats", type=int, default=7,
+                   help="slope sample count per scenario")
+    p.add_argument("--cpu-factor", type=float, default=500.0,
+                   help="budget multiplier on CPU hosts (no TPU present)")
+    p.add_argument("--max-vs-einsum", type=float, default=3.0,
+                   help="allowed slowdown of a non-einsum backend vs the "
+                        "einsum baseline before the gate fails")
     args = p.parse_args()
 
     import jax
@@ -144,65 +170,77 @@ def main() -> None:
 
     from kepler_tpu.models import initializer
     from kepler_tpu.parallel import make_mesh
-    from kepler_tpu.parallel.packed import (
-        make_packed_fleet_program,
-        pack_fleet_inputs,
-        unpack_fleet_watts,
-    )
+    from kepler_tpu.parallel.packed import (pack_fleet_inputs,
+                                            unpack_fleet_watts)
 
     mesh = make_mesh(devices=jax.devices()[:1])
     platform = jax.devices()[0].platform
+    on_tpu = platform != "cpu"
+    budget_scale = 1.0 if on_tpu else args.cpu_factor
+    repeats = args.repeats if on_tpu else max(2, args.repeats // 3)
+    failures: list[str] = []
 
-    def percentiles(fn, iters):
-        for _ in range(3):
-            fn()
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            fn()
-            times.append((time.perf_counter() - t0) * 1e3)
-        times.sort()
-        return (times[math.ceil(0.99 * len(times)) - 1],
-                times[len(times) // 2])
-
-    for name, n, w, z, mode, model, ragged in SCENARIOS:
+    for name, n, w, z, mode, model, ragged, budget in SCENARIOS:
         batch = make_batch(n, w, z, mode, ragged=ragged)
         params = (initializer(model)(jax.random.PRNGKey(0), z)
                   if model else None)
-        program = make_packed_fleet_program(
-            mesh, n_workloads=w, n_zones=z, model_mode=model,
-            backend=args.backend)
+        k_pair = ((32, 2048) if n == 1 else (16, 528)) if on_tpu else (1, 5)
+        program, dev_p50 = slope_for(mesh, batch, w, z, model,
+                                     args.backend, k_pair, repeats, params)
+        vs_einsum = None
+        if args.backend != "einsum":
+            _, einsum_p50 = slope_for(mesh, batch, w, z, model, "einsum",
+                                      k_pair, repeats, params)
+            vs_einsum = dev_p50 / max(einsum_p50, 1e-9)
+
         packed_host = pack_fleet_inputs(batch)
 
-        def step():
+        def e2e():
             out = program(params, jnp.asarray(packed_host))
             unpack_fleet_watts(np.asarray(out))
 
-        packed_dev = jnp.asarray(packed_host)
-
-        def device_step():
-            jax.block_until_ready(program(params, packed_dev))
-
-        p99, p50 = percentiles(step, args.iters)
-        dev_p99, dev_p50 = percentiles(device_step, args.iters)
+        p99, p50 = percentiles(e2e, warm=2, iters=args.iters)
         pods = int(batch.workload_valid.sum())
-        print(json.dumps({
+        scaled_budget = budget * budget_scale
+        row = {
             "scenario": name,
-            "p99_ms": round(p99, 4),
-            "p50_ms": round(p50, 4),
-            "device_p99_ms": round(dev_p99, 4),
-            "device_p50_ms": round(dev_p50, 4),
+            "device_p50_ms": round(dev_p50, 6),
+            "budget_ms": scaled_budget,
+            "within_budget": dev_p50 <= scaled_budget,
+            "e2e_p99_ms": round(p99, 4),
+            "e2e_p50_ms": round(p50, 4),
             "nodes": n,
             "pods": pods,
-            "pods_per_sec": round(pods / (p50 / 1e3)),
+            "pods_per_sec_device": round(pods / (max(dev_p50, 1e-9) / 1e3)),
             "platform": platform,
             "backend": args.backend,
-        }))
+        }
+        if vs_einsum is not None:
+            row["vs_einsum"] = round(vs_einsum, 3)
+            if vs_einsum > args.max_vs_einsum:
+                failures.append(
+                    f"{name}: {args.backend} is {vs_einsum:.1f}x the einsum "
+                    f"baseline (limit {args.max_vs_einsum}x)")
+        if not row["within_budget"]:
+            failures.append(f"{name}: device p50 {dev_p50:.4f} ms exceeds "
+                            f"budget {scaled_budget} ms")
+        print(json.dumps(row))
 
-    out = run_temporal_scenario(mesh, args.backend, percentiles,
-                                args.iters)
-    out.update({"platform": platform, "backend": args.backend})
-    print(json.dumps(out))
+    row = run_temporal_scenario(mesh, args.backend, on_tpu, args.iters,
+                                repeats)
+    row.update({"platform": platform, "backend": args.backend})
+    scaled = TEMPORAL_BUDGET_MS * budget_scale
+    row["budget_ms"] = scaled
+    row["within_budget"] = row["device_p50_ms"] <= scaled
+    if not row["within_budget"]:
+        failures.append(f"temporal-fleet: device p50 {row['device_p50_ms']}"
+                        f" ms exceeds budget {scaled} ms")
+    print(json.dumps(row))
+
+    if failures:
+        for f in failures:
+            print(f"BUDGET VIOLATION: {f}", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -81,7 +81,18 @@ class PrometheusExporter:
             "/metrics", "Metrics", "Prometheus metrics", self._handle)
         log.info("prometheus exporter ready at /metrics")
 
-    def _handle(self, _request) -> tuple[int, dict[str, str], bytes]:
+    def _handle(self, request) -> tuple[int, dict[str, str], bytes]:
+        # content negotiation (reference enables OpenMetrics on its
+        # promhttp handler): serve the OpenMetrics exposition when the
+        # scraper asks for it, classic text format otherwise
+        accept = ""
+        if request is not None and getattr(request, "headers", None):
+            accept = request.headers.get("Accept") or ""
+        if "application/openmetrics-text" in accept:
+            from prometheus_client import openmetrics
+            return (200,
+                    {"Content-Type": openmetrics.exposition.CONTENT_TYPE_LATEST},
+                    openmetrics.exposition.generate_latest(self._registry))
         payload = generate_latest(self._registry)
         return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
 

@@ -137,6 +137,60 @@ def temporal_trunk(
     return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
 
 
+def _last_query_trunk(
+    params: TemporalParams,
+    feat_hist: jax.Array,  # f32 [B, T, F]
+    t_valid: jax.Array,  # bool [B, T]
+    compute_dtype: jnp.dtype,
+) -> jax.Array:
+    """Dense-serving fast path → pooled hidden f32 [B, D].
+
+    Only the LAST valid timestep feeds the head, so the attention block
+    needs one query row per sequence (K/V still span the window): at the
+    last valid position the causal mask plus right-padding reduces to
+    ``t_valid`` itself. Cuts the trunk's matmul FLOPs ~4× vs computing
+    all T positions (Q/O/MLP shrink by T; K/V stay) — same math as
+    ``temporal_trunk`` + take_along_axis, verified in tests.
+    """
+    b, t, _ = feat_hist.shape
+    d = params["in_proj"].shape[1]
+    h = N_HEADS
+    dh = d // h
+    cd = compute_dtype
+
+    x = feat_hist.astype(cd) @ params["in_proj"].astype(cd)
+    x = x.astype(jnp.float32) + params["pos_emb"][:t]
+    x = jnp.where(t_valid[..., None], x, 0.0)
+    last = jnp.maximum(jnp.sum(t_valid, axis=-1) - 1, 0).astype(jnp.int32)
+
+    y = layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+    y16 = y.astype(cd)
+    y_last = jnp.take_along_axis(y16, last[:, None, None], axis=1)[:, 0]
+    q = (y_last @ params["wq"].astype(cd)).reshape(b, h, dh)
+    k = (y16 @ params["wk"].astype(cd)).reshape(b, t, h, dh)
+    v = (y16 @ params["wv"].astype(cd)).reshape(b, t, h, dh)
+    scores = jnp.einsum("bhd,bthd->bht", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(dh, jnp.float32))
+    # finite mask value (not -inf): an all-invalid window must yield 0
+    # attention, not softmax(-inf…)=NaN — parity with full_attention's
+    # l_safe clamping for fully-masked rows
+    scores = jnp.where(t_valid[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cd)
+    any_valid = t_valid.any(axis=-1)
+    probs = jnp.where(any_valid[:, None, None], probs, 0.0)
+    attn = jnp.einsum("bht,bthd->bhd", probs, v).reshape(b, d)
+
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    x_last = x_last + (attn @ params["wo"].astype(cd)).astype(jnp.float32)
+
+    y = layer_norm(x_last, params["ln2_scale"], params["ln2_bias"]).astype(cd)
+    y = jax.nn.gelu(y @ params["w_mlp0"].astype(cd)
+                    + params["b_mlp0"].astype(cd))
+    x_last = x_last + (y @ params["w_mlp1"].astype(cd)).astype(jnp.float32) \
+        + params["b_mlp1"]
+    return layer_norm(x_last, params["ln_f_scale"], params["ln_f_bias"])
+
+
 def predict_temporal(
     params: TemporalParams,
     feat_hist: jax.Array,  # f32 [..., W, T, F]
@@ -151,17 +205,23 @@ def predict_temporal(
     Leading axes flatten into the attention batch; the LAST valid timestep's
     hidden state feeds the head (ragged histories right-pad, so that is the
     last ``t_valid`` position, falling back to position 0 when empty).
+    Dense serving (no ``attention_fn``) uses the single-query fast path;
+    a custom attention_fn (ring attention over a sharded T axis) keeps the
+    full-sequence trunk.
     """
     lead = feat_hist.shape[:-2]
     t, f = feat_hist.shape[-2:]
     x = feat_hist.reshape(-1, t, f)
     tv = (jnp.ones(x.shape[:2], bool) if t_valid is None
           else t_valid.reshape(-1, t))
-    hidden = temporal_trunk(params, x, tv, attention_fn=attention_fn,
-                            compute_dtype=compute_dtype)
-    last = jnp.maximum(jnp.sum(tv, axis=-1) - 1, 0)  # index of last tick
-    pooled = jnp.take_along_axis(
-        hidden, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    if attention_fn is None:
+        pooled = _last_query_trunk(params, x, tv, compute_dtype)
+    else:
+        hidden = temporal_trunk(params, x, tv, attention_fn=attention_fn,
+                                compute_dtype=compute_dtype)
+        last = jnp.maximum(jnp.sum(tv, axis=-1) - 1, 0)  # last tick index
+        pooled = jnp.take_along_axis(
+            hidden, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
     watts = pooled @ params["w_head"] + params["b_head"]
     watts = watts.reshape(*lead, -1)
     if clamp:

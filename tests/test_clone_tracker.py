@@ -1,0 +1,238 @@
+"""Clone-isolation and tracker-eviction matrices.
+
+Reference parity: ``internal/monitor/clone_test.go`` (627 LoC — mutate a
+returned snapshot every way possible and prove the monitor's state is
+untouched) and ``terminated_resource_tracker_test.go`` (806 LoC —
+threshold/eviction/unbounded/off configurations in one table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kepler_tpu.monitor.snapshot import NodeUsage, Snapshot, WorkloadTable
+from kepler_tpu.monitor.terminated import TerminatedTracker
+
+from tests.test_monitor import MockProc, make_monitor
+
+
+def build_monitor_with_everything():
+    """Monitor with running + terminated processes AND containers."""
+    cid = "c" * 64
+    procs = [
+        MockProc(1, cpu=1.0, comm="bash"),
+        MockProc(2, cpu=1.0, cgroups=[f"/docker-{cid}.scope"],
+                 env={"HOSTNAME": "web"}),
+        MockProc(3, cpu=1.0),
+    ]
+    mon, reader, zones, clock = make_monitor(
+        procs, ratio=0.5, min_terminated_energy_uj=0.0)
+    mon.refresh()
+    for z in zones:
+        z.increment = 100_000_000
+    for p in procs:
+        p.cpu += 5.0
+    clock.step(5.0)
+    mon.refresh()
+    reader.procs = procs[:2]  # pid 3 terminates
+    for z in zones:
+        z.increment = 50_000_000
+    for p in procs[:2]:
+        p.cpu += 1.0
+    clock.step(5.0)
+    mon.refresh()
+    mon._staleness = 1e9
+    return mon
+
+
+def all_arrays(obj, prefix=""):
+    """Yield (path, ndarray) for every numpy array reachable from a
+    Snapshot-like dataclass tree."""
+    if isinstance(obj, np.ndarray):
+        yield prefix, obj
+    elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        for f in dataclasses.fields(obj):
+            yield from all_arrays(getattr(obj, f.name), f"{prefix}.{f.name}")
+    elif isinstance(obj, (tuple, list)) and not isinstance(obj, str):
+        for i, v in enumerate(obj):
+            yield from all_arrays(v, f"{prefix}[{i}]")
+
+
+class TestCloneIsolation:
+    def test_no_array_shares_memory_with_second_clone(self):
+        """Generic completeness check: EVERY ndarray in one clone must be
+        independent of the corresponding array in another clone — a newly
+        added Snapshot field cannot silently skip the deep copy."""
+        mon = build_monitor_with_everything()
+        a, b = mon.snapshot(), mon.snapshot()
+        arrays_a = dict(all_arrays(a))
+        arrays_b = dict(all_arrays(b))
+        assert arrays_a.keys() == arrays_b.keys()
+        assert arrays_a, "no arrays found — walker broken"
+        for path, arr in arrays_a.items():
+            assert not np.shares_memory(arr, arrays_b[path]), path
+
+    def test_mutating_every_array_leaves_monitor_untouched(self):
+        mon = build_monitor_with_everything()
+        baseline = {p: arr.copy() for p, arr in all_arrays(mon.snapshot())}
+        victim = mon.snapshot()
+        for _, arr in all_arrays(victim):
+            if arr.size:
+                arr[:] = -12345.0  # scribble over the whole clone
+        fresh = {p: arr for p, arr in all_arrays(mon.snapshot())}
+        assert baseline.keys() == fresh.keys()
+        for path, arr in fresh.items():
+            np.testing.assert_array_equal(arr, baseline[path], err_msg=path)
+
+    def test_meta_mappings_are_deep_copied(self):
+        mon = build_monitor_with_everything()
+        victim = mon.snapshot()
+        assert victim.processes.meta, "fixture has no process meta"
+        for table in (victim.processes, victim.containers,
+                      victim.terminated_processes):
+            for m in table.meta:
+                if isinstance(m, dict):
+                    m["comm"] = "HACKED"
+                    m["injected"] = "yes"
+        fresh = mon.snapshot()
+        for table in (fresh.processes, fresh.containers,
+                      fresh.terminated_processes):
+            for m in table.meta:
+                assert m.get("comm") != "HACKED"
+                assert "injected" not in m
+
+    def test_terminated_tables_cloned_too(self):
+        mon = build_monitor_with_everything()
+        victim = mon.snapshot()
+        assert victim.terminated_processes.ids  # fixture guarantees one
+        victim.terminated_processes.energy_uj[:] = 0.0
+        fresh = mon.snapshot()
+        idx = fresh.terminated_processes.ids.index("3")
+        assert fresh.terminated_processes.energy_uj[idx].sum() > 0
+
+    def test_clone_of_clone_independent(self):
+        mon = build_monitor_with_everything()
+        a = mon.snapshot()
+        c = a.clone()
+        a.node.energy_uj[:] = 1.0
+        assert c.node.energy_uj.sum() != pytest.approx(
+            a.node.energy_uj.sum())
+
+    def test_empty_table_clone(self):
+        t = WorkloadTable.empty(3)
+        c = t.clone()
+        assert len(c) == 0 and c.energy_uj.shape == (0, 3)
+
+
+# ---------------------------------------------------------------------------
+# Tracker eviction matrix
+# ---------------------------------------------------------------------------
+
+
+def table(ids, energies, n_zones=1, primary=0, power=None):
+    n = len(ids)
+    e = np.zeros((n, n_zones))
+    e[:, primary] = energies
+    p = np.asarray(power, np.float64).reshape(n, n_zones) if power is not None \
+        else np.zeros((n, n_zones))
+    return WorkloadTable(ids=tuple(ids), meta=tuple({"i": str(i)}
+                                                    for i in range(n)),
+                         energy_uj=e, power_uw=p)
+
+
+@dataclasses.dataclass
+class EvictionCase:
+    name: str
+    max_size: int
+    min_energy: float
+    batches: list  # list of (ids, energies)
+    expect: set  # surviving ids
+
+
+EVICTION_MATRIX = [
+    EvictionCase("off", 0, 0.0, [(list("abc"), [1e9, 2e9, 3e9])], set()),
+    EvictionCase("unbounded", -1, 0.0,
+                 [([str(i) for i in range(250)], list(range(250)))],
+                 {str(i) for i in range(250)}),
+    EvictionCase("topn_single_batch", 2, 0.0,
+                 [(list("abcd"), [40.0, 10.0, 30.0, 20.0])], {"a", "c"}),
+    EvictionCase("topn_exact_fit", 3, 0.0,
+                 [(list("abc"), [1.0, 2.0, 3.0])], {"a", "b", "c"}),
+    EvictionCase("topn_across_batches", 2, 0.0,
+                 [(list("ab"), [10.0, 20.0]), (list("cd"), [30.0, 5.0])],
+                 {"b", "c"}),
+    EvictionCase("threshold_filters_low", 10, 50.0,
+                 [(list("abc"), [49.9, 50.0, 100.0])], {"b", "c"}),
+    EvictionCase("threshold_all_below", 10, 1e12,
+                 [(list("abc"), [1.0, 2.0, 3.0])], set()),
+    EvictionCase("threshold_plus_topn", 1, 25.0,
+                 [(list("abc"), [30.0, 20.0, 40.0])], {"c"}),
+    EvictionCase("zero_energy_with_zero_threshold", 5, 0.0,
+                 [(list("ab"), [0.0, 1.0])], {"a", "b"}),
+]
+
+
+class TestEvictionMatrix:
+    @pytest.mark.parametrize("case", EVICTION_MATRIX,
+                             ids=[c.name for c in EVICTION_MATRIX])
+    def test_case(self, case):
+        tr = TerminatedTracker(n_zones=1, primary_zone_index=0,
+                               max_size=case.max_size,
+                               min_energy_uj=case.min_energy)
+        for ids, energies in case.batches:
+            tr.add_batch(table(ids, energies))
+        assert set(tr.items().ids) == case.expect
+        assert len(tr) == len(case.expect)
+
+    def test_survivors_keep_energy_power_meta(self):
+        tr = TerminatedTracker(1, 0, max_size=2, min_energy_uj=0.0)
+        tr.add_batch(table(list("abc"), [10.0, 30.0, 20.0],
+                           power=[1.0, 3.0, 2.0]))
+        items = tr.items()
+        got = {wid: (items.energy_uj[i, 0], items.power_uw[i, 0],
+                     items.meta[i]["i"])
+               for i, wid in enumerate(items.ids)}
+        assert got == {"b": (30.0, 3.0, "1"), "c": (20.0, 2.0, "2")}
+
+    def test_primary_zone_selects_ranking_axis(self):
+        """Ranking must use the primary zone's energy, not zone 0."""
+        tr = TerminatedTracker(n_zones=2, primary_zone_index=1,
+                               max_size=1, min_energy_uj=0.0)
+        e = np.array([[100.0, 1.0], [1.0, 100.0]])
+        t = WorkloadTable(ids=("zone0-rich", "zone1-rich"),
+                          meta=({}, {}), energy_uj=e,
+                          power_uw=np.zeros((2, 2)))
+        tr.add_batch(t)
+        assert tr.items().ids == ("zone1-rich",)
+
+    def test_stable_under_repeated_batches(self):
+        tr = TerminatedTracker(1, 0, max_size=2, min_energy_uj=0.0)
+        t = table(list("abc"), [10.0, 30.0, 20.0])
+        for _ in range(5):
+            tr.add_batch(t)
+        assert set(tr.items().ids) == {"b", "c"}
+
+    def test_eviction_then_higher_energy_newcomer(self):
+        tr = TerminatedTracker(1, 0, max_size=2, min_energy_uj=0.0)
+        tr.add_batch(table(list("ab"), [10.0, 20.0]))
+        tr.add_batch(table(["c"], [100.0]))  # evicts a
+        tr.add_batch(table(["d"], [50.0]))  # evicts b
+        assert set(tr.items().ids) == {"c", "d"}
+
+    def test_clear_resets_known_set(self):
+        tr = TerminatedTracker(1, 0, max_size=5, min_energy_uj=0.0)
+        tr.add_batch(table(["a"], [10.0]))
+        tr.clear()
+        tr.add_batch(table(["a"], [99.0]))  # re-add after clear is fresh
+        assert tr.items().energy_uj[0, 0] == 99.0
+
+    def test_tracker_items_snapshot_independent(self):
+        """items() must hand out arrays the caller can scribble on."""
+        tr = TerminatedTracker(1, 0, max_size=5, min_energy_uj=0.0)
+        tr.add_batch(table(["a"], [10.0]))
+        view = tr.items()
+        view.energy_uj[:] = -1.0
+        assert tr.items().energy_uj[0, 0] == 10.0

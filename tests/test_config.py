@@ -221,3 +221,312 @@ class TestBuilder:
         )
         assert cfg.log.level == "error"
         assert cfg.monitor.interval == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Exhaustive field matrix (reference config_test.go, 1886 LoC): every public
+# field through all three layers — default < YAML < explicit flag — plus a
+# completeness meta-test that introspects the Config dataclass tree so a new
+# field cannot be added without appearing here.
+# ---------------------------------------------------------------------------
+
+import dataclasses
+
+from kepler_tpu.config.config import _CANONICAL_YAML_KEYS, _kebab
+
+
+def get_path(cfg, path):
+    node = cfg
+    for part in path.split("."):
+        node = getattr(node, part)
+    return node
+
+
+@dataclasses.dataclass
+class FieldCase:
+    path: str  # dotted attribute path into Config
+    yaml: str  # YAML doc setting the field (canonical spelling)
+    yaml_expected: object
+    flags: list | None = None  # argv or None if no flag exists (by design)
+    flag_expected: object = None
+
+
+FIELD_MATRIX = [
+    FieldCase("log.level", "log: {level: warn}", "warn",
+              ["--log.level", "error"], "error"),
+    FieldCase("log.format", "log: {format: json}", "json",
+              ["--log.format", "text"], "text"),
+    FieldCase("host.sysfs", "host: {sysfs: /tmp}", "/tmp",
+              ["--host.sysfs", "/var"], "/var"),
+    FieldCase("host.procfs", "host: {procfs: /tmp}", "/tmp",
+              ["--host.procfs", "/var"], "/var"),
+    FieldCase("monitor.interval", "monitor: {interval: 10s}", 10.0,
+              ["--monitor.interval", "3s"], 3.0),
+    FieldCase("monitor.staleness", "monitor: {staleness: 250ms}", 0.25),
+    FieldCase("monitor.max_terminated", "monitor: {maxTerminated: 100}", 100,
+              ["--monitor.max-terminated", "7"], 7),
+    FieldCase("monitor.min_terminated_energy_threshold",
+              "monitor: {minTerminatedEnergyThreshold: 25}", 25),
+    FieldCase("rapl.zones", "rapl: {zones: [package]}", ["package"]),
+    FieldCase("exporter.stdout.enabled",
+              "exporter: {stdout: {enabled: true}}", True,
+              ["--no-exporter.stdout"], False),
+    FieldCase("exporter.prometheus.enabled",
+              "exporter: {prometheus: {enabled: false}}", False,
+              ["--exporter.prometheus"], True),
+    FieldCase("exporter.prometheus.debug_collectors",
+              "exporter: {prometheus: {debugCollectors: []}}", []),
+    FieldCase("exporter.prometheus.metrics_level",
+              "exporter: {prometheus: {metricsLevel: [node]}}", Level.NODE,
+              ["--metrics", "pod"], Level.POD),
+    FieldCase("web.config_file", "web: {configFile: /tmp/w.yaml}",
+              "/tmp/w.yaml", ["--web.config-file", "/tmp/w2.yaml"],
+              "/tmp/w2.yaml"),
+    FieldCase("web.listen_addresses", 'web: {listenAddresses: [":1111"]}',
+              [":1111"], ["--web.listen-address", ":2222"], [":2222"]),
+    FieldCase("debug.pprof.enabled", "debug: {pprof: {enabled: true}}", True,
+              ["--no-debug.pprof"], False),
+    FieldCase("kube.enabled", "kube: {enabled: true}", True,
+              ["--no-kube.enable"], False),
+    FieldCase("kube.config", "kube: {config: /tmp/kc}", "/tmp/kc",
+              ["--kube.config", "/tmp/kc2"], "/tmp/kc2"),
+    FieldCase("kube.node_name", "kube: {nodeName: n1}", "n1",
+              ["--kube.node-name", "n2"], "n2"),
+    FieldCase("tpu.platform", "tpu: {platform: cpu}", "cpu",
+              ["--tpu.platform", "tpu"], "tpu"),
+    FieldCase("tpu.workload_bucket", "tpu: {workloadBucket: 64}", 64),
+    FieldCase("tpu.node_bucket", "tpu: {nodeBucket: 16}", 16),
+    FieldCase("tpu.mesh_shape", "tpu: {meshShape: [2, 4]}", [2, 4]),
+    FieldCase("tpu.mesh_axes", "tpu: {meshAxes: [node, model]}",
+              ["node", "model"]),
+    FieldCase("tpu.fleet_backend", "tpu: {fleetBackend: pallas}", "pallas",
+              ["--tpu.fleet-backend", "einsum"], "einsum"),
+    FieldCase("aggregator.enabled", "aggregator: {enabled: true}", True,
+              ["--no-aggregator.enable"], False),
+    FieldCase("aggregator.listen_address",
+              'aggregator: {listenAddress: ":9999"}', ":9999",
+              ["--aggregator.listen-address", ":8888"], ":8888"),
+    FieldCase("aggregator.endpoint",
+              "aggregator: {endpoint: http://a:1}", "http://a:1",
+              ["--aggregator.endpoint", "http://b:2"], "http://b:2"),
+    FieldCase("aggregator.tls_skip_verify",
+              "aggregator: {tlsSkipVerify: true}", True,
+              ["--no-aggregator.tls-skip-verify"], False),
+    FieldCase("aggregator.interval", "aggregator: {interval: 2s}", 2.0),
+    FieldCase("aggregator.stale_after", "aggregator: {staleAfter: 30s}",
+              30.0),
+    FieldCase("aggregator.model", "aggregator: {model: linear}", "linear",
+              ["--aggregator.model", "temporal"], "temporal"),
+    FieldCase("aggregator.params_path",
+              "aggregator: {paramsPath: /tmp/p.npz}", "/tmp/p.npz",
+              ["--aggregator.params-path", "/tmp/q.npz"], "/tmp/q.npz"),
+    FieldCase("aggregator.history_window",
+              "aggregator: {historyWindow: 4}", 4,
+              ["--aggregator.history-window", "9"], 9),
+    FieldCase("aggregator.training_dump_dir",
+              "aggregator: {trainingDumpDir: /tmp/dump}", "/tmp/dump",
+              ["--aggregator.training-dump-dir", "/tmp/dump2"], "/tmp/dump2"),
+    FieldCase("aggregator.training_dump_max_files",
+              "aggregator: {trainingDumpMaxFiles: 5}", 5,
+              ["--aggregator.training-dump-max-files", "6"], 6),
+    FieldCase("aggregator.node_mode", "aggregator: {nodeMode: model}",
+              "model", ["--aggregator.node-mode", "ratio"], "ratio"),
+    # dev settings deliberately have no flags (reference config.go:104,189)
+    FieldCase("dev.fake_cpu_meter.enabled",
+              "dev: {fakeCpuMeter: {enabled: true}}", True),
+    FieldCase("dev.fake_cpu_meter.zones",
+              "dev: {fakeCpuMeter: {zones: [core]}}", ["core"]),
+]
+
+IDS = [c.path for c in FIELD_MATRIX]
+
+
+class TestFieldMatrix:
+    @pytest.mark.parametrize("case", FIELD_MATRIX, ids=IDS)
+    def test_yaml_overrides_default(self, case):
+        assert get_path(load(case.yaml), case.path) == case.yaml_expected
+        # the chosen test value must actually differ from the default,
+        # or the assertion above proves nothing
+        assert get_path(default_config(), case.path) != case.yaml_expected
+
+    @pytest.mark.parametrize(
+        "case", [c for c in FIELD_MATRIX if c.flags], 
+        ids=[c.path for c in FIELD_MATRIX if c.flags])
+    def test_flag_overrides_yaml(self, case):
+        cfg = apply_flags(load(case.yaml), parse(case.flags))
+        assert get_path(cfg, case.path) == case.flag_expected
+        assert case.flag_expected != case.yaml_expected  # meaningful pair
+
+    @pytest.mark.parametrize(
+        "case", [c for c in FIELD_MATRIX if c.flags],
+        ids=[c.path for c in FIELD_MATRIX if c.flags])
+    def test_unset_flag_preserves_yaml(self, case):
+        cfg = apply_flags(load(case.yaml), parse([]))
+        assert get_path(cfg, case.path) == case.yaml_expected
+
+    def test_matrix_is_complete(self):
+        """Every leaf field of the Config tree appears in FIELD_MATRIX."""
+        def leaves(obj, prefix=""):
+            for f in dataclasses.fields(obj):
+                value = getattr(obj, f.name)
+                if dataclasses.is_dataclass(value):
+                    yield from leaves(value, f"{prefix}{f.name}.")
+                else:
+                    yield f"{prefix}{f.name}"
+
+        all_paths = set(leaves(default_config()))
+        covered = {c.path for c in FIELD_MATRIX}
+        assert covered == all_paths, (
+            f"matrix missing {all_paths - covered}, "
+            f"stale {covered - all_paths}")
+
+
+class TestYAMLSpellings:
+    """Every multi-word key accepts camelCase AND its kebab-case CLI
+    spelling, mapping to the same field."""
+
+    SECTION_OF = {
+        "configFile": "web", "listenAddresses": "web",
+        "maxTerminated": "monitor",
+        "minTerminatedEnergyThreshold": "monitor",
+        "debugCollectors": ("exporter", "prometheus"),
+        "metricsLevel": ("exporter", "prometheus"),
+        "nodeName": "kube",
+        "listenAddress": "aggregator", "staleAfter": "aggregator",
+        "paramsPath": "aggregator", "tlsSkipVerify": "aggregator",
+        "nodeMode": "aggregator", "historyWindow": "aggregator",
+        "trainingDumpDir": "aggregator",
+        "trainingDumpMaxFiles": "aggregator",
+        "workloadBucket": "tpu", "nodeBucket": "tpu", "meshShape": "tpu",
+        "meshAxes": "tpu", "fleetBackend": "tpu",
+        "fakeCpuMeter": "dev",
+    }
+    VALUE_OF = {
+        "configFile": ("/tmp/x", "/tmp/x"),
+        "listenAddresses": ('[":1"]', [":1"]),
+        "maxTerminated": ("3", 3),
+        "minTerminatedEnergyThreshold": ("2", 2),
+        "debugCollectors": ("[]", []),
+        "metricsLevel": ("[node]", Level.NODE),
+        "nodeName": ("n", "n"),
+        "listenAddress": ('":2"', ":2"),
+        "staleAfter": ("9s", 9.0),
+        "paramsPath": ("/tmp/p", "/tmp/p"),
+        "tlsSkipVerify": ("true", True),
+        "nodeMode": ("model", "model"),
+        "historyWindow": ("3", 3),
+        "trainingDumpDir": ("/tmp/d", "/tmp/d"),
+        "trainingDumpMaxFiles": ("2", 2),
+        "workloadBucket": ("8", 8),
+        "nodeBucket": ("2", 2),
+        "meshShape": ("[2]", [2]),
+        "meshAxes": ("[x]", ["x"]),
+        "fleetBackend": ("pallas", "pallas"),
+        "fakeCpuMeter": ("{enabled: true}", None),  # subsection
+    }
+
+    @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))
+    def test_camel_and_kebab_equivalent(self, camel):
+        section = self.SECTION_OF[camel]
+        yaml_val, expected = self.VALUE_OF[camel]
+        attr = _CANONICAL_YAML_KEYS[camel]
+        for spelling in (camel, _kebab(camel)):
+            if isinstance(section, tuple):
+                doc = (f"{section[0]}:\n  {section[1]}:\n"
+                       f"    {spelling}: {yaml_val}\n")
+                target = lambda cfg: getattr(
+                    getattr(cfg, section[0]), section[1])
+            else:
+                doc = f"{section}:\n  {spelling}: {yaml_val}\n"
+                target = lambda cfg: getattr(cfg, section)
+            cfg = load(doc)
+            if camel == "fakeCpuMeter":
+                assert cfg.dev.fake_cpu_meter.enabled is True
+            else:
+                assert getattr(target(cfg), attr) == expected, spelling
+
+
+class TestValidationMatrix:
+    """Every validate() error branch (reference config.go:418-509)."""
+
+    CASES = [
+        ("log.level", lambda c: setattr(c.log, "level", "verbose"),
+         "log level"),
+        ("log.format", lambda c: setattr(c.log, "format", "xml"),
+         "log format"),
+        ("host.sysfs", lambda c: setattr(c.host, "sysfs", "/nope"),
+         "sysfs"),
+        ("host.procfs", lambda c: setattr(c.host, "procfs", "/nope"),
+         "procfs"),
+        ("monitor.interval", lambda c: setattr(c.monitor, "interval", -1),
+         "interval"),
+        ("monitor.staleness", lambda c: setattr(c.monitor, "staleness", -1),
+         "staleness"),
+        ("monitor.minTerminated",
+         lambda c: setattr(c.monitor, "min_terminated_energy_threshold", -1),
+         "minTerminatedEnergyThreshold"),
+        ("kube.nodeName", lambda c: setattr(c.kube, "enabled", True),
+         "nodeName"),
+        ("tpu.workload_bucket",
+         lambda c: setattr(c.tpu, "workload_bucket", 0), "workload_bucket"),
+        ("tpu.node_bucket", lambda c: setattr(c.tpu, "node_bucket", 0),
+         "node_bucket"),
+        ("tpu.platform", lambda c: setattr(c.tpu, "platform", "cuda"),
+         "tpu.platform"),
+        ("tpu.fleetBackend",
+         lambda c: setattr(c.tpu, "fleet_backend", "nccl"), "fleetBackend"),
+        ("aggregator.historyWindow",
+         lambda c: setattr(c.aggregator, "history_window", 0),
+         "historyWindow"),
+        ("aggregator.trainingDumpMaxFiles",
+         lambda c: setattr(c.aggregator, "training_dump_max_files", 0),
+         "trainingDumpMaxFiles"),
+        ("aggregator.model",
+         lambda c: setattr(c.aggregator, "model", "gpt"),
+         "aggregator.model"),
+        ("aggregator.nodeMode",
+         lambda c: setattr(c.aggregator, "node_mode", "auto"),
+         "aggregator.nodeMode"),
+    ]
+
+    @pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])
+    def test_error_branch(self, case):
+        _, mutate, match = case
+        cfg = default_config()
+        mutate(cfg)
+        skip = [] if case[0].startswith("host.") else ["host"]
+        with pytest.raises(ValueError, match=match):
+            cfg.validate(skip=skip)
+
+    def test_kube_config_must_exist(self):
+        cfg = default_config()
+        cfg.kube.enabled = True
+        cfg.kube.node_name = "n"
+        cfg.kube.config = "/no/such/kubeconfig"
+        with pytest.raises(ValueError, match="kube.config"):
+            cfg.validate(skip=["host"])
+
+    def test_errors_aggregate(self):
+        cfg = default_config()
+        cfg.log.level = "verbose"
+        cfg.tpu.platform = "cuda"
+        with pytest.raises(ValueError) as err:
+            cfg.validate(skip=["host"])
+        assert "log level" in str(err.value)
+        assert "tpu.platform" in str(err.value)
+
+
+class TestFullPrecedenceChain:
+    def test_parse_args_and_config_end_to_end(self, tmp_path):
+        from kepler_tpu.config.config import parse_args_and_config
+
+        f = tmp_path / "c.yaml"
+        f.write_text("log: {level: debug}\nmonitor: {interval: 9s}\n"
+                     "tpu: {fleet-backend: pallas}\n")
+        cfg = parse_args_and_config(
+            ["--config.file", str(f), "--log.level", "error"],
+            skip_validation=["host"])
+        assert cfg.log.level == "error"  # flag beat file
+        assert cfg.monitor.interval == 9.0  # file beat default
+        assert cfg.tpu.fleet_backend == "pallas"  # kebab key in file
+        assert cfg.monitor.staleness == 0.5  # untouched default

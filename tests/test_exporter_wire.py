@@ -1,0 +1,255 @@
+"""Scrape-the-wire exporter tests.
+
+Reference parity: ``power_collector_test.go`` (1057 LoC — scrape via an
+HTTP test server, assert on the exposition TEXT: families, label sets,
+escaping, content type) and ``power_collector_concurrency_test.go``
+(509 LoC — concurrent scrapes racing refreshes). The in-process suite in
+``tests/test_exporter.py`` checks generated families; this one asserts on
+the bytes a real Prometheus would receive from the real ``APIServer``.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from kepler_tpu.exporter.prometheus import (
+    PrometheusExporter,
+    create_collectors,
+)
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+from tests.test_exporter import make_ready_monitor
+from tests.test_monitor import MockProc, make_monitor
+
+CID = "d" * 64
+
+
+@pytest.fixture()
+def wire():
+    """Real APIServer + exporter on an ephemeral port → (monitor, base url)."""
+    mon = make_ready_monitor()
+    server = APIServer(listen_addresses=["127.0.0.1:0"])
+    server.init()
+    ctx = CancelContext()
+    t = threading.Thread(target=server.run, args=(ctx,), daemon=True)
+    t.start()
+    exporter = PrometheusExporter(server,
+                                  create_collectors(mon, node_name="n1"))
+    exporter.init()
+    host, port = server.addresses[0]
+    yield mon, f"http://{host}:{port}"
+    ctx.cancel()
+    server.shutdown()
+
+
+def get(url: str, accept: str | None = None):
+    req = urllib.request.Request(url)
+    if accept:
+        req.add_header("Accept", accept)
+    resp = urllib.request.urlopen(req, timeout=10)
+    return resp.headers.get("Content-Type"), resp.read().decode()
+
+
+def sample_lines(text: str, family: str) -> list[str]:
+    return [ln for ln in text.splitlines()
+            if ln.startswith(family + "{") or ln == family
+            or ln.startswith(family + " ")]
+
+
+def labels_of(line: str) -> dict[str, str]:
+    m = re.search(r"\{(.*)\}", line)
+    if not m:
+        return {}
+    return dict(re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"',
+                           m.group(1)))
+
+
+class TestExpositionText:
+    def test_classic_content_type(self, wire):
+        _, base = wire
+        ctype, text = get(base + "/metrics")
+        assert ctype.startswith("text/plain")
+        assert "charset=utf-8" in ctype
+
+    def test_openmetrics_negotiation(self, wire):
+        _, base = wire
+        ctype, text = get(base + "/metrics",
+                          accept="application/openmetrics-text; version=1.0.0")
+        assert ctype.startswith("application/openmetrics-text")
+        assert text.rstrip().endswith("# EOF")
+        # counters drop the _total suffix in OpenMetrics metadata lines
+        assert "# TYPE kepler_node_cpu_joules counter" in text
+
+    def test_node_family_label_sets(self, wire):
+        _, base = wire
+        _, text = get(base + "/metrics")
+        for family in ("kepler_node_cpu_joules_total",
+                       "kepler_node_cpu_active_joules_total",
+                       "kepler_node_cpu_idle_joules_total",
+                       "kepler_node_cpu_watts",
+                       "kepler_node_cpu_active_watts",
+                       "kepler_node_cpu_idle_watts"):
+            lines = sample_lines(text, family)
+            assert lines, family
+            zones = set()
+            for ln in lines:
+                lbl = labels_of(ln)
+                assert set(lbl) == {"zone", "path", "node_name"}, ln
+                assert lbl["node_name"] == "n1"
+                zones.add(lbl["zone"])
+            assert zones == {"package", "dram"}
+
+    def test_process_family_label_sets(self, wire):
+        _, base = wire
+        _, text = get(base + "/metrics")
+        lines = sample_lines(text, "kepler_process_cpu_watts")
+        assert lines
+        for ln in lines:
+            lbl = labels_of(ln)
+            assert set(lbl) == {"pid", "comm", "exe", "type", "container_id",
+                                "vm_id", "state", "zone", "node_name"}, ln
+        by_pid = {labels_of(ln)["pid"]: labels_of(ln) for ln in lines}
+        assert by_pid["1"]["comm"] == "bash"
+        assert by_pid["1"]["exe"] == "/bin/bash"
+        assert by_pid["2"]["container_id"] == CID
+
+    def test_container_and_seconds_families(self, wire):
+        _, base = wire
+        _, text = get(base + "/metrics")
+        clines = sample_lines(text, "kepler_container_cpu_joules_total")
+        assert clines
+        lbl = labels_of(clines[0])
+        assert set(lbl) == {"container_id", "container_name", "runtime",
+                            "pod_id", "state", "zone", "node_name"}
+        assert lbl["runtime"] == "docker"
+        assert lbl["container_name"] == "web-1"
+        slines = sample_lines(text, "kepler_process_cpu_seconds_total")
+        assert slines
+        assert "zone" not in labels_of(slines[0])  # seconds are zone-less
+
+    def test_usage_ratio_and_build_info(self, wire):
+        _, base = wire
+        _, text = get(base + "/metrics")
+        ratio = sample_lines(text, "kepler_node_cpu_usage_ratio")
+        assert ratio and float(ratio[0].split()[-1]) == pytest.approx(0.5)
+        assert sample_lines(text, "kepler_build_info")
+
+    def test_label_escaping_on_the_wire(self):
+        """comm/exe with quotes, backslashes, newlines must be escaped per
+        the exposition format (power_collector_test.go's escaping cases)."""
+        nasty = 'sh -c "x\\y\nz"'
+        procs = [MockProc(1, cpu=1.0, comm=nasty, exe="/bin/we\"ird")]
+        mon, reader, zones, clock = make_monitor(procs, ratio=0.5)
+        mon.refresh()
+        zones[0].increment = 10_000_000
+        procs[0].cpu += 1.0
+        clock.step(5.0)
+        mon.refresh()
+        mon._staleness = 1e9
+        server = APIServer(listen_addresses=["127.0.0.1:0"])
+        server.init()
+        ctx = CancelContext()
+        threading.Thread(target=server.run, args=(ctx,), daemon=True).start()
+        try:
+            PrometheusExporter(server, create_collectors(mon)).init()
+            host, port = server.addresses[0]
+            _, text = get(f"http://{host}:{port}/metrics")
+            line = sample_lines(text, "kepler_process_cpu_watts")[0]
+            assert '\\"x\\\\y\\nz\\"' in line  # escaped, single line
+            assert labels_of(line)["comm"].replace('\\"', '"').replace(
+                "\\n", "\n").replace("\\\\", "\\") == nasty
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+    def test_terminated_series_on_the_wire(self):
+        procs = [MockProc(1, cpu=1.0), MockProc(2, cpu=1.0)]
+        mon, reader, zones, clock = make_monitor(procs, ratio=0.5)
+        mon.refresh()
+        zones[0].increment = 100_000_000
+        for p in procs:
+            p.cpu += 20.0  # plenty of energy to clear the 10 J threshold
+        clock.step(5.0)
+        mon.refresh()
+        reader.procs = [procs[0]]  # pid 2 terminates
+        for z in zones:
+            z.increment = 50_000_000
+        procs[0].cpu += 1.0
+        clock.step(5.0)
+        mon.refresh()
+        mon._staleness = 1e9
+        server = APIServer(listen_addresses=["127.0.0.1:0"])
+        server.init()
+        ctx = CancelContext()
+        threading.Thread(target=server.run, args=(ctx,), daemon=True).start()
+        try:
+            PrometheusExporter(server, create_collectors(mon)).init()
+            host, port = server.addresses[0]
+            _, text = get(f"http://{host}:{port}/metrics")
+            lines = sample_lines(text, "kepler_process_cpu_joules_total")
+            states = {labels_of(ln)["pid"]: labels_of(ln)["state"]
+                      for ln in lines}
+            assert states["1"] == "running"
+            assert states["2"] == "terminated"
+        finally:
+            ctx.cancel()
+            server.shutdown()
+
+
+class TestConcurrentScrapes:
+    def test_hammer_scrapes_during_refreshes(self, wire):
+        """2×CPU scraper threads race the monitor's refresh loop; every
+        response must be a complete, self-consistent exposition (the
+        single-snapshot-per-collect contract): within one scrape,
+        node total == active + idle for every zone."""
+        import os
+
+        mon, base = wire
+        stop = threading.Event()
+        errors: list[str] = []
+
+        def refresher():
+            while not stop.is_set():
+                mon._staleness = 0.0  # force real refreshes
+                mon.refresh()
+
+        def check_consistent(text: str):
+            def values(family):
+                return {labels_of(ln)["zone"]: float(ln.split()[-1])
+                        for ln in sample_lines(text, family)}
+
+            total = values("kepler_node_cpu_joules_total")
+            active = values("kepler_node_cpu_active_joules_total")
+            idle = values("kepler_node_cpu_idle_joules_total")
+            assert set(total) == {"package", "dram"}
+            for zone in total:
+                if abs(total[zone] - (active[zone] + idle[zone])) > max(
+                        1e-4 * total[zone], 1e-6):
+                    raise AssertionError(
+                        f"torn scrape: {zone} total={total[zone]} "
+                        f"active={active[zone]} idle={idle[zone]}")
+
+        def scraper():
+            try:
+                for _ in range(25):
+                    _, text = get(base + "/metrics")
+                    check_consistent(text)
+            except Exception as e:  # noqa: BLE001 — collect for main thread
+                errors.append(repr(e))
+
+        rt = threading.Thread(target=refresher, daemon=True)
+        rt.start()
+        n = min(2 * (os.cpu_count() or 4), 16)
+        threads = [threading.Thread(target=scraper) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        stop.set()
+        rt.join(timeout=10)
+        assert not errors, errors[:3]

@@ -113,3 +113,63 @@ class TestTraining:
         target = jnp.asarray([[1.0], [0.0]])
         valid = jnp.asarray([True, False])
         assert float(masked_mse(pred, target, valid)) == 0.0
+
+
+class TestTemporalFastPath:
+    def test_last_query_path_matches_full_trunk(self):
+        """Dense serving uses the single-query trunk; it must agree with
+        the full-sequence trunk + take_along_axis pooling on ragged
+        windows (same math, ~4x fewer FLOPs)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kepler_tpu.models.temporal import init_temporal, predict_temporal
+        from kepler_tpu.ops.attention import full_attention
+
+        t = 12
+        params = init_temporal(jax.random.PRNGKey(0), n_zones=3,
+                               d_model=64, t_max=t)
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (5, 7, t, 6))
+        wv = jnp.array([True, True, False, True, True, True, True])[None, :]
+        wv = jnp.broadcast_to(wv, (5, 7))
+        lengths = jnp.arange(5 * 7).reshape(5, 7) % t + 1
+        tv = jnp.arange(t)[None, None, :] < lengths[..., None]
+
+        fast = predict_temporal(params, hist, wv, tv,
+                                compute_dtype=jnp.float32)
+        full = predict_temporal(
+            params, hist, wv, tv, compute_dtype=jnp.float32,
+            attention_fn=lambda q, k, v, tvv: full_attention(
+                q, k, v, causal=True, t_valid=tvv,
+                compute_dtype=jnp.float32))
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_empty_history_window_yields_finite_zero_not_nan(self):
+        """A valid workload whose history window is entirely invalid (first
+        tick before any history accretes) must get finite watts — the
+        fast path's masked softmax must not produce NaN."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kepler_tpu.models.temporal import init_temporal, predict_temporal
+        from kepler_tpu.ops.attention import full_attention
+
+        t = 8
+        params = init_temporal(jax.random.PRNGKey(0), n_zones=2,
+                               d_model=64, t_max=t)
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (1, 3, t, 6))
+        wv = jnp.ones((1, 3), bool)
+        tv = jnp.zeros((1, 3, t), bool).at[0, 0].set(True)  # 1 full, 2 empty
+
+        fast = np.asarray(predict_temporal(params, hist, wv, tv,
+                                           compute_dtype=jnp.float32))
+        assert np.isfinite(fast).all(), fast
+        full = np.asarray(predict_temporal(
+            params, hist, wv, tv, compute_dtype=jnp.float32,
+            attention_fn=lambda q, k, v, tvv: full_attention(
+                q, k, v, causal=True, t_valid=tvv,
+                compute_dtype=jnp.float32)))
+        np.testing.assert_allclose(fast, full, rtol=2e-5, atol=2e-5)
