@@ -21,7 +21,12 @@ dispatch cost); the e2e figures include the packed H2D/D2H legs.
 Teeth (exit non-zero on violation):
   * every scenario carries a device-latency BUDGET derived from the
     north-star (<1 ms for the cluster shapes, tighter for single-node);
-    budgets gate on real TPU — on CPU hosts they scale by --cpu-factor.
+    absolute budgets GATE only on real TPU. On CPU hosts the scaled
+    budget (--cpu-factor) is still *reported* as within_budget for
+    visibility, but pass/fail would track the CI machine's speed, not a
+    regression — so CPU runs gate only on the machine-independent
+    vs_einsum ratio (and program health: a NaN/compile failure still
+    fails loudly).
   * with --backend pallas, each scenario also measures the einsum
     baseline and fails if the pallas path regresses past --max-vs-einsum.
 
@@ -141,8 +146,12 @@ def run_temporal_scenario(mesh, backend, on_tpu, iters, repeats):
         np.asarray(res.workload_power_uw)  # value fetch = real sync
 
     p99, p50 = percentiles(e2e, warm=2, iters=iters)
+    res = run_fleet_attribution(program, batch, params, hist, tv)
+    finite = bool(np.isfinite(np.asarray(res.workload_power_uw)).all()
+                  and np.isfinite(dev_p50))
     return {  # budget/within_budget are owned by main() for all rows
         "scenario": "temporal-fleet",
+        "finite": finite,
         "device_p50_ms": round(dev_p50, 6),
         "e2e_p99_ms": round(p99, 4), "e2e_p50_ms": round(p50, 4),
         "nodes": n, "pods": n * w,
@@ -195,6 +204,15 @@ def main() -> None:
 
         packed_host = pack_fleet_inputs(batch)
 
+        # program health gates on EVERY host (the docstring's promise):
+        # non-finite watts or a non-finite slope is a real regression, not
+        # machine speed
+        out_host = np.asarray(program(params, jnp.asarray(packed_host)))
+        if not np.isfinite(out_host).all():
+            failures.append(f"{name}: program emitted non-finite watts")
+        if not np.isfinite(dev_p50):
+            failures.append(f"{name}: non-finite device slope {dev_p50}")
+
         def e2e():
             out = program(params, jnp.asarray(packed_host))
             unpack_fleet_watts(np.asarray(out))
@@ -221,7 +239,10 @@ def main() -> None:
                 failures.append(
                     f"{name}: {args.backend} is {vs_einsum:.1f}x the einsum "
                     f"baseline (limit {args.max_vs_einsum}x)")
-        if not row["within_budget"]:
+        # absolute budgets only gate on TPU: a CPU host's wall time tracks
+        # the CI machine, not the program (advisor r2) — vs_einsum above is
+        # the relative, machine-independent CPU gate
+        if on_tpu and not row["within_budget"]:
             failures.append(f"{name}: device p50 {dev_p50:.4f} ms exceeds "
                             f"budget {scaled_budget} ms")
         print(json.dumps(row))
@@ -232,7 +253,9 @@ def main() -> None:
     scaled = TEMPORAL_BUDGET_MS * budget_scale
     row["budget_ms"] = scaled
     row["within_budget"] = row["device_p50_ms"] <= scaled
-    if not row["within_budget"]:
+    if not row.pop("finite"):
+        failures.append("temporal-fleet: non-finite watts or slope")
+    if on_tpu and not row["within_budget"]:
         failures.append(f"temporal-fleet: device p50 {row['device_p50_ms']}"
                         f" ms exceeds budget {scaled} ms")
     print(json.dumps(row))

@@ -26,7 +26,8 @@ from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 
 from kepler_tpu.config.level import Level
 from kepler_tpu.device.energy import JOULE, WATT
-from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.monitor.monitor import (PowerMonitor,
+                                        SnapshotUnavailableError)
 from kepler_tpu.monitor.snapshot import WorkloadTable
 
 log = logging.getLogger("kepler.exporter.prometheus")
@@ -61,7 +62,14 @@ class PowerCollector:
         if not self._is_ready():
             log.debug("collector not ready: no snapshot yet")
             return
-        snap = self._monitor.snapshot()  # ONE snapshot per scrape
+        try:
+            snap = self._monitor.snapshot()  # ONE snapshot per scrape
+        except SnapshotUnavailableError as err:
+            # defined degradation: an empty scrape (plus a warning) beats a
+            # 500 with a traceback — Prometheus records the target up with
+            # no kepler families, and the next scrape retries the refresh
+            log.warning("scrape skipped: %s", err)
+            return
         const = {"node_name": self._node_name} if self._node_name else {}
 
         if Level.NODE in self._level:

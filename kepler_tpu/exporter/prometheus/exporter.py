@@ -89,10 +89,10 @@ class PrometheusExporter:
         if request is not None and getattr(request, "headers", None):
             accept = request.headers.get("Accept") or ""
         if "application/openmetrics-text" in accept:
-            from prometheus_client import openmetrics
+            from prometheus_client.openmetrics import exposition as om_exposition
             return (200,
-                    {"Content-Type": openmetrics.exposition.CONTENT_TYPE_LATEST},
-                    openmetrics.exposition.generate_latest(self._registry))
+                    {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
+                    om_exposition.generate_latest(self._registry))
         payload = generate_latest(self._registry)
         return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
 

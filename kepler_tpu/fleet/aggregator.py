@@ -118,6 +118,14 @@ class Aggregator:
 
         self._lock = threading.Lock()
         self._reports: dict[str, _Stored] = {}
+        # per-node run nonces superseded by restarts: a network-delayed
+        # straggler from ANY previous agent run must not be re-classified
+        # as yet another restart (that would overwrite the fresher run's
+        # report, push a spurious temporal history window, and mark the
+        # LIVE run as superseded — going dark until the next restart).
+        # A bounded per-node list (oldest dropped) keeps memory O(nodes).
+        self._superseded_runs: dict[str, list[str]] = {}
+        self._superseded_cap = 16
         self._results_lock = threading.Lock()
         self._results: dict[str, dict] = {}
         self._stats = {"reports_total": 0, "rejected_total": 0,
@@ -200,10 +208,24 @@ class Aggregator:
             # When BOTH sides carry a run nonce the cases are unambiguous:
             # different nonce = fresh agent process (restart), same nonce +
             # seq regression = network reorder (reject). Pre-nonce agents
-            # fall back to the seq==1 heuristic for restarts.
+            # fall back to the seq==1 heuristic for restarts. A nonce that
+            # matches any run a previous restart superseded is a delayed
+            # straggler from a dead run — reject it outright rather than
+            # honoring it as another restart (which would also wrongly
+            # mark the live run as superseded).
+            superseded = self._superseded_runs.get(report.node_name, [])
+            if stored.run and stored.run in superseded:
+                self._stats["rejected_total"] += 1
+                return (409, {"Content-Type": "text/plain"},
+                        b"stale run nonce (superseded by a newer agent run)\n")
             has_nonces = (prev is not None and bool(stored.run)
                           and bool(prev.run))
             restarted = has_nonces and stored.run != prev.run
+            if restarted:
+                runs = self._superseded_runs.setdefault(
+                    report.node_name, [])
+                runs.append(prev.run)
+                del runs[:-self._superseded_cap]
             legacy = prev is not None and not has_nonces
             if (prev is None or restarted or stored.seq >= prev.seq
                     or (legacy and stored.seq == 1)):
@@ -251,6 +273,8 @@ class Aggregator:
             self._reports = dict(live)
             for name in [n for n in self._history if n not in live]:
                 del self._history[name]
+            for name in [n for n in self._superseded_runs if n not in live]:
+                del self._superseded_runs[name]
         if not live:
             return None
         # canonical zone axis = sorted union of reported zone names; nodes
@@ -483,13 +507,15 @@ class Aggregator:
     # -- read endpoints ----------------------------------------------------
 
     def _handle_results(self, request) -> tuple[int, dict[str, str], bytes]:
+        from urllib.parse import unquote_plus
+
         query = ""
         if "?" in request.path:
             query = request.path.split("?", 1)[1]
         node = None
         for part in query.split("&"):
             if part.startswith("node="):
-                node = part[len("node="):]
+                node = unquote_plus(part[len("node="):])
         with self._results_lock:
             if node is not None:
                 payload = self._results.get(node)

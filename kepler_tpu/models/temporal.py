@@ -173,8 +173,12 @@ def _last_query_trunk(
     scores = scores / jnp.sqrt(jnp.asarray(dh, jnp.float32))
     # finite mask value (not -inf): an all-invalid window must yield 0
     # attention, not softmax(-inf…)=NaN — parity with full_attention's
-    # l_safe clamping for fully-masked rows
-    scores = jnp.where(t_valid[:, None, :], scores, -1e30)
+    # l_safe clamping for fully-masked rows. The causal constraint
+    # (position ≤ last) keeps this path exact on gapped t_valid masks,
+    # not just the contiguous right-padded prefixes history windows
+    # produce — full parity with the all-positions trunk.
+    causal = jnp.arange(t, dtype=jnp.int32)[None, :] <= last[:, None]
+    scores = jnp.where((t_valid & causal)[:, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(cd)
     any_valid = t_valid.any(axis=-1)
     probs = jnp.where(any_valid[:, None, None], probs, 0.0)

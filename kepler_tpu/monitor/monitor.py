@@ -39,6 +39,15 @@ from kepler_tpu.service.lifecycle import CancelContext
 
 log = logging.getLogger("kepler.monitor")
 
+
+class SnapshotUnavailableError(RuntimeError):
+    """No snapshot exists and the refresh that would create one failed.
+
+    Raised from ``PowerMonitor.snapshot()`` only when there is no stale
+    snapshot to degrade to (the reference serves stale data on refresh
+    failure when it can — :185-200); collectors catch this to render a
+    scrape error rather than propagate a raw traceback."""
+
 _UNSET = object()  # "batch plan not yet computed" (None = computed, absent)
 
 _KINDS = ("processes", "containers", "virtual_machines", "pods")
@@ -185,13 +194,25 @@ class PowerMonitor:
         Freshness contract (reference :185-200, :254-302): if the current
         snapshot is older than ``staleness``, refresh first; concurrent
         callers dedupe on a lock with a double-check so at most one refresh
-        runs (singleflight).
+        runs (singleflight). Degradation contract: if the refresh fails
+        (meter died between init and scrape) a stale snapshot, when one
+        exists, is served with a warning — matching the reference's
+        serve-stale-on-error stance; with no snapshot at all the failure
+        surfaces as ``SnapshotUnavailableError`` so the collector can
+        render a scrape error instead of a raw traceback.
         """
         snap = self._snapshot
         if snap is None or not self._is_fresh():
             with self._snapshot_lock:
                 if not self._is_fresh():  # double-check under the lock
-                    self._refresh_locked()
+                    try:
+                        self._refresh_locked()
+                    except Exception as err:
+                        if self._snapshot is None:
+                            raise SnapshotUnavailableError(
+                                f"first refresh failed: {err}") from err
+                        log.warning("refresh failed (%s); serving stale "
+                                    "snapshot", err)
             snap = self._snapshot
         assert snap is not None
         self._exported = True  # terminated data now consumable→clearable

@@ -4,6 +4,7 @@ fixture strategy from SURVEY §4 (no real nodes needed)."""
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -511,6 +512,74 @@ class TestTemporalAggregator:
                     seq=1, run="run-2")  # restarted agent, same seq
         _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, True, False, False]
+
+    def test_superseded_run_straggler_rejected(self, server):
+        # a network-delayed report from the PREVIOUS agent run arriving
+        # after the new run's reports must NOT be classified as yet another
+        # restart (advisor r2): it would overwrite the fresher run and, in
+        # temporal mode, push a spurious history window — and alternating
+        # stragglers would flip-flop the stored run forever
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=4)
+        agg.init()
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=7, run="run-1")
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=1, run="run-2")  # genuine restart
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=8, run="run-1")  # old run's straggler
+        assert exc.value.code == 409
+        assert agg._reports["node-a"].run == "run-2"
+        assert agg._reports["node-a"].seq == 1
+        # exactly two windows pushed (run-1 seq=7, run-2 seq=1) — the
+        # straggler must not have advanced the temporal window
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].tolist() == [True, True, False, False]
+        # and the next report from the LIVE run still lands normally
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=2, run="run-2")
+        assert agg._reports["node-a"].seq == 2
+
+    def test_straggler_from_two_runs_back_rejected(self, server):
+        # reviewer repro: with only the LAST superseded run remembered, a
+        # straggler from TWO runs back is accepted as a "restart" and then
+        # marks the LIVE run as superseded — every later live report 409s
+        # until the next real restart. The superseded list must remember
+        # all dead runs (bounded).
+        agg = Aggregator(server, model_mode="temporal", node_bucket=8,
+                         workload_bucket=16, history_window=8)
+        agg.init()
+        for run in ("run-1", "run-2", "run-3"):
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=1, run=run)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            post_report(server, make_report("node-a", mode=MODE_MODEL),
+                        seq=9, run="run-1")  # two runs back
+        assert exc.value.code == 409
+        assert agg._reports["node-a"].run == "run-3"
+        # the LIVE run must still be accepted afterwards
+        post_report(server, make_report("node-a", mode=MODE_MODEL),
+                    seq=2, run="run-3")
+        assert agg._reports["node-a"].seq == 2
+        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        assert tv[0].sum() == 4  # 3 restarts + seq advance, no straggler
+
+    def test_results_node_query_url_decoded(self, server):
+        # node names with URL-encoded characters must round-trip through
+        # /v1/results?node=… (weak r2 #5)
+        agg = Aggregator(server, model_mode="mlp", node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("rack 1/node-a", mode=MODE_RATIO))
+        agg.aggregate_once()
+        host, port = server.addresses[0]
+        from urllib.parse import quote
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/v1/results?node="
+                f"{quote('rack 1/node-a', safe='')}", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert len(payload["workloads"]) == 3
 
     def test_same_run_reordered_first_seq_rejected(self, server):
         # a network-duplicated copy of seq=1 arriving after seq=3 within

@@ -146,6 +146,43 @@ class TestTemporalFastPath:
         np.testing.assert_allclose(np.asarray(fast), np.asarray(full),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_gapped_t_valid_matches_full_trunk(self):
+        """A GAPPED t_valid (not a contiguous right-padded prefix) must
+        produce identical output on the fast path and the all-positions
+        trunk (advisor r2: the fast path previously masked with t_valid
+        only, silently diverging between dense serving and the
+        attention_fn/ring path on gapped masks)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kepler_tpu.models.temporal import init_temporal, predict_temporal
+        from kepler_tpu.ops.attention import full_attention
+
+        t = 10
+        params = init_temporal(jax.random.PRNGKey(3), n_zones=2,
+                               d_model=64, t_max=t)
+        hist = jax.random.uniform(jax.random.PRNGKey(4), (2, 4, t, 6))
+        wv = jnp.ones((2, 4), bool)
+        # gapped masks: holes in the middle, valid past the holes
+        tv = np.zeros((2, 4, t), bool)
+        tv[0, 0, [0, 2, 5]] = True       # gaps at 1, 3-4
+        tv[0, 1, [1, 3, 4, 8]] = True    # leading gap + middle gaps
+        tv[0, 2, :] = True               # dense for contrast
+        tv[0, 3, [9]] = True             # single late tick
+        tv[1, :, ::2] = True             # alternating
+        tv = jnp.asarray(tv)
+
+        fast = predict_temporal(params, hist, wv, tv,
+                                compute_dtype=jnp.float32)
+        full = predict_temporal(
+            params, hist, wv, tv, compute_dtype=jnp.float32,
+            attention_fn=lambda q, k, v, tvv: full_attention(
+                q, k, v, causal=True, t_valid=tvv,
+                compute_dtype=jnp.float32))
+        np.testing.assert_allclose(np.asarray(fast), np.asarray(full),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_empty_history_window_yields_finite_zero_not_nan(self):
         """A valid workload whose history window is entirely invalid (first
         tick before any history accretes) must get finite watts — the
