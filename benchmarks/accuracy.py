@@ -216,72 +216,139 @@ def measure_packed_accuracy(program, batch, params) -> dict:
     }
 
 
-def fit_scan(predict_fn, params, features, workload_valid, target_watts,
+ESTIMATOR_P99_TOL = 0.005  # every family gates on p99 ≤ 0.5%
+
+
+def fit_scan(forward, params, workload_valid, target_watts,
              steps: int, learning_rate: float = 1e-2):
     """Full-batch fit as ONE device program (`lax.scan` over the train
-    step) — a tunnelled chip pays one dispatch, not one per step."""
-    import functools
+    step) — a tunnelled chip pays one dispatch, not one per step.
 
+    ``forward(params) → pred_watts`` closes over the (family-specific)
+    inputs. Loss is the RELATIVE masked MSE — the north star is a
+    percent-of-ground-truth bound, so the optimizer must weight the small
+    workloads' tail, not just the big ones. Adam + cosine decay, no weight
+    decay: decay regularizes toward zero weights, which is a systematic
+    bias away from the exact fit the accuracy gate demands. The scan
+    carries the best-loss params seen, so a warm-started model can only be
+    improved by fine-tuning, never degraded by a wandering step.
+    """
     import jax
     import jax.numpy as jnp
     import optax
 
-    from kepler_tpu.models.train import masked_mse
+    from kepler_tpu.models.train import masked_relative_mse
 
-    optimizer = optax.adamw(learning_rate, weight_decay=1e-4)
-    train_predict = functools.partial(predict_fn, clamp=False)
+    schedule = optax.cosine_decay_schedule(learning_rate, steps, alpha=1e-3)
+    optimizer = optax.adam(schedule)
+
+    def loss_fn(p):
+        return masked_relative_mse(forward(p), target_watts, workload_valid)
 
     @jax.jit
     def run(params):
         opt_state = optimizer.init(params)
+        best = (params, loss_fn(params))
 
         def step(carry, _):
-            params, opt_state = carry
-
-            def loss_fn(p):
-                pred = train_predict(p, features, workload_valid)
-                return masked_mse(pred, target_watts, workload_valid)
-
+            params, opt_state, best = carry
             loss, grads = jax.value_and_grad(loss_fn)(params)
+            best_p, best_l = best
+            keep = loss < best_l
+            best = (jax.tree.map(
+                lambda new, old: jnp.where(keep, new, old), params, best_p),
+                jnp.minimum(loss, best_l))
             updates, opt_state = optimizer.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), opt_state), loss
+            return (optax.apply_updates(params, updates), opt_state,
+                    best), loss
 
-        (params, _), losses = jax.lax.scan(step, (params, opt_state),
-                                           jnp.arange(steps))
-        return params, losses[-1]
+        (params, _, best), _ = jax.lax.scan(
+            step, (params, opt_state, best), jnp.arange(steps))
+        # the final step's params were never themselves evaluated
+        final_l = loss_fn(params)
+        best_p, best_l = best
+        keep = final_l < best_l
+        return (jax.tree.map(lambda new, old: jnp.where(keep, new, old),
+                             params, best_p),
+                jnp.minimum(final_l, best_l))
 
     return run(params)
+
+
+def _learnable_fleet(n_nodes, n_workloads, n_zones, seed,
+                     k_uw_per_cpu_s: np.ndarray):
+    """Synthetic fleet whose ground truth IS predictable from the features
+    (the model-serving premise). ``k_uw_per_cpu_s`` is [Z] or [N, Z]:
+    setting zone_delta[n,z] = k[n,z] · node_cpu · dt / usage_ratio gives
+    active_power[n,z] = k[n,z] · node_cpu, hence workload watts =
+    k[n,z] · cpu_delta[n,w] — power proportional to CPU time."""
+    fleet = synthetic_fleet(n_nodes, n_workloads, n_zones, seed)
+    k = np.broadcast_to(np.asarray(k_uw_per_cpu_s, np.float64),
+                        (n_nodes, n_zones))
+    fleet["zone_deltas_uj"] = (
+        k * (fleet["node_cpu_delta"][:, None].astype(np.float64)
+             * fleet["dt_s"][:, None]
+             / np.clip(fleet["usage_ratio"], 0.05, 1.0)[:, None])
+    ).astype(np.float32)
+    fleet["zone_valid"] = np.ones((n_nodes, n_zones), bool)
+    return fleet
+
+
+def _err_stats(pred, refw, vmask) -> tuple[float, float]:
+    """(median, p99) relative error over valid rows with |ref| > 0.1 W."""
+    sig = vmask[:, :, None] & (np.abs(refw) > 0.1)
+    err = (np.abs(np.asarray(pred, np.float64) - refw)
+           / np.maximum(np.abs(refw), 1e-12))[sig]
+    return float(np.median(err)), float(np.quantile(err, 0.99))
 
 
 def measure_estimator_accuracy(n_nodes: int = 64, n_workloads: int = 32,
                                n_zones: int = 2, steps: int = 1500,
                                seed: int = 3) -> dict:
-    """Fit linear + MLP estimators against RAPL-ratio labels on a synthetic
-    fleet (the reference train/serve split: learn on RAPL nodes, serve
-    no-RAPL nodes) and report relative error of predicted vs true watts."""
+    """See _measure_estimator_accuracy. Runs under matmul precision
+    HIGHEST: TPU "f32" matmuls default to one bf16 MXU pass (~1e-3 relative
+    noise — twice the whole 0.5% budget); the accuracy-mode configuration
+    pays the 3-pass cost, which is invisible at estimator sizes."""
+    import jax
+
+    with jax.default_matmul_precision("highest"):
+        return _measure_estimator_accuracy(n_nodes, n_workloads, n_zones,
+                                           steps, seed)
+
+
+def _measure_estimator_accuracy(n_nodes: int = 64, n_workloads: int = 32,
+                                n_zones: int = 2, steps: int = 1500,
+                                seed: int = 3) -> dict:
+    """Fit ALL FIVE estimator families against RAPL-ratio labels on a
+    synthetic fleet (the reference train/serve split: learn on RAPL nodes,
+    serve no-RAPL nodes) and report median + p99 relative error of
+    predicted vs f64 ground-truth watts. Every family must land p99 within
+    the 0.5% north-star budget (`*_fit_p99_rel_err` ≤ ESTIMATOR_P99_TOL).
+
+    linear solves in closed form (`fit_linear_exact` — how linear
+    regression is actually fit); the nonlinear families train their
+    wide-and-deep skip + trunk with the relative loss. Evaluation runs the
+    f32 compute path (the accuracy-mode serving configuration; bf16 is the
+    throughput mode).
+    """
+    import functools
+
+    import jax
     import jax.numpy as jnp
 
     from kepler_tpu.models import build_features, init_linear, init_mlp
-    from kepler_tpu.models.linear import predict_linear
+    from kepler_tpu.models.deep import init_deep, predict_deep
+    from kepler_tpu.models.linear import fit_linear_exact, predict_linear
     from kepler_tpu.models.mlp import predict_mlp
-    import jax
+    from kepler_tpu.models.moe import init_moe, predict_moe
+    from kepler_tpu.models.temporal import init_temporal, predict_temporal
 
-    fleet = synthetic_fleet(n_nodes, n_workloads, n_zones, seed)
-    # Make the ground truth LEARNABLE from the features (the model-serving
-    # premise: power is predictable from usage counters). Setting
-    # zone_delta[n,z] = k_z · node_cpu · dt / usage_ratio gives
-    # active_power[n,z] = k_z · node_cpu, hence workload watts =
-    # k_z · cpu_delta[n,w] — power proportional to CPU time, with
-    # per-zone coefficients (~4 W per cpu-core-second here).
-    k_z = np.linspace(2e6, 6e6, n_zones)  # µW per cpu-second
-    fleet["zone_deltas_uj"] = (
-        k_z[None, :] * fleet["node_cpu_delta"][:, None].astype(np.float64)
-        * fleet["dt_s"][:, None]
-        / np.clip(fleet["usage_ratio"], 0.05, 1.0)[:, None]
-    ).astype(np.float32)
-    fleet["zone_valid"] = np.ones((n_nodes, n_zones), bool)
+    f32 = jnp.float32
+    k_z = np.linspace(2e6, 6e6, n_zones)  # µW per cpu-second, per zone
+    fleet = _learnable_fleet(n_nodes, n_workloads, n_zones, seed, k_z)
     ref = reference_attribution_f64(**fleet)
-    target = jnp.asarray(ref.workload_power_uw * 1e-6, jnp.float32)  # W
+    refw = ref.workload_power_uw * 1e-6  # W
+    target = jnp.asarray(refw, jnp.float32)
     feats = build_features(
         jnp.asarray(fleet["cpu_deltas"]),
         jnp.asarray(fleet["workload_valid"]),
@@ -291,22 +358,118 @@ def measure_estimator_accuracy(n_nodes: int = 64, n_workloads: int = 32,
     )
     valid = jnp.asarray(fleet["workload_valid"])
     vmask = fleet["workload_valid"]
-
     out = {}
+
+    # -- linear: closed-form least squares --------------------------------
+    fitted = fit_linear_exact(feats, valid, target)
+    med, p99 = _err_stats(predict_linear(fitted, feats, valid), refw, vmask)
+    out["linear_fit_median_rel_err"] = med
+    out["linear_fit_p99_rel_err"] = p99
+
+    # -- mlp / deep: wide-and-deep fit on the same fleet ------------------
+    from kepler_tpu.models.train import warm_start_moe, warm_start_wide
+
     for name, init, predict, lr in (
-        ("linear", init_linear, predict_linear, 3e-2),
-        ("mlp", init_mlp, predict_mlp, 1e-2),
+        ("mlp", init_mlp, predict_mlp, 1e-3),
+        ("deep", init_deep, predict_deep, 1e-3),
     ):
         params = init(jax.random.PRNGKey(0), n_zones=n_zones)
-        fitted, loss = fit_scan(predict, params, feats, valid, target,
-                                steps=steps, learning_rate=lr)
-        pred = np.asarray(predict(fitted, feats, valid), np.float64)
-        refw = ref.workload_power_uw * 1e-6
-        sig = vmask[:, :, None] & (np.abs(refw) > 0.1)  # > 0.1 W rows
-        err = (np.abs(pred - refw) / np.maximum(np.abs(refw), 1e-12))[sig]
-        out[f"{name}_fit_median_rel_err"] = float(np.median(err))
-        out[f"{name}_fit_p99_rel_err"] = float(np.quantile(err, 0.99))
+        params = warm_start_wide(params, feats, valid, target)
+        pfn = functools.partial(predict, features=feats,
+                                workload_valid=valid, clamp=False,
+                                compute_dtype=f32)
+        fitted, loss = fit_scan(pfn, params, valid, target, steps=steps,
+                                learning_rate=lr)
+        med, p99 = _err_stats(
+            predict(fitted, feats, valid, compute_dtype=f32), refw, vmask)
+        out[f"{name}_fit_median_rel_err"] = med
+        out[f"{name}_fit_p99_rel_err"] = p99
         out[f"{name}_fit_loss"] = float(loss)
+
+    # -- moe: heterogeneous fleet, per-node-type coefficients, explicit
+    #    routing (the kepler-model-server per-platform-model capability) --
+    n_experts = 4
+    rng = np.random.default_rng(seed + 10)
+    expert_id = rng.integers(0, n_experts, n_nodes)
+    k_per_type = k_z[None, :] * (1.0 + 0.4 * np.arange(n_experts))[:, None]
+    moe_fleet = _learnable_fleet(n_nodes, n_workloads, n_zones, seed + 11,
+                                 k_per_type[expert_id])
+    moe_ref = reference_attribution_f64(**moe_fleet)
+    moe_refw = moe_ref.workload_power_uw * 1e-6
+    moe_target = jnp.asarray(moe_refw, jnp.float32)
+    moe_feats = build_features(
+        jnp.asarray(moe_fleet["cpu_deltas"]),
+        jnp.asarray(moe_fleet["workload_valid"]),
+        jnp.asarray(moe_fleet["node_cpu_delta"]),
+        jnp.asarray(moe_fleet["usage_ratio"]),
+        jnp.asarray(moe_fleet["dt_s"]),
+    )
+    moe_valid = jnp.asarray(moe_fleet["workload_valid"])
+    eid = jnp.asarray(expert_id, jnp.int32)
+    params = init_moe(jax.random.PRNGKey(0), n_zones=n_zones,
+                      n_experts=n_experts)
+    params = warm_start_moe(params, moe_feats, moe_valid, moe_target, eid)
+    moe_fn = functools.partial(predict_moe, features=moe_feats,
+                               workload_valid=moe_valid, clamp=False,
+                               compute_dtype=f32, expert_id=eid)
+    fitted, loss = fit_scan(moe_fn, params, moe_valid, moe_target,
+                            steps=steps, learning_rate=1e-3)
+    med, p99 = _err_stats(
+        predict_moe(fitted, moe_feats, moe_valid, compute_dtype=f32,
+                    expert_id=eid),
+        moe_refw, moe_fleet["workload_valid"])
+    out["moe_fit_median_rel_err"] = med
+    out["moe_fit_p99_rel_err"] = p99
+    out["moe_fit_loss"] = float(loss)
+
+    # -- temporal: history windows, target = last tick's watts ------------
+    t_hist = 8
+    rngt = np.random.default_rng(seed + 20)
+    lengths = rngt.integers(1, t_hist + 1, (n_nodes, n_workloads))
+    ticks = [_learnable_fleet(n_nodes, n_workloads, n_zones,
+                              seed + 30 + t, k_z) for t in range(t_hist)]
+    feat_all = np.stack(
+        [np.asarray(build_features(
+            jnp.asarray(tk["cpu_deltas"]),
+            jnp.asarray(tk["workload_valid"]),
+            jnp.asarray(tk["node_cpu_delta"]),
+            jnp.asarray(tk["usage_ratio"]),
+            jnp.asarray(tk["dt_s"]),
+        )) for tk in ticks], axis=-2)  # [N, W, T, F] in tick order
+    # HistoryBuffer convention: ragged windows right-pad (valid PREFIX), so
+    # a length-L workload holds ticks t_hist-L … t_hist-1 at positions
+    # 0 … L-1 — the current tick is always the LAST VALID position
+    pos = np.arange(t_hist)[None, None, :]
+    idx = np.clip(t_hist - lengths[..., None] + pos, 0, t_hist - 1)
+    hist_feats = jnp.asarray(
+        np.take_along_axis(feat_all, idx[..., None], axis=2))
+    tv = jnp.asarray(pos < lengths[..., None])
+    last_tick = ticks[-1]
+    tmp_ref = reference_attribution_f64(**last_tick)
+    tmp_refw = tmp_ref.workload_power_uw * 1e-6
+    tmp_target = jnp.asarray(tmp_refw, jnp.float32)
+    tmp_valid = jnp.asarray(last_tick["workload_valid"])
+    params = init_temporal(jax.random.PRNGKey(0), n_zones=n_zones,
+                           t_max=t_hist)
+    # warm start against the CURRENT tick's features (the skip's input)
+    last_feats = jnp.asarray(feat_all[:, :, -1])
+    params = warm_start_wide(params, last_feats, tmp_valid, tmp_target)
+    tmp_fn = functools.partial(predict_temporal, feat_hist=hist_feats,
+                               workload_valid=tmp_valid, t_valid=tv,
+                               clamp=False, compute_dtype=f32)
+    fitted, loss = fit_scan(tmp_fn, params, tmp_valid, tmp_target,
+                            steps=steps, learning_rate=1e-3)
+    med, p99 = _err_stats(
+        predict_temporal(fitted, hist_feats, tmp_valid, t_valid=tv,
+                         compute_dtype=f32),
+        tmp_refw, last_tick["workload_valid"])
+    out["temporal_fit_median_rel_err"] = med
+    out["temporal_fit_p99_rel_err"] = p99
+    out["temporal_fit_loss"] = float(loss)
+
+    out["estimator_accuracy_ok"] = bool(all(
+        out[f"{n}_fit_p99_rel_err"] <= ESTIMATOR_P99_TOL
+        for n in ("linear", "mlp", "deep", "moe", "temporal")))
     return out
 
 
@@ -321,5 +484,6 @@ def run_all(packed_program=None, packed_batch=None, packed_params=None,
                                            packed_params))
     out.update(measure_estimator_accuracy(steps=estimator_steps))
     out["accuracy_ok"] = bool(out["ratio_f32_ok"]
-                              and out.get("packed_f16_ok", True))
+                              and out.get("packed_f16_ok", True)
+                              and out["estimator_accuracy_ok"])
     return out

@@ -55,12 +55,12 @@ MAX_REPORT_BYTES = 64 << 20
 # program (make_temporal_fleet_program), not the single-tick predictor
 # registry — the aggregator accretes each workload's window itself.
 _REQUIRED_PARAM_KEYS = {
-    "mlp": ("w0", "b0", "w1", "b1", "w2", "b2"),
+    "mlp": ("w0", "b0", "w1", "b1", "w2", "b2", "w_skip"),
     "linear": ("weight", "bias"),
-    "moe": ("gate_w", "w0", "b0", "w1", "b1"),
-    "deep": ("in_proj", "in_bias", "blocks", "w_head", "b_head"),
+    "moe": ("gate_w", "w0", "b0", "w1", "b1", "w_skip"),
+    "deep": ("in_proj", "in_bias", "blocks", "w_head", "b_head", "w_skip"),
     "temporal": ("in_proj", "pos_emb", "wq", "wk", "wv", "wo",
-                 "w_mlp0", "w_mlp1", "w_head", "b_head"),
+                 "w_mlp0", "w_mlp1", "w_head", "b_head", "w_skip"),
 }
 _OUTPUT_BIAS_KEY = {"mlp": "b2", "linear": "bias", "moe": "b1",
                     "deep": "b_head", "temporal": "b_head"}

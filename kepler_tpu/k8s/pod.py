@@ -157,12 +157,33 @@ class PodInformer:
                  len(self._index), self._node_name)
 
     def run(self, ctx: CancelContext) -> None:
-        """Watch + periodic re-list (controller-runtime cache analog)."""
+        """Watch + periodic re-list (controller-runtime cache analog).
+
+        A watch ``ERROR`` event (e.g. 410 Gone after an API-server restart
+        compacts our resourceVersion) triggers an *immediate* re-list rather
+        than waiting out the stream timeout — the recovery controller-runtime
+        performs for the reference (``internal/k8s/pod/pod.go:136-196``).
+        Only the FIRST consecutive ERROR gets the fast path: if the fresh
+        resourceVersion is rejected again, fall back to the normal wait so a
+        persistently failing watch can't become a tight LIST/WATCH loop
+        against the API server (the reflector's backoff analog).
+        """
+        error_streak = 0
         while not ctx.cancelled():
+            expired = False
             try:
-                self._watch(ctx)
+                expired = self._watch(ctx)
             except Exception as err:
                 log.warning("pod watch interrupted: %s", err)
+            if ctx.cancelled():
+                return
+            error_streak = error_streak + 1 if expired else 0
+            if expired and error_streak == 1:
+                try:
+                    self.relist()
+                    continue  # fresh resourceVersion: re-watch right away
+                except Exception as err:
+                    log.warning("pod re-list after ERROR failed: %s", err)
             if ctx.wait(min(5.0, self._resync)):
                 return
             try:
@@ -176,7 +197,8 @@ class PodInformer:
         sel = f"spec.nodeName%3D{self._node_name}"
         path = f"/api/v1/pods?fieldSelector={sel}"
         if watch:
-            path += f"&watch=true&resourceVersion={self._resource_version}"
+            path += (f"&watch=true&resourceVersion={self._resource_version}"
+                     "&allowWatchBookmarks=true")
         return path
 
     def relist(self) -> None:
@@ -191,7 +213,10 @@ class PodInformer:
             self._resource_version = data.get("metadata", {}).get(
                 "resourceVersion", "")
 
-    def _watch(self, ctx: CancelContext) -> None:
+    def _watch(self, ctx: CancelContext) -> bool:
+        """Consume one watch stream. Returns True when the stream must be
+        abandoned because the server declared our resourceVersion stale
+        (ERROR event, typically 410 Gone)."""
         assert self._client is not None
         with self._client.get(self._pods_path(watch=True),
                               timeout=60.0) as resp:
@@ -199,7 +224,7 @@ class PodInformer:
             while not ctx.cancelled():
                 chunk = resp.readline()
                 if not chunk:
-                    return  # stream closed; caller re-lists
+                    return False  # stream closed; caller re-lists
                 buf += chunk
                 if not buf.endswith(b"\n"):
                     continue
@@ -209,20 +234,37 @@ class PodInformer:
                     continue  # partial frame
                 finally:
                     buf = b""
-                self._apply_event(event)
+                if self._apply_event(event):
+                    return True
+        return False
 
-    def _apply_event(self, event: Mapping) -> None:
+    def _apply_event(self, event: Mapping) -> bool:
+        """Fold one watch event into the cache. Returns True when the watch
+        is expired and the caller must re-list (reference relies on
+        controller-runtime's reflector for this, ``pod.go:136-144``)."""
         kind = event.get("type")
         pod = event.get("object", {})
+        if kind == "ERROR":
+            # object is a v1.Status; 410 Gone means our resourceVersion was
+            # compacted away. Drop it so the next LIST starts fresh.
+            log.warning(
+                "pod watch ERROR (code=%s reason=%s): re-listing",
+                pod.get("code"), pod.get("reason"))
+            with self._lock:
+                self._resource_version = ""
+            return True
         rv = pod.get("metadata", {}).get("resourceVersion")
         with self._lock:
             if rv:
                 self._resource_version = rv
-            if kind in ("ADDED", "MODIFIED"):
+            if kind == "BOOKMARK":
+                pass  # resourceVersion checkpoint only; no cache change
+            elif kind in ("ADDED", "MODIFIED"):
                 self._remove_locked(pod)
                 self._upsert_locked(pod)
             elif kind == "DELETED":
                 self._remove_locked(pod)
+        return False
 
     def _upsert_locked(self, pod: Mapping) -> None:
         meta = pod.get("metadata", {})

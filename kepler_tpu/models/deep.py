@@ -39,6 +39,7 @@ class DeepParams(TypedDict):
     blocks: BlockParams  # leading S axis = pipeline stages
     w_head: jax.Array  # [D, Z]
     b_head: jax.Array  # [Z]
+    w_skip: jax.Array  # [F, Z] wide path (features → watts, outside stack)
 
 
 def init_deep(
@@ -63,6 +64,7 @@ def init_deep(
         ),
         w_head=jnp.zeros((d_model, n_zones), jnp.float32),
         b_head=jnp.zeros((n_zones,), jnp.float32),
+        w_skip=jnp.zeros((n_features, n_zones), jnp.float32),
     )
 
 
@@ -87,9 +89,12 @@ def embed(params: DeepParams, features: jax.Array,
 
 
 def head(params: DeepParams, x: jax.Array, workload_valid: jax.Array,
-         clamp: bool = True) -> jax.Array:
-    """[.., D] → watts [.., Z] (also outside the pipeline)."""
+         clamp: bool = True, features: jax.Array | None = None) -> jax.Array:
+    """[.., D] → watts [.., Z] (also outside the pipeline). ``features``
+    feeds the wide f32 skip path (see predict_mlp's w_skip note)."""
     watts = x @ params["w_head"] + params["b_head"]
+    if features is not None:
+        watts = watts + features.astype(jnp.float32) @ params["w_skip"]
     if clamp:
         watts = jnp.maximum(watts, 0.0)
     return jnp.where(workload_valid[..., None], watts, 0.0)
@@ -109,4 +114,4 @@ def predict_deep(
         return block_fn(block, x, compute_dtype), None
 
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    return head(params, x, workload_valid, clamp)
+    return head(params, x, workload_valid, clamp, features=features)

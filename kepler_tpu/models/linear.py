@@ -35,6 +35,44 @@ def init_linear(
     )
 
 
+def fit_linear_exact(
+    features: jax.Array,  # [..., W, F]
+    workload_valid: jax.Array,  # bool [..., W]
+    target_watts: jax.Array,  # [..., W, Z]
+    label_valid: jax.Array | None = None,  # bool [..., W, Z]
+) -> LinearParams:
+    """Closed-form masked least squares → exact-optimum LinearParams.
+
+    Linear regression is classically *solved*, not descended (the
+    kepler-model-server fits its linear family offline with an exact
+    solver); on TPU the solve is one small device program — an SVD-based
+    ``lstsq`` on the flattened ``[R, F]`` design matrix, R = all valid
+    workload rows. The bias column is feature 5 (constant 1), so the
+    learned bias lives inside ``weight`` and ``bias`` stays zero.
+
+    With ``label_valid`` each zone's column solves against only its own
+    labelled rows (vmapped per-zone lstsq with that zone's row mask).
+    """
+    f = features.shape[-1]
+    z = target_watts.shape[-1]
+    x = features.reshape(-1, f)
+    y = target_watts.reshape(-1, z)
+    m = workload_valid.reshape(-1).astype(x.dtype)
+    if label_valid is None:
+        xm = x * m[:, None]
+        w, _, _, _ = jnp.linalg.lstsq(xm, y * m[:, None])
+    else:
+        lm = label_valid.reshape(-1, z).astype(x.dtype) * m[:, None]
+
+        def solve_zone(mz, yz):
+            wz, _, _, _ = jnp.linalg.lstsq(x * mz[:, None], yz * mz)
+            return wz  # [F]
+
+        w = jax.vmap(solve_zone, in_axes=(1, 1), out_axes=1)(lm, y)
+    return LinearParams(weight=w.astype(jnp.float32),
+                        bias=jnp.zeros((z,), jnp.float32))
+
+
 def predict_linear(
     params: LinearParams,
     features: jax.Array,  # [..., W, F]

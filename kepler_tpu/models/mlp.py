@@ -33,6 +33,7 @@ class MLPParams(TypedDict):
     b1: jax.Array  # [H]
     w2: jax.Array  # [H, Z]
     b2: jax.Array  # [Z]
+    w_skip: jax.Array  # [F, Z] wide path (direct linear features → watts)
 
 
 def init_mlp(
@@ -47,8 +48,9 @@ def init_mlp(
         b0=jnp.zeros((hidden,), jnp.float32),
         w1=glorot(k1, (hidden, hidden)),
         b1=jnp.zeros((hidden,), jnp.float32),
-        w2=glorot(k2, (hidden, n_zones)),
+        w2=jnp.zeros((hidden, n_zones), jnp.float32),  # zero-init output
         b2=jnp.zeros((n_zones,), jnp.float32),
+        w_skip=jnp.zeros((n_features, n_zones), jnp.float32),
     )
 
 
@@ -61,6 +63,13 @@ def predict_mlp(
 ) -> jax.Array:
     """→ watts f32 [..., W, Z]; bf16 matmuls, f32 accumulation at the end.
 
+    Wide-and-deep: the ``w_skip`` path carries the dominant linear
+    power-vs-CPU-time signal in full f32 (power models are linear to first
+    order — the ratio formula itself is), the GELU trunk learns the
+    nonlinear correction. Keeps the estimator within the 0.5% ground-truth
+    budget even with a bf16 trunk: the trunk's head can shrink toward zero
+    where the relationship is linear, taking its rounding noise with it.
+
     ``clamp`` as in ``predict_linear``: floor at 0 W for serving only —
     training needs gradients through negative raw outputs.
     """
@@ -70,6 +79,7 @@ def predict_mlp(
     h = jax.nn.gelu(h @ params["w1"].astype(compute_dtype)
                     + params["b1"].astype(compute_dtype))
     watts = (h @ params["w2"].astype(compute_dtype)).astype(jnp.float32)
+    watts = watts + features.astype(jnp.float32) @ params["w_skip"]
     watts = watts + params["b2"]
     if clamp:
         watts = jnp.maximum(watts, 0.0)

@@ -33,6 +33,7 @@ class MoEParams(TypedDict):
     b0: jax.Array  # [E, H]
     w1: jax.Array  # [E, H, Z]
     b1: jax.Array  # [E, Z]
+    w_skip: jax.Array  # [E, F, Z] per-expert wide path (linear watts)
 
 
 def init_moe(
@@ -47,8 +48,9 @@ def init_moe(
         gate_w=glorot(kg, (n_features, n_experts)),
         w0=glorot(k0, (n_experts, n_features, hidden)),
         b0=jnp.zeros((n_experts, hidden), jnp.float32),
-        w1=glorot(k1, (n_experts, hidden, n_zones)),
+        w1=jnp.zeros((n_experts, hidden, n_zones), jnp.float32),  # zero-init
         b1=jnp.zeros((n_experts, n_zones), jnp.float32),
+        w_skip=jnp.zeros((n_experts, n_features, n_zones), jnp.float32),
     )
 
 
@@ -57,7 +59,12 @@ def expert_forward(
     x: jax.Array,  # [E, C, F] rows already grouped per expert
     compute_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jax.Array:
-    """Batched per-expert MLP → f32 [E, C, Z]. Shared by dense and EP paths."""
+    """Batched per-expert MLP → f32 [E, C, Z]. Shared by dense and EP paths.
+
+    Wide-and-deep per expert: each node type's dominant linear power curve
+    rides the f32 ``w_skip`` einsum (Z is tiny, so it's free); the GELU
+    trunk learns the type-specific nonlinearity (see predict_mlp's note).
+    """
     cd = compute_dtype
     h = jax.nn.gelu(
         jnp.einsum("ecf,efh->ech", x.astype(cd), params["w0"].astype(cd),
@@ -66,6 +73,8 @@ def expert_forward(
     return (
         jnp.einsum("ech,ehz->ecz", h.astype(cd), params["w1"].astype(cd),
                    preferred_element_type=jnp.float32)
+        + jnp.einsum("ecf,efz->ecz", x.astype(jnp.float32),
+                     params["w_skip"])
         + params["b1"][:, None, :])
 
 

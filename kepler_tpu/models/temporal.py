@@ -55,6 +55,7 @@ class TemporalParams(TypedDict):
     ln_f_bias: jax.Array  # [D]
     w_head: jax.Array  # [D, Z]
     b_head: jax.Array  # [Z]
+    w_skip: jax.Array  # [F, Z] wide path from the CURRENT tick's features
 
 
 N_HEADS = 4
@@ -88,6 +89,7 @@ def init_temporal(
         ln_f_bias=jnp.zeros((d_model,), jnp.float32),
         w_head=jnp.zeros((d_model, n_zones), jnp.float32),
         b_head=jnp.zeros((n_zones,), jnp.float32),
+        w_skip=jnp.zeros((n_features, n_zones), jnp.float32),
     )
 
 
@@ -218,15 +220,21 @@ def predict_temporal(
     x = feat_hist.reshape(-1, t, f)
     tv = (jnp.ones(x.shape[:2], bool) if t_valid is None
           else t_valid.reshape(-1, t))
+    last = jnp.maximum(jnp.sum(tv, axis=-1) - 1, 0).astype(jnp.int32)
     if attention_fn is None:
         pooled = _last_query_trunk(params, x, tv, compute_dtype)
     else:
         hidden = temporal_trunk(params, x, tv, attention_fn=attention_fn,
                                 compute_dtype=compute_dtype)
-        last = jnp.maximum(jnp.sum(tv, axis=-1) - 1, 0)  # last tick index
         pooled = jnp.take_along_axis(
-            hidden, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
-    watts = pooled @ params["w_head"] + params["b_head"]
+            hidden, last[:, None, None], axis=1)[:, 0]
+    # wide-and-deep: the current (= last valid) tick's raw features carry
+    # the first-order linear power signal in f32; the attention trunk adds
+    # the history-conditioned correction (see predict_mlp's w_skip note)
+    feat_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+    watts = (pooled @ params["w_head"]
+             + feat_last.astype(jnp.float32) @ params["w_skip"]
+             + params["b_head"])
     watts = watts.reshape(*lead, -1)
     if clamp:
         watts = jnp.maximum(watts, 0.0)

@@ -46,6 +46,60 @@ def masked_mse(
     return total / count
 
 
+def masked_relative_mse(
+    pred_watts: jax.Array,  # [..., W, Z]
+    target_watts: jax.Array,  # [..., W, Z]
+    workload_valid: jax.Array,  # bool [..., W]
+    label_valid: jax.Array | None = None,  # bool [..., W, Z]
+    floor_watts: float = 0.1,
+) -> jax.Array:
+    """MSE of (pred−target)/max(|target|, floor) — optimizes the metric the
+    north star is stated in (percent of ground truth), so the tail of SMALL
+    workloads converges instead of being drowned by the big ones plain MSE
+    favors. ``floor_watts`` keeps near-zero labels from exploding the
+    scale (below it, errors count absolutely in floor units)."""
+    scale = jnp.maximum(jnp.abs(target_watts), floor_watts)
+    err = ((pred_watts - target_watts) / scale) ** 2
+    mask = workload_valid[..., None].astype(err.dtype)
+    if label_valid is not None:
+        mask = mask * label_valid.astype(err.dtype)
+    total = jnp.sum(err * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count
+
+
+def warm_start_wide(params: Params, features: jax.Array,
+                    workload_valid: jax.Array, target_watts: jax.Array,
+                    label_valid: jax.Array | None = None) -> Params:
+    """Residual-fitting warm start for a wide-and-deep family: solve the
+    wide path (``w_skip``) in closed form against the labels, so gradient
+    training starts from the exact linear optimum and the trunk learns only
+    the nonlinear correction. Works for any params dict with a ``w_skip
+    [F, Z]`` leaf (mlp / temporal / deep — temporal callers pass the
+    current-tick features)."""
+    from kepler_tpu.models.linear import fit_linear_exact
+
+    sol = fit_linear_exact(features, workload_valid, target_watts,
+                           label_valid)
+    return {**params, "w_skip": sol["weight"]}
+
+
+def warm_start_moe(params: Params, features: jax.Array,
+                   workload_valid: jax.Array, target_watts: jax.Array,
+                   expert_id: jax.Array) -> Params:
+    """Per-expert closed-form warm start of the MoE's ``w_skip [E, F, Z]``:
+    each expert solves against only the rows routed to it (its node type's
+    linear power curve)."""
+    from kepler_tpu.models.linear import fit_linear_exact
+
+    n_experts = int(params["w0"].shape[0])
+    sols = []
+    for e in range(n_experts):
+        mask = workload_valid & jnp.expand_dims(expert_id == e, -1)
+        sols.append(fit_linear_exact(features, mask, target_watts)["weight"])
+    return {**params, "w_skip": jnp.stack(sols)}
+
+
 def make_optimizer(learning_rate: float = 1e-3,
                    weight_decay: float = 1e-4) -> optax.GradientTransformation:
     return optax.adamw(learning_rate, weight_decay=weight_decay)

@@ -96,9 +96,10 @@ def make_expert_parallel_moe(
     # expert weights shard on their leading E axis; the router's gate_w is
     # [F, E] (E is axis 1) and is only read OUTSIDE the shard_map anyway
     p_spec = dict(gate_w=P(None, axis_name), w0=P(axis_name),
-                  b0=P(axis_name), w1=P(axis_name), b1=P(axis_name))
+                  b0=P(axis_name), w1=P(axis_name), b1=P(axis_name),
+                  w_skip=P(axis_name))
     p_shard = {k: NamedSharding(mesh, s) for k, s in p_spec.items()}
-    expert_keys = ("w0", "b0", "w1", "b1")
+    expert_keys = ("w0", "b0", "w1", "b1", "w_skip")
 
     def fn(params, features, expert_id, gate_prob):
         b_loc = features.shape[0] // n

@@ -127,6 +127,7 @@ def make_pipelined_deep(
     stages = NamedSharding(mesh, P(axis_name))
     rep = NamedSharding(mesh, P())
     shardings = dict(in_proj=rep, in_bias=rep, w_head=rep, b_head=rep,
+                     w_skip=rep,
                      blocks=jax.tree.map(lambda _: stages,
                                          dict(ln_scale=0, ln_bias=0, w0=0,
                                               b0=0, w1=0, b1=0)))
@@ -134,7 +135,7 @@ def make_pipelined_deep(
     def fn(params, features, workload_valid):
         x = embed(params, features, compute_dtype)
         x = pipeline(params["blocks"], x)
-        return head(params, x, workload_valid, clamp)
+        return head(params, x, workload_valid, clamp, features=features)
 
     return jax.jit(fn, in_shardings=(shardings, rep, rep),
                    out_shardings=rep)

@@ -42,6 +42,7 @@ def mlp_param_shardings(mesh: Mesh) -> MLPParams:
         b1=ns(),
         w2=ns(),
         b2=ns(),
+        w_skip=ns(),  # wide path: [F, Z] is tiny, replicate
     )
 
 

@@ -185,6 +185,134 @@ class TestWatch:
         assert watch_paths and "resourceVersion=99" in watch_paths[0]
 
 
+class TestWatchFaults:
+    """ERROR-410 / bookmark / disconnect recovery (reference gets these from
+    controller-runtime's reflector, pod.go:136-196)."""
+
+    def test_error_event_resets_rv_and_signals_expiry(self):
+        inf, client = make_informer({
+            "metadata": {"resourceVersion": "7"}, "items": []})
+        client.watch_events = [
+            {"type": "ERROR", "object": {
+                "kind": "Status", "code": 410, "reason": "Expired"}},
+            # events after the ERROR must not be consumed from this stream
+            {"type": "ADDED", "object": pod_obj(
+                UID_A, "web", containers=[("app", "containerd://late")],
+                rv="99")},
+        ]
+        expired = inf._watch(CancelContext())
+        assert expired is True
+        assert inf._resource_version == ""
+        assert inf.lookup_by_container_id("late") is None
+
+    def test_bookmark_advances_rv_without_cache_change(self):
+        inf, client = make_informer({
+            "metadata": {"resourceVersion": "7"},
+            "items": [pod_obj(UID_A, "web",
+                              containers=[("app", "containerd://keep")])],
+        })
+        client.watch_events = [
+            {"type": "BOOKMARK", "object": {
+                "metadata": {"resourceVersion": "120"}}},
+        ]
+        expired = inf._watch(CancelContext())
+        assert expired is False
+        assert inf._resource_version == "120"
+        assert inf.lookup_by_container_id("keep") == (
+            UID_A, "web", "default", "app")
+
+    def test_watch_requests_bookmarks(self):
+        inf, client = make_informer({"items": []})
+        inf._watch(CancelContext())
+        watch_paths = [p for p in client.paths if "watch=true" in p]
+        assert watch_paths and "allowWatchBookmarks=true" in watch_paths[0]
+
+    def test_error_triggers_immediate_relist_and_rewatch(self):
+        """A 410 must not wedge the cache until the resync timer: run()
+        re-lists immediately and resumes the watch from the fresh rv."""
+        ctx = CancelContext()
+
+        class FaultClient:
+            def __init__(self):
+                self.paths = []
+                self.watch_count = 0
+
+            def get(self, path, timeout=30.0):
+                self.paths.append(path)
+                if "watch=true" in path:
+                    self.watch_count += 1
+                    if self.watch_count == 1:
+                        frame = json.dumps({"type": "ERROR", "object": {
+                            "kind": "Status", "code": 410,
+                            "reason": "Expired"}})
+                        return io.BytesIO(frame.encode() + b"\n")
+                    ctx.cancel()
+                    return io.BytesIO(b"")
+                return io.BytesIO(json.dumps({
+                    "metadata": {"resourceVersion": "200"},
+                    "items": [pod_obj(
+                        UID_A, "web",
+                        containers=[("app", "containerd://c-new")])],
+                }).encode())
+
+        client = FaultClient()
+        inf = PodInformer("node-1", client=client, resync_interval=300.0)
+        inf.init()
+        t = threading.Thread(target=inf.run, args=(ctx,))
+        t.start()
+        t.join(timeout=3)  # immediate recovery, not the 5 s backoff
+        assert not t.is_alive()
+        # sequence: LIST(init), WATCH(ERROR), LIST(recovery), WATCH(resume)
+        kinds = ["watch" if "watch=true" in p else "list"
+                 for p in client.paths]
+        assert kinds == ["list", "watch", "list", "watch"]
+        assert "resourceVersion=200" in client.paths[3]
+        assert inf.lookup_by_container_id("c-new") == (
+            UID_A, "web", "default", "app")
+
+    def test_disconnect_then_periodic_relist_resumes(self):
+        """A mid-stream disconnect falls back to the resync re-list, and the
+        next watch resumes from the re-listed resourceVersion."""
+        ctx = CancelContext()
+
+        class DropClient:
+            def __init__(self):
+                self.paths = []
+                self.watch_count = 0
+
+            def get(self, path, timeout=30.0):
+                self.paths.append(path)
+                if "watch=true" in path:
+                    self.watch_count += 1
+                    if self.watch_count == 1:
+                        frame = json.dumps({"type": "ADDED", "object": pod_obj(
+                            UID_A, "web",
+                            containers=[("app", "containerd://c1")],
+                            rv="55")})
+                        # deliver one event, then the stream dies
+                        return io.BytesIO(frame.encode() + b"\n")
+                    ctx.cancel()
+                    return io.BytesIO(b"")
+                return io.BytesIO(json.dumps({
+                    "metadata": {"resourceVersion": "77"},
+                    "items": [pod_obj(
+                        UID_A, "web",
+                        containers=[("app", "containerd://c1")])],
+                }).encode())
+
+        client = DropClient()
+        inf = PodInformer("node-1", client=client, resync_interval=0.01)
+        inf.init()
+        t = threading.Thread(target=inf.run, args=(ctx,))
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        watch_paths = [p for p in client.paths if "watch=true" in p]
+        assert len(watch_paths) == 2
+        assert "resourceVersion=77" in watch_paths[1]
+        assert inf.lookup_by_container_id("c1") is not None
+
+
 class TestResourceLayerIntegration:
     def test_informer_feeds_pod_lookup(self):
         """ResourceInformer resolves container → pod via the k8s index
