@@ -205,6 +205,23 @@ def main() -> None:
     acc_fields = run_all(packed_program=program, packed_batch=batch,
                          packed_params=params)
 
+    # ---- on-node scrape-to-export (host path, the reference's whole hot
+    # loop) — subprocess so attribution runs on host CPU, the node-agent
+    # configuration (agents don't own chips; the aggregator does) --------
+    node_fields = {}
+    try:
+        import subprocess
+
+        cp = subprocess.run(
+            [sys.executable, "-m", "benchmarks.node_path",
+             "--procs", "10000", "--iters", "9"],
+            capture_output=True, timeout=900, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        node_fields = json.loads(cp.stdout.strip().splitlines()[-1])
+    except Exception as err:  # never sink the headline on a host hiccup
+        node_fields = {"node_scrape_error": repr(err)[:200]}
+
     pods = int(np.asarray(batch.workload_valid).sum())
     result = {
         "metric": "attribution_program_p99_ms_10k_pods",
@@ -231,6 +248,7 @@ def main() -> None:
     }
     result.update({k: (round(v, 8) if isinstance(v, float) else v)
                    for k, v in acc_fields.items()})
+    result.update(node_fields)
     print(json.dumps(result))
     if not acc_fields["accuracy_ok"]:
         sys.exit(1)

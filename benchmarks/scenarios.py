@@ -160,6 +160,37 @@ def run_temporal_scenario(mesh, backend, on_tpu, iters, repeats):
     }
 
 
+NODE_PATH_BUDGET_MS = 2000.0  # p99 scrape→export @10k procs; order-of-
+# magnitude tripwire (host path: absolute wall time varies with CI CPU, so
+# the budget is deliberately loose — precise numbers are in the row)
+
+
+def run_node_path_scenario(n_procs: int) -> dict:
+    """On-node scrape-to-export p99 (benchmarks/node_path) as a gated row.
+    Runs in a subprocess with CPU attribution — the node-agent
+    configuration — so the TPU scenarios above keep the device."""
+    import subprocess
+
+    budget = NODE_PATH_BUDGET_MS * (n_procs / 10_000)
+    try:
+        cp = subprocess.run(
+            [sys.executable, "-m", "benchmarks.node_path",
+             "--procs", str(n_procs), "--iters", "7"],
+            capture_output=True, timeout=900, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        row = json.loads(cp.stdout.strip().splitlines()[-1])
+    except Exception as err:
+        return {"scenario": "node-scrape-to-export",
+                "error": repr(err)[:200], "within_budget": False,
+                "budget_ms": budget}
+    row["scenario"] = "node-scrape-to-export"
+    row["budget_ms"] = budget
+    row["within_budget"] = (
+        row["node_scrape_to_export_p99_ms"] <= budget)
+    return row
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--iters", type=int, default=20)
@@ -172,6 +203,9 @@ def main() -> None:
     p.add_argument("--max-vs-einsum", type=float, default=3.0,
                    help="allowed slowdown of a non-einsum backend vs the "
                         "einsum baseline before the gate fails")
+    p.add_argument("--node-procs", type=int, default=10_000,
+                   help="process count for the on-node scrape-to-export "
+                        "row (0 disables it; CI may shrink it)")
     args = p.parse_args()
 
     import jax
@@ -246,6 +280,18 @@ def main() -> None:
             failures.append(f"{name}: device p50 {dev_p50:.4f} ms exceeds "
                             f"budget {scaled_budget} ms")
         print(json.dumps(row))
+
+    if args.node_procs > 0:
+        node_row = run_node_path_scenario(args.node_procs)
+        print(json.dumps(node_row))
+        if "error" in node_row:
+            failures.append(
+                f"node-scrape-to-export: {node_row['error']}")
+        elif not node_row.get("within_budget", True):
+            failures.append(
+                f"node-scrape-to-export: p99 "
+                f"{node_row['node_scrape_to_export_p99_ms']} ms exceeds "
+                f"budget {node_row['budget_ms']} ms")
 
     row = run_temporal_scenario(mesh, args.backend, on_tpu, args.iters,
                                 repeats)

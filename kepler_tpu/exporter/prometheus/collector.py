@@ -39,6 +39,31 @@ _META_LABEL_SETS = {
     "pod": ("pod_id", "pod_name", "pod_namespace"),
 }
 
+# ONE definition of the family tables, consumed by both the registry path
+# (collect()) and the direct text fast path (render_text()) — keep them
+# here so the two renders cannot diverge.
+#   kind → (level bit, Snapshot attr, terminated Snapshot attr)
+_KIND_TABLES = (
+    ("process", Level.PROCESS, "processes", "terminated_processes"),
+    ("container", Level.CONTAINER, "containers", "terminated_containers"),
+    ("vm", Level.VM, "virtual_machines", "terminated_virtual_machines"),
+    ("pod", Level.POD, "pods", "terminated_pods"),
+)
+#   (name suffix, type, doc stem, NodeUsage attrs (total, active, idle),
+#    unit scale)
+_NODE_VARIANTS = (
+    ("joules_total", "counter", "Energy consumption of cpu",
+     ("energy_uj", "active_uj", "idle_uj"), 1 / JOULE),
+    ("watts", "gauge", "Power consumption of cpu",
+     ("power_uw", "active_power_uw", "idle_power_uw"), 1 / WATT),
+)
+
+
+def _node_family_doc(desc: str, state: str) -> str:
+    return (f"{desc}"
+            f"{' in ' + state.rstrip('_') + ' state' if state else ''}"
+            " at node level")
+
 
 class PowerCollector:
     """Custom collector; registered into the exporter's registry."""
@@ -79,21 +104,13 @@ class PowerCollector:
                 "CPU usage ratio of a node (active/total)",
                 labels=list(const))
             yield self._with_const(ratio, [], snap.node.usage_ratio, const)
-        kind_level = {
-            "process": (Level.PROCESS, snap.processes,
-                        snap.terminated_processes),
-            "container": (Level.CONTAINER, snap.containers,
-                          snap.terminated_containers),
-            "vm": (Level.VM, snap.virtual_machines,
-                   snap.terminated_virtual_machines),
-            "pod": (Level.POD, snap.pods, snap.terminated_pods),
-        }
         zone_names = snap.node.zone_names
-        for kind, (level, running, terminated) in kind_level.items():
+        for kind, level, run_attr, term_attr in _KIND_TABLES:
             if level not in self._level:
                 continue
             yield from self._workload_metrics(
-                kind, zone_names, running, terminated, const)
+                kind, zone_names, getattr(snap, run_attr),
+                getattr(snap, term_attr), const)
 
     # -- helpers ----------------------------------------------------------
 
@@ -105,22 +122,15 @@ class PowerCollector:
 
     def _node_metrics(self, snap, const: dict[str, str]):
         node = snap.node
-        variants = (
-            ("joules_total", CounterMetricFamily, "Energy consumption of cpu",
-             (node.energy_uj, node.active_uj, node.idle_uj), 1 / JOULE),
-            ("watts", GaugeMetricFamily, "Power consumption of cpu",
-             (node.power_uw, node.active_power_uw, node.idle_power_uw),
-             1 / WATT),
-        )
         const_keys = list(const)
-        for suffix, ctor, desc, (total, active, idle), scale in variants:
-            for state, values in (("", total), ("active_", active),
-                                  ("idle_", idle)):
+        for suffix, mtype, desc, attrs, scale in _NODE_VARIANTS:
+            ctor = (CounterMetricFamily if mtype == "counter"
+                    else GaugeMetricFamily)
+            for state, attr in zip(("", "active_", "idle_"), attrs):
+                values = getattr(node, attr)
                 name = f"kepler_node_cpu_{state}{suffix}"
                 family = ctor(
-                    name,
-                    f"{desc}{' in ' + state.rstrip('_') + ' state' if state else ''}"
-                    " at node level",
+                    name, _node_family_doc(desc, state),
                     labels=["zone", "path"] + const_keys)
                 for z, zone in enumerate(node.zone_names):
                     family.add_metric(
@@ -163,6 +173,154 @@ class PowerCollector:
         yield watts
         if seconds is not None:
             yield seconds
+
+    # -- direct text render (the node hot path) ---------------------------
+    #
+    # Rendering 10k processes through prometheus_client costs ~650 ms per
+    # scrape (per-sample Metric objects + per-sample label re-escaping);
+    # the snapshot already holds everything in table form, so the exporter
+    # renders the kepler families straight to classic text, caching each
+    # workload's escaped label block across scrapes (labels change on
+    # exec/reclassify; counters change every tick). Output is byte-
+    # identical to prometheus_client's generate_latest over this collector
+    # — pinned by tests/test_exporter_wire.py.
+
+    def render_text(self) -> bytes:
+        """Classic-text exposition of this collector's families (fast
+        path). Empty bytes when not ready / snapshot unavailable — the
+        same scrapes collect() would skip."""
+        from kepler_tpu.exporter.prometheus.fastexpo import _escape_value
+
+        if not self._is_ready():
+            return b""
+        try:
+            snap = self._monitor.snapshot()
+        except SnapshotUnavailableError as err:
+            log.warning("scrape skipped: %s", err)
+            return b""
+        const = {"node_name": self._node_name} if self._node_name else {}
+        out: list[str] = []
+        if Level.NODE in self._level:
+            self._render_node_text(out, snap, const)
+        ezones = [(z, _escape_value(z)) for z in snap.node.zone_names]
+        new_cache: dict = {}
+        for kind, level, run_attr, term_attr in _KIND_TABLES:
+            if level not in self._level:
+                continue
+            self._render_workload_text(out, kind, ezones,
+                                       getattr(snap, run_attr),
+                                       getattr(snap, term_attr), const,
+                                       new_cache)
+        self._label_cache = new_cache  # drop vanished workloads' entries
+        return "".join(out).encode("utf-8")
+
+    def _render_node_text(self, out: list[str], snap, const) -> None:
+        from prometheus_client.utils import floatToGoString
+
+        from kepler_tpu.exporter.prometheus.fastexpo import _escape_value
+
+        node = snap.node
+        for suffix, mtype, desc, attrs, scale in _NODE_VARIANTS:
+            for state, attr in zip(("", "active_", "idle_"), attrs):
+                values = getattr(node, attr)
+                name = f"kepler_node_cpu_{state}{suffix}"
+                doc = _node_family_doc(desc, state)
+                out.append(f"# HELP {name} {doc}\n")
+                out.append(f"# TYPE {name} {mtype}\n")
+                for z, zone in enumerate(node.zone_names):
+                    pairs = sorted({"zone": zone, "path": "",
+                                    **const}.items())
+                    labelstr = ",".join(
+                        f'{k}="{_escape_value(v)}"' for k, v in pairs)
+                    out.append(f"{name}{{{labelstr}}} "
+                               f"{floatToGoString(values[z] * scale)}\n")
+        name = "kepler_node_cpu_usage_ratio"
+        out.append(f"# HELP {name} CPU usage ratio of a node "
+                   "(active/total)\n")
+        out.append(f"# TYPE {name} gauge\n")
+        if const:
+            pairs = sorted(const.items())
+            labelstr = "{%s}" % ",".join(
+                f'{k}="{_escape_value(v)}"' for k, v in pairs)
+        else:
+            labelstr = ""
+        out.append(f"{name}{labelstr} "
+                   f"{floatToGoString(node.usage_ratio)}\n")
+
+    def _render_workload_text(self, out: list[str], kind: str, ezones,
+                              running: WorkloadTable,
+                              terminated: WorkloadTable, const,
+                              new_cache: dict) -> None:
+        from kepler_tpu.exporter.prometheus.fastexpo import (_escape_value,
+                                                            fmt_float)
+
+        label_names = list(_META_LABEL_SETS[kind])
+        # the cached per-row block holds every label EXCEPT zone; valid
+        # only because "zone" sorts after all label names we emit
+        assert all(k < "zone" for k in
+                   label_names + ["state"] + list(const))
+        nonzone = label_names + ["state"] + list(const)
+        order = sorted(range(len(nonzone)), key=lambda i: nonzone[i])
+        jname = f"kepler_{kind}_cpu_joules_total"
+        wname = f"kepler_{kind}_cpu_watts"
+        j_lines: list[str] = []
+        w_lines: list[str] = []
+        s_lines: list[str] = []
+        cache = getattr(self, "_label_cache", {})
+        const_vals = tuple(const.values())
+        is_process = kind == "process"
+        for state, table in (("running", running),
+                             ("terminated", terminated)):
+            energy = table.energy_uj
+            power = table.power_uw
+            metas = table.meta
+            for i, wid in enumerate(table.ids):
+                meta = metas[i]
+                key = (kind, state, wid)
+                cached = cache.get(key)
+                # meta dicts are rebuilt per refresh but rarely CHANGE;
+                # one C-speed dict compare replaces label extraction,
+                # escaping, and sorting for the unchanged 90%+
+                if cached is not None and cached[0] == meta:
+                    prefix, s_val = cached[1], cached[2]
+                    new_cache[key] = cached
+                else:
+                    values = self._label_values(kind, wid, meta,
+                                                label_names)
+                    row = tuple(values) + (state,) + const_vals
+                    prefix = "{" + ",".join(
+                        f'{nonzone[i_]}="{_escape_value(row[i_])}"'
+                        for i_ in order)
+                    s_val = (fmt_float(float(meta["_cpu_total_seconds"]))
+                             if is_process and "_cpu_total_seconds" in meta
+                             else None)
+                    new_cache[key] = (meta, prefix, s_val)
+                for z, (_, ez) in enumerate(ezones):
+                    # divide (not multiply-by-inverse): byte parity with
+                    # collect()'s float(x) / JOULE rounding
+                    j_lines.append(
+                        f'{jname}{prefix},zone="{ez}"}} '
+                        f"{fmt_float(float(energy[i, z]) / JOULE)}\n")
+                    w_lines.append(
+                        f'{wname}{prefix},zone="{ez}"}} '
+                        f"{fmt_float(float(power[i, z]) / WATT)}\n")
+                if s_val is not None:
+                    s_lines.append(
+                        f"kepler_process_cpu_seconds_total{prefix}}} "
+                        f"{s_val}\n")
+        out.append(f"# HELP {jname} Energy consumption of cpu at {kind} "
+                   "level in joules\n")
+        out.append(f"# TYPE {jname} counter\n")
+        out.extend(j_lines)
+        out.append(f"# HELP {wname} Power consumption of cpu at {kind} "
+                   "level in watts\n")
+        out.append(f"# TYPE {wname} gauge\n")
+        out.extend(w_lines)
+        if kind == "process":
+            out.append("# HELP kepler_process_cpu_seconds_total Total user "
+                       "and system time of the process in seconds\n")
+            out.append("# TYPE kepler_process_cpu_seconds_total counter\n")
+            out.extend(s_lines)
 
     @staticmethod
     def _label_values(kind: str, wid: str, meta, label_names: Iterable[str]

@@ -12,9 +12,10 @@ import logging
 from typing import Sequence
 
 from prometheus_client import CollectorRegistry
-from prometheus_client.exposition import CONTENT_TYPE_LATEST, generate_latest
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
 
 from kepler_tpu.config.level import Level
+from kepler_tpu.exporter.prometheus.fastexpo import fast_generate_latest
 from kepler_tpu.exporter.prometheus.collector import PowerCollector
 from kepler_tpu.exporter.prometheus.info_collectors import (
     BuildInfoCollector,
@@ -54,6 +55,14 @@ class PrometheusExporter:
         self._collectors = list(collectors)
         self._debug = list(debug_collectors)
         self._registry = CollectorRegistry()
+        # classic-text scrapes render PowerCollectors via their direct
+        # snapshot→text fast path and everything else through the registry;
+        # ordering (power first) matches create_collectors' registration
+        # order so the fast output is byte-identical to a full registry
+        # render (tests/test_exporter_wire.py pins it)
+        self._power = [c for c in self._collectors
+                       if isinstance(c, PowerCollector)]
+        self._aux_registry = CollectorRegistry()
 
     def name(self) -> str:
         return "prometheus-exporter"
@@ -61,6 +70,8 @@ class PrometheusExporter:
     def init(self) -> None:
         for c in self._collectors:
             self._registry.register(c)  # type: ignore[arg-type]
+            if not isinstance(c, PowerCollector):
+                self._aux_registry.register(c)  # type: ignore[arg-type]
         if "go" in self._debug or "process" in self._debug:
             # Python-runtime analog of the Go runtime collectors
             try:
@@ -71,10 +82,11 @@ class PrometheusExporter:
                 )
                 for c in (GC_COLLECTOR, PLATFORM_COLLECTOR,
                           PROCESS_COLLECTOR):
-                    try:
-                        self._registry.register(c)
-                    except ValueError:
-                        pass  # already registered into this registry
+                    for reg in (self._registry, self._aux_registry):
+                        try:
+                            reg.register(c)
+                        except ValueError:
+                            pass  # already registered into this registry
             except ImportError:  # pragma: no cover
                 log.debug("runtime collectors unavailable")
         self._server.register(
@@ -93,7 +105,8 @@ class PrometheusExporter:
             return (200,
                     {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
                     om_exposition.generate_latest(self._registry))
-        payload = generate_latest(self._registry)
+        payload = (b"".join(c.render_text() for c in self._power)
+                   + fast_generate_latest(self._aux_registry))
         return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
 
     @property

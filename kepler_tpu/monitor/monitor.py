@@ -422,15 +422,21 @@ class PowerMonitor:
             ids = [batch.ids[i] for i in idx]
             kind_meta = meta_by_kind[kind_name]
             nz = len(self._zone_names)
-            energy_rows = np.zeros((len(idx), nz))
-            power_rows = power_wz[idx] if len(idx) else np.zeros((0, nz))
-            for row, (i, wid) in enumerate(zip(idx, ids)):
-                acc = store.get(wid)
-                if acc is None:
-                    acc = np.zeros(nz)
-                acc = acc + energy_delta_wz[i]
-                store[wid] = acc
-                energy_rows[row] = acc
+            n = len(ids)
+            energy_rows = np.zeros((n, nz))
+            power_rows = power_wz[idx] if n else np.zeros((0, nz))
+            # gather prev cumulative, one vectorized add, scatter views
+            # back (rows alias energy_rows — safe: snapshot arrays are
+            # never mutated after publication, each refresh builds new)
+            get = store.get
+            for row, wid in enumerate(ids):
+                acc = get(wid)
+                if acc is not None:
+                    energy_rows[row] = acc
+            if n:
+                energy_rows += energy_delta_wz[idx]
+            for row, wid in enumerate(ids):
+                store[wid] = energy_rows[row]
             meta_rows = tuple(kind_meta.get(wid, {}) for wid in ids)
             self._meta_cache[kind_name].update(zip(ids, meta_rows))
             # terminated ids stay in the store until _handle_terminated has

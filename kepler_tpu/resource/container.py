@@ -30,20 +30,19 @@ _PATTERNS: list[tuple[re.Pattern[str], ContainerRuntime]] = [
 def container_info_from_cgroup_paths(
     paths: list[str],
 ) -> tuple[ContainerRuntime, str]:
-    """Return (runtime, container_id) of the deepest matching path.
+    """Return (runtime, container_id) of the deepest match.
 
-    Deepest = most '/' components; systemd nesting puts the leaf container
-    scope deepest (reference container.go:92-141).
+    Deepest = highest match start index, across ALL matches in all paths —
+    systemd nesting (kind-in-docker) puts the leaf container scope after
+    its host's, so the later match identifies the process (reference
+    container.go:92-141 sorts by StartIdx descending).
     """
     best: tuple[int, ContainerRuntime, str] | None = None
     for path in paths:
         for pattern, runtime in _PATTERNS:
-            m = pattern.search(path)
-            if not m:
-                continue
-            depth = path.count("/")
-            if best is None or depth > best[0]:
-                best = (depth, runtime, m.group(1))
+            for m in pattern.finditer(path):
+                if best is None or m.start() > best[0]:
+                    best = (m.start(), runtime, m.group(1))
     if best is None:
         return ContainerRuntime.UNKNOWN, ""
     return best[1], best[2]
@@ -57,12 +56,21 @@ def _name_from_env(env: dict[str, str]) -> str:
 
 
 def _name_from_cmdline(cmdline: list[str]) -> str:
-    # docker/podman runtimes pass --name <name> or --name=<name>
+    # docker/podman runtimes pass --name <name> or --name=<name>; the
+    # containerd shims pass the container name positionally as argv[3]
+    # (reference container.go:162-190)
+    if len(cmdline) <= 1:
+        return ""
+    exe = cmdline[0].rsplit("/", 1)[-1]
+    shim = exe in ("docker-containerd-shim", "containerd-shim")
     for i, arg in enumerate(cmdline):
-        if arg == "--name" and i + 1 < len(cmdline):
-            return cmdline[i + 1]
-        if arg.startswith("--name="):
-            return arg.split("=", 1)[1]
+        if i > 0:
+            if arg == "--name" and i + 1 < len(cmdline):
+                return cmdline[i + 1]
+            if arg.startswith("--name="):
+                return arg.split("=", 1)[1]
+        if shim and i == 3:
+            return arg
     return ""
 
 

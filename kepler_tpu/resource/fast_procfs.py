@@ -46,6 +46,18 @@ class FastProcFSReader(ProcFSReader):
             for p, c in zip(pids, cpu)
         ]
 
+    def scan_arrays(self) -> tuple[list[int], list[float]]:
+        """→ (pids, cpu_seconds) as plain lists — the allocation-free tick
+        path: the informer updates its cache straight from these and only
+        materializes a ProcInfo for NEW pids (classification) or procs
+        whose comm needs re-reading. One C call, zero per-proc objects."""
+        pids, cpu = self._scanner.scan_procs(self._procfs)
+        return pids.tolist(), cpu.tolist()
+
+    def proc_info(self, pid: int) -> ProcFSInfo:
+        """Cold-path reader for one PID (classification/comm/exe)."""
+        return ProcFSInfo(self._procfs, pid)
+
     def _read_stat_totals(self) -> tuple[float, float]:
         return self._scanner.stat_totals(self._procfs)
 

@@ -160,19 +160,65 @@ class ResourceInformer:
         self._last_scan = self._clock()
 
     def _refresh_processes(self) -> None:
-        running: dict[int, Process] = {}
-        for proc in self._fs.all_procs():
-            try:
-                entry = self._update_process_cache(proc)
-            except OSError:
-                continue  # PID vanished mid-scan (reference :186-190)
-            running[entry.pid] = entry
+        scan = getattr(self._fs, "scan_arrays", None)
+        if scan is not None:
+            pids, cpus = scan()
+            running = self._refresh_from_arrays(pids, cpus)
+        else:
+            running = {}
+            for proc in self._fs.all_procs():
+                try:
+                    entry = self._update_process_cache(proc)
+                except OSError:
+                    continue  # PID vanished mid-scan (reference :186-190)
+                except (ValueError, IndexError):
+                    continue  # truncated/garbage stat line mid-exit
+                running[entry.pid] = entry
         terminated = {
             pid: p for pid, p in self._proc_cache.items() if pid not in running
         }
         for pid in terminated:
             del self._proc_cache[pid]
         self._processes = Processes(running=running, terminated=terminated)
+
+    def _refresh_from_arrays(self, pids: list[int], cpus: list[float]
+                             ) -> dict[int, Process]:
+        """Tick path for readers with a batched scan (`scan_arrays`): same
+        cache semantics as `_update_process_cache`, but the 10k-per-tick
+        steady state touches only the cache dict — ProcInfo objects (and
+        their file reads) exist only for NEW pids and for procs whose
+        nonzero delta warrants a comm refresh."""
+        cache = self._proc_cache
+        proc_info = self._fs.proc_info
+        running: dict[int, Process] = {}
+        for pid, cpu in zip(pids, cpus):
+            cached = cache.get(pid)
+            if cached is None:
+                try:
+                    info = proc_info(pid)
+                    cached = Process(pid=pid, comm=info.comm(),
+                                     exe=info.executable(),
+                                     cpu_total_time=cpu, cpu_time_delta=cpu)
+                    self._classify(info, cached)
+                except OSError:
+                    continue  # PID vanished mid-scan
+                cache[pid] = cached
+                running[pid] = cached
+                continue
+            delta = cpu - cached.cpu_total_time
+            delta = delta if delta > 0.0 else 0.0
+            cached.cpu_time_delta = delta
+            cached.cpu_total_time = cpu
+            if delta > _RECLASSIFY_EPSILON:
+                try:
+                    info = proc_info(pid)
+                    cached.comm = info.comm()
+                    if not cached.classified:
+                        self._classify(info, cached)
+                except OSError:
+                    pass
+            running[pid] = cached
+        return running
 
     def _update_process_cache(self, proc: ProcInfo) -> Process:
         pid = proc.pid()

@@ -17,9 +17,12 @@ _QEMU_RE = re.compile(r"(bin/qemu-system-\w+|libexec/qemu-kvm)")
 
 
 def _extract_flag(cmdline: list[str], flag: str) -> str:
+    # "-name foo" and "-name=foo" forms (reference vm_test.go covers both)
     for i, arg in enumerate(cmdline):
         if arg == flag and i + 1 < len(cmdline):
             return cmdline[i + 1]
+        if arg.startswith(flag + "="):
+            return arg.split("=", 1)[1]
     return ""
 
 

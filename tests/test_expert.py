@@ -27,6 +27,14 @@ F = 6
 def params_and_rows(n_experts=8, b=32, seed=0):
     params = init_moe(jax.random.PRNGKey(seed), N_ZONES,
                       n_experts=n_experts, hidden=32)
+    # init zero-inits the output projection and wide skip (training
+    # stability); these tests need NONZERO outputs so routed-vs-dropped
+    # rows are distinguishable — give both random weights
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed + 7))
+    params["w1"] = jax.random.normal(k1, params["w1"].shape,
+                                     jnp.float32) * 0.3
+    params["w_skip"] = jax.random.normal(k2, params["w_skip"].shape,
+                                         jnp.float32) * 0.2
     feats = jax.random.uniform(jax.random.PRNGKey(seed + 1), (b, F),
                                jnp.float32, 0.0, 2.0)
     return params, feats

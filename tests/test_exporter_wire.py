@@ -253,3 +253,94 @@ class TestConcurrentScrapes:
         stop.set()
         rt.join(timeout=10)
         assert not errors, errors[:3]
+
+
+class TestFastExpositionParity:
+    """The direct snapshot→text fast path must be BYTE-identical to
+    prometheus_client's stock renderer — fresh, cached, and after label
+    churn (the cross-scrape label-block cache must invalidate)."""
+
+    def make_registry(self, mon):
+        from prometheus_client import CollectorRegistry
+
+        from kepler_tpu.exporter.prometheus.collector import PowerCollector
+
+        col = PowerCollector(mon, node_name="n1")
+        registry = CollectorRegistry()
+        registry.register(col)
+        return col, registry
+
+    @staticmethod
+    def advance(procs, zones, clock, dcpu=1.5):
+        for p in procs:
+            p.cpu += dcpu
+        for z in zones:
+            z.increment = 40_000_000
+        clock.step(5.0)
+
+    def test_byte_parity_fresh_cached_and_churned(self):
+        from prometheus_client.exposition import generate_latest
+
+        procs = [
+            MockProc(1, cpu=2.0),
+            MockProc(7, cpu=1.0, comm='we"ird\\name\n'),
+            MockProc(9, cpu=3.0, cgroups=[
+                f"/kubepods.slice/cri-containerd-{CID}.scope"]),
+        ]
+        mon, _, zones, clock = make_monitor(procs)
+        mon.refresh()
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        col, registry = self.make_registry(mon)
+        assert col.render_text() == generate_latest(registry)  # fresh
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        assert col.render_text() == generate_latest(registry)  # cached
+        procs[0]._comm = "execd-new-name"  # exec: labels must re-render
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        assert col.render_text() == generate_latest(registry)
+
+    def test_parity_with_terminated_rows(self):
+        from prometheus_client.exposition import generate_latest
+
+        procs = [MockProc(1, cpu=2.0), MockProc(2, cpu=100000.0)]
+        mon, reader, zones, clock = make_monitor(
+            procs, min_terminated_energy_uj=0.0)
+        mon.refresh()
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        reader.procs = [procs[0]]  # pid 2 exits with earned energy
+        self.advance([procs[0]], zones, clock)
+        mon.refresh()
+        col, registry = self.make_registry(mon)
+        text = col.render_text()
+        assert text == generate_latest(registry)
+        assert b'state="terminated"' in text
+
+    def test_fast_generate_latest_parity(self):
+        from prometheus_client.exposition import generate_latest
+
+        from kepler_tpu.exporter.prometheus.fastexpo import (
+            fast_generate_latest,
+        )
+
+        procs = [MockProc(1, cpu=2.0)]
+        mon, _, zones, clock = make_monitor(procs)
+        mon.refresh()
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        _, registry = self.make_registry(mon)
+        assert fast_generate_latest(registry) == generate_latest(registry)
+
+    def test_fmt_float_parity(self):
+        from prometheus_client.utils import floatToGoString
+
+        from kepler_tpu.exporter.prometheus.fastexpo import fmt_float
+
+        cases = [0.0, -0.0, 1.0, 0.5, 123.456, 999999.9375, 1000000.5,
+                 12345678.25, 1e-05, 5e-324, 1.7e308, float("inf"),
+                 float("-inf"), float("nan"), -1.25, -1e7, 3.0000000000004,
+                 0.1 + 0.2, 2**53 + 0.0, 1e21]
+        for v in cases:
+            assert fmt_float(v) == floatToGoString(v), v
