@@ -42,6 +42,14 @@ dryrun: ## compile-check driver entry points on a virtual 8-device mesh
 native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)
 	$(PYTHON) -c "from kepler_tpu.native import ensure_built; print(ensure_built(force=True))"
 
+.PHONY: native-tsan
+native-tsan: ## ThreadSanitizer pass over the native scanner (the -race analog)
+	g++ -O1 -g -fsanitize=thread -std=c++17 -Wall -Wextra \
+		kepler_tpu/native/src/scan.cpp \
+		kepler_tpu/native/src/scan_tsan_test.cpp \
+		-o /tmp/kepler_scan_tsan
+	/tmp/kepler_scan_tsan
+
 # -- lint ---------------------------------------------------------------------
 .PHONY: lint
 lint:

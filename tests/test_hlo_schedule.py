@@ -1,0 +1,158 @@
+"""Compiled-HLO collective-schedule assertions.
+
+Multi-chip performance can't be measured on the CPU mesh, but the
+SCHEDULE can be pinned: these tests compile the sharded programs on the
+8-virtual-device mesh and assert exactly which collectives GSPMD emitted.
+A regression that silently inserts an all-gather (resharding drift, a
+spec typo breaking the ring) changes the compiled text long before any
+benchmark could catch it on real hardware.
+
+Pinned schedules:
+  * ring attention — N-1 collective-permute steps (the KV ring), ZERO
+    all-gathers (the whole point of ring attention is never materializing
+    the full sequence);
+  * node-sharded fleet attribution — ZERO collectives of any kind (node
+    rows are independent; anything else means GSPMD stopped trusting the
+    shardings);
+  * DP×TP train step — all-reduces for the TP activation psum + DP
+    gradient sync, and no all-gathers of the hidden-sharded weights.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kepler_tpu.parallel import make_mesh
+from kepler_tpu.parallel.mesh import MODEL_AXIS, NODE_AXIS
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-virtual-device mesh")
+
+
+def collective_counts(compiled_text: str) -> dict[str, int]:
+    """Count collective ops in compiled (post-GSPMD) HLO text."""
+    counts = {"all-gather": 0, "collective-permute": 0, "all-reduce": 0,
+              "all-to-all": 0, "reduce-scatter": 0}
+    # op instances appear as `<op>[-start]*(` — count starts only so a
+    # paired start/done lowering isn't double-counted
+    for op in counts:
+        counts[op] = len(re.findall(rf"\b{op}(?:-start)?\(", compiled_text))
+    return counts
+
+
+class TestRingAttentionSchedule:
+    def test_exactly_n_ppermutes_zero_allgathers(self):
+        from kepler_tpu.parallel.ring import make_ring_attention
+
+        n = 8
+        mesh = make_mesh([n], ["seq"])
+        ring = make_ring_attention(mesh, axis_name="seq")
+        b, t, h, d = 2, 64, 4, 32
+        args = (jnp.zeros((b, t, h, d)), jnp.zeros((b, t, h, d)),
+                jnp.zeros((b, t, h, d)), jnp.ones((b, t), bool))
+        text = jax.jit(ring).lower(*args).compile().as_text()
+        c = collective_counts(text)
+        assert c["all-gather"] == 0, c
+        assert c["all-to-all"] == 0, c
+        # the KV block travels the ring once: N-1 hops (the final hop back
+        # is never needed), possibly emitted as one permute inside a loop
+        # body plus unrolled steps — what's pinned is: at least one, and
+        # no more than N
+        assert 1 <= c["collective-permute"] <= n, c
+
+    def test_ring_matches_dense_on_mesh(self):
+        """Schedule assertions alone can lie; pin numerics alongside."""
+        from kepler_tpu.ops.attention import full_attention
+        from kepler_tpu.parallel.ring import make_ring_attention
+
+        mesh = make_mesh([8], ["seq"])
+        ring = make_ring_attention(mesh, axis_name="seq",
+                                   compute_dtype=jnp.float32)
+        rng = np.random.default_rng(0)
+        b, t, h, d = 2, 64, 4, 16
+        q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+                   for _ in range(3))
+        tv = jnp.asarray(rng.random((b, t)) > 0.2)
+        got = np.asarray(ring(q, k, v, tv))
+        want = np.asarray(full_attention(q, k, v, causal=True, t_valid=tv,
+                                         compute_dtype=jnp.float32))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+class TestFleetSchedule:
+    def test_node_sharded_forward_has_zero_collectives(self):
+        from kepler_tpu.models import init_mlp
+        from kepler_tpu.parallel.packed import (make_packed_fleet_program,
+                                                pack_fleet_inputs)
+
+        from benchmarks.scenarios import make_batch
+
+        mesh = make_mesh([8], [NODE_AXIS])
+        w, z = 16, 4
+        program = make_packed_fleet_program(mesh, n_workloads=w, n_zones=z,
+                                            model_mode="mlp")
+        params = init_mlp(jax.random.PRNGKey(0), n_zones=z)
+        batch = make_batch(64, w, z, -1)
+        packed = jnp.asarray(pack_fleet_inputs(batch))
+        text = program.lower(params, packed).compile().as_text()
+        c = collective_counts(text)
+        assert all(v == 0 for v in c.values()), (
+            f"fleet forward must be collective-free (node rows are "
+            f"independent): {c}")
+
+
+class TestTrainStepSchedule:
+    def test_dp_tp_step_allreduces_but_never_gathers_weights(self):
+        from kepler_tpu.models import init_mlp
+        from kepler_tpu.models.train import create_train_state
+        from kepler_tpu.parallel.trainer import (
+            make_distributed_train_step,
+            shard_train_state,
+        )
+
+        mesh = make_mesh([2, 4], [NODE_AXIS, MODEL_AXIS])
+        z = 4
+        optimizer = optax.adamw(1e-3)
+        params = init_mlp(jax.random.PRNGKey(0), n_zones=z)
+        state = shard_train_state(create_train_state(params, optimizer),
+                                  mesh)
+        step = make_distributed_train_step(mesh, optimizer)
+        b, w = 16, 8
+        feats = jnp.zeros((b, w, 6))
+        valid = jnp.ones((b, w), bool)
+        targets = jnp.zeros((b, w, z))
+        text = step.lower(state, feats, valid, targets).compile().as_text()
+        c = collective_counts(text)
+        # TP activation psum (forward), its transpose (backward), and the
+        # DP gradient sync all lower to all-reduces; XLA may fuse them
+        assert c["all-reduce"] >= 2, c
+        # the hidden-sharded weights must never be gathered whole
+        assert c["all-gather"] == 0, c
+        assert c["all-to-all"] == 0, c
+
+
+class TestExpertSchedule:
+    def test_moe_dispatch_is_the_all_to_all_pair(self):
+        from kepler_tpu.models.moe import init_moe
+        from kepler_tpu.parallel.expert import make_expert_parallel_moe
+
+        mesh = make_mesh([8], ["expert"])
+        params = init_moe(jax.random.PRNGKey(0), n_zones=2, n_experts=8,
+                          hidden=32)
+        ep = make_expert_parallel_moe(mesh)
+        b, f = 64, 6
+        feats = jnp.zeros((b, f))
+        eid = jnp.zeros((b,), jnp.int32)
+        gate = jnp.ones((b,), jnp.float32)
+        text = jax.jit(ep).lower(params, feats, eid,
+                                 gate).compile().as_text()
+        c = collective_counts(text)
+        # dispatch + combine: the classic pair, and nothing else
+        assert c["all-to-all"] == 2, c
+        assert c["all-gather"] == 0, c

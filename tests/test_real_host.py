@@ -1,0 +1,53 @@
+"""Real-host closed-loop validation harness (benchmarks/real_host.py).
+
+The replay test runs the checked-in capture (deterministic, no host
+deps); the proc test runs against the live /proc of whatever machine the
+suite is on (real process churn); the live-RAPL test auto-skips off
+bare-metal — on hardware CI it closes the loop against real counters.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from benchmarks.real_host import (
+    DEFAULT_CAPTURE,
+    RAPL_SYSFS,
+    TOL,
+    run_live,
+    run_proc_live,
+    run_replay,
+)
+
+
+class TestClosedLoop:
+    def test_replay_checked_in_capture(self):
+        out = run_replay(DEFAULT_CAPTURE)
+        assert out["ok"], out
+        assert out["max_rel_err"] <= TOL
+        assert out["windows"] >= 3
+        assert out["procs_last_window"] > 10  # a real host's process count
+
+    def test_live_proc_dynamics(self):
+        """Real /proc (whatever is running now) through the full loop."""
+        out = run_proc_live(windows=2, interval=0.2)
+        assert out["ok"], out
+        assert out["max_rel_err"] <= TOL
+        assert out["procs_last_window"] > 1
+
+    @pytest.mark.skipif(not os.path.isdir(RAPL_SYSFS),
+                        reason="no RAPL sysfs (not bare-metal)")
+    def test_live_rapl(self):
+        out = run_live(windows=2, interval=0.5)
+        assert out["ok"], out
+
+    def test_capture_roundtrip(self, tmp_path):
+        from benchmarks.real_host import capture
+
+        path = str(tmp_path / "cap.json")
+        meta = capture(path, windows=2, interval=0.05)
+        assert meta["procs"] > 1
+        out = run_replay(path)
+        assert out["ok"], out
