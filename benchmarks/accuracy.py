@@ -473,6 +473,78 @@ def _measure_estimator_accuracy(n_nodes: int = 64, n_workloads: int = 32,
     return out
 
 
+def measure_nonlinear_accuracy(n_nodes: int = 64, n_workloads: int = 32,
+                               n_zones: int = 2, steps: int = 8000,
+                               seed: int = 9) -> dict:
+    """NONLINEAR ground truth: the wide path alone cannot fit this — the
+    trunk has to learn it, so this row guards against the linear fleet
+    benchmark overstating what the estimators can do.
+
+    Construction: active_power[n,z] = k_z · node_cpu · mod(node_cpu) with
+    mod = 1 + 0.3·tanh((node_cpu − 80)/40) — a smooth load-dependent
+    efficiency curve (light nodes run 30% cheaper per cpu-second than
+    saturated ones, the shape real power curves have). Workload watts
+    k_z · cpu · mod(node_cpu) are NOT linear in the features; the wide
+    path alone leaves ~15% error (reported as *_linear_only_*), the trunk
+    must close the rest. Gated at a looser 2% p99 (the nonlinear-
+    regression bar; the 0.5% north star applies to the ratio/linear
+    serving paths measured above).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from kepler_tpu.models import build_features, init_mlp
+    from kepler_tpu.models.mlp import predict_mlp
+    from kepler_tpu.models.train import warm_start_wide
+
+    with jax.default_matmul_precision("highest"):
+        k_z = np.linspace(2e6, 6e6, n_zones)
+        # same RNG stream as _learnable_fleet(seed): probing node_cpu first
+        # then rebuilding with the per-node modulated k yields one fleet
+        probe = synthetic_fleet(n_nodes, n_workloads, n_zones, seed)
+        mod = 1.0 + 0.3 * np.tanh(
+            (probe["node_cpu_delta"].astype(np.float64) - 80.0) / 40.0)
+        fleet = _learnable_fleet(n_nodes, n_workloads, n_zones, seed,
+                                 k_z[None, :] * mod[:, None])
+        ref = reference_attribution_f64(**fleet)
+        refw = ref.workload_power_uw * 1e-6
+        target = jnp.asarray(refw, jnp.float32)
+        feats = build_features(
+            jnp.asarray(fleet["cpu_deltas"]),
+            jnp.asarray(fleet["workload_valid"]),
+            jnp.asarray(fleet["node_cpu_delta"]),
+            jnp.asarray(fleet["usage_ratio"]),
+            jnp.asarray(fleet["dt_s"]),
+        )
+        valid = jnp.asarray(fleet["workload_valid"])
+        params = warm_start_wide(
+            init_mlp(jax.random.PRNGKey(0), n_zones=n_zones),
+            feats, valid, target)
+        pfn = functools.partial(predict_mlp, features=feats,
+                                workload_valid=valid, clamp=False,
+                                compute_dtype=jnp.float32)
+        fitted, loss = fit_scan(pfn, params, valid, target, steps=steps,
+                                learning_rate=3e-3)
+        med, p99 = _err_stats(
+            predict_mlp(fitted, feats, valid, compute_dtype=jnp.float32),
+            refw, fleet["workload_valid"])
+        # the wide warm start ALONE (trunk untouched): how much the trunk
+        # actually contributed
+        med0, p99_0 = _err_stats(
+            predict_mlp(params, feats, valid, compute_dtype=jnp.float32),
+            refw, fleet["workload_valid"])
+    return {
+        "mlp_nonlinear_fit_median_rel_err": med,
+        "mlp_nonlinear_fit_p99_rel_err": p99,
+        "mlp_nonlinear_fit_loss": float(loss),
+        "mlp_nonlinear_linear_only_p99_rel_err": p99_0,
+        "mlp_nonlinear_linear_only_median_rel_err": med0,
+        "nonlinear_accuracy_ok": bool(p99 <= 0.02),
+    }
+
+
 def run_all(packed_program=None, packed_batch=None, packed_params=None,
             estimator_steps: int = 1500) -> dict:
     """Everything the bench JSON line needs. Caller may pass an
@@ -483,7 +555,9 @@ def run_all(packed_program=None, packed_batch=None, packed_params=None,
         out.update(measure_packed_accuracy(packed_program, packed_batch,
                                            packed_params))
     out.update(measure_estimator_accuracy(steps=estimator_steps))
+    out.update(measure_nonlinear_accuracy())
     out["accuracy_ok"] = bool(out["ratio_f32_ok"]
                               and out.get("packed_f16_ok", True)
-                              and out["estimator_accuracy_ok"])
+                              and out["estimator_accuracy_ok"]
+                              and out["nonlinear_accuracy_ok"])
     return out

@@ -23,7 +23,8 @@ active/idle split and per-workload shares from each window's raw inputs
 re-derivation as ``benchmarks.accuracy.reference_attribution_f64``.
 
 CLI: ``python -m benchmarks.real_host [--windows N] [--interval S]
-[--capture PATH] [--replay PATH] [--json]``.
+[--capture PATH] [--replay [PATH]]`` — prints one JSON line, exits
+nonzero when validation ran and missed the budget.
 """
 
 from __future__ import annotations
@@ -176,6 +177,10 @@ def validate(meter, reader, windows: int, interval: float,
     from kepler_tpu.monitor.monitor import PowerMonitor
     from kepler_tpu.resource.informer import ResourceInformer
 
+    if windows < 1:
+        return {"mode": mode, "skipped": True, "ok": False,
+                "reason": f"need >= 1 window, got {windows} (a capture "
+                          "holds windows+1 samples)"}
     informer = ResourceInformer(reader=reader)
     monitor = PowerMonitor(meter, informer, interval=0, staleness=1e9)
     monitor.init()

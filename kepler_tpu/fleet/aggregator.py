@@ -486,6 +486,22 @@ class Aggregator:
                 f"params are missing {missing} for model "
                 f"{self._model_mode!r} — were they saved from a different "
                 "model kind?")
+        # the input projection's feature axis must match THIS build's
+        # feature vector — a checkpoint trained before a feature-set change
+        # (e.g. F 6→7, node_cpu_log) must fail HERE, not as an XLA shape
+        # error inside the first window's jit
+        from kepler_tpu.models.features import NUM_FEATURES
+
+        in_key, f_axis = {"mlp": ("w0", 0), "linear": ("weight", 0),
+                          "moe": ("w0", 1), "deep": ("in_proj", 0),
+                          "temporal": ("in_proj", 0)}[self._model_mode]
+        got_f = int(np.asarray(self._params[in_key]).shape[f_axis])
+        if got_f != NUM_FEATURES:
+            raise ValueError(
+                f"params' {in_key} has feature dim {got_f} but this build's "
+                f"feature vector is F={NUM_FEATURES} — the checkpoint "
+                "predates a feature-set change; retrain it "
+                "(models.features.build_features documents the vector)")
         if self._model_mode == "temporal":
             t_max = int(np.asarray(self._params["pos_emb"]).shape[0])
             if t_max < self._history_window:

@@ -7,13 +7,20 @@ Here the feature pipeline is a pure function from the informer's
 ``FeatureBatch`` (+ node context) to a dense ``[W, F]`` matrix, so the model
 evaluation fuses with ratio attribution in one device program.
 
-Feature vector (F = 6):
+Feature vector (F = 7):
     0: cpu_time_delta       seconds of CPU in the window
     1: cpu_share            workload delta / node delta (the ratio feature)
     2: node_usage_ratio     broadcast node active/total ratio
     3: dt                   window length (s)
     4: cpu_rate             cpu_time_delta / dt (cores actively used)
     5: bias                 constant 1.0
+    6: node_cpu_log         broadcast log1p(node cpu delta) — node-level
+                            load, the input nonlinear power curves (load-
+                            dependent efficiency) are functions of; without
+                            it a trunk would have to reconstruct node load
+                            as cpu/share, a division GELU stacks learn
+                            poorly (kepler-model-server's feature sets
+                            likewise carry node-scope counters)
 """
 
 from __future__ import annotations
@@ -21,7 +28,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-NUM_FEATURES = 6
+NUM_FEATURES = 7
 
 
 def build_features(
@@ -42,6 +49,7 @@ def build_features(
     rate = jnp.where(dt_s[..., None] > 0, deltas / dt, 0.0)
     broadcast = jnp.broadcast_to
     w_shape = deltas.shape
+    node_log = jnp.log1p(jnp.maximum(node_cpu_delta, 0.0))
     feats = jnp.stack(
         [
             deltas,
@@ -50,6 +58,7 @@ def build_features(
             broadcast(dt_s[..., None], w_shape),
             rate,
             jnp.ones_like(deltas),
+            broadcast(node_log[..., None], w_shape),
         ],
         axis=-1,
     )

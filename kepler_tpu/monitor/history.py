@@ -7,7 +7,7 @@ last T ticks of the feature vector per workload, so this buffer accretes
 one row per workload per `push()` and materialises right-padded
 ``[W, T, F]`` windows on demand.
 
-Host-side numpy only: rows are tiny (F=6 f32), the buffer is O(W×T)
+Host-side numpy only: rows are tiny (F=7 f32), the buffer is O(W×T)
 bytes, and it lives beside the informer on the node agent — the device
 only ever sees the dense padded window. Feature rows are computed with the
 same formulas as `models.features.build_features` so a window's last
@@ -39,6 +39,7 @@ def feature_rows(batch: FeatureBatch, dt_s: float) -> np.ndarray:
     rows[:, 3] = dt_s
     rows[:, 4] = rate
     rows[:, 5] = 1.0
+    rows[:, 6] = np.log1p(max(denom, 0.0))
     return rows
 
 

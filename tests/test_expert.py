@@ -21,7 +21,7 @@ from kepler_tpu.parallel import (
 )
 
 N_ZONES = 2
-F = 6
+F = 7
 
 
 def params_and_rows(n_experts=8, b=32, seed=0):

@@ -658,3 +658,30 @@ class TestWireFuzz:
         for n in range(len(blob)):
             with pytest.raises((WireError, ValueError)):
                 decode_report(blob[:n])
+
+
+class TestParamsFeatureDimCheck:
+    def test_stale_feature_dim_fails_at_startup(self):
+        """A checkpoint trained before a feature-set change (F mismatch on
+        the input projection) must fail at _check_params_shape, not as an
+        XLA shape error inside the first window."""
+        import jax
+
+        from kepler_tpu.models import init_mlp
+
+        params = {k: np.asarray(v) for k, v in
+                  init_mlp(jax.random.PRNGKey(0), 2,
+                           n_features=6).items()}  # pre-F=7 checkpoint
+        agg = Aggregator(APIServer(), model_mode="mlp", model_params=params)
+        with pytest.raises(ValueError, match="feature dim"):
+            agg._check_params_shape()
+
+    def test_current_feature_dim_passes(self):
+        import jax
+
+        from kepler_tpu.models import init_mlp
+
+        params = {k: np.asarray(v) for k, v in
+                  init_mlp(jax.random.PRNGKey(0), 2).items()}
+        Aggregator(APIServer(), model_mode="mlp",
+                   model_params=params)._check_params_shape()

@@ -124,7 +124,7 @@ class TestTrainStepSchedule:
                                   mesh)
         step = make_distributed_train_step(mesh, optimizer)
         b, w = 16, 8
-        feats = jnp.zeros((b, w, 6))
+        feats = jnp.zeros((b, w, 6 + 1))
         valid = jnp.ones((b, w), bool)
         targets = jnp.zeros((b, w, z))
         text = step.lower(state, feats, valid, targets).compile().as_text()
@@ -146,7 +146,7 @@ class TestExpertSchedule:
         params = init_moe(jax.random.PRNGKey(0), n_zones=2, n_experts=8,
                           hidden=32)
         ep = make_expert_parallel_moe(mesh)
-        b, f = 64, 6
+        b, f = 64, 7
         feats = jnp.zeros((b, f))
         eid = jnp.zeros((b,), jnp.int32)
         gate = jnp.ones((b,), jnp.float32)

@@ -130,7 +130,7 @@ class TestTemporalFastPath:
         t = 12
         params = init_temporal(jax.random.PRNGKey(0), n_zones=3,
                                d_model=64, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (5, 7, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (5, 7, t, 7))
         wv = jnp.array([True, True, False, True, True, True, True])[None, :]
         wv = jnp.broadcast_to(wv, (5, 7))
         lengths = jnp.arange(5 * 7).reshape(5, 7) % t + 1
@@ -162,7 +162,7 @@ class TestTemporalFastPath:
         t = 10
         params = init_temporal(jax.random.PRNGKey(3), n_zones=2,
                                d_model=64, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(4), (2, 4, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(4), (2, 4, t, 7))
         wv = jnp.ones((2, 4), bool)
         # gapped masks: holes in the middle, valid past the holes
         tv = np.zeros((2, 4, t), bool)
@@ -197,7 +197,7 @@ class TestTemporalFastPath:
         t = 8
         params = init_temporal(jax.random.PRNGKey(0), n_zones=2,
                                d_model=64, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (1, 3, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (1, 3, t, 7))
         wv = jnp.ones((1, 3), bool)
         tv = jnp.zeros((1, 3, t), bool).at[0, 0].set(True)  # 1 full, 2 empty
 

@@ -64,7 +64,7 @@ class TestFullAttentionPallas:
     def test_temporal_trunk_seam(self):
         """predict_temporal(attention_fn=pallas) == default dense path."""
         params = init_temporal(jax.random.PRNGKey(0), 2, d_model=32, t_max=8)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (5, 8, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (5, 8, 7))
         wv = jnp.ones(5, bool)
         tv = jnp.arange(8)[None, :] < jnp.array([8, 3, 8, 1, 6])[:, None]
         base = predict_temporal(params, hist, wv, tv,

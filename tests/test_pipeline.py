@@ -22,7 +22,7 @@ from kepler_tpu.parallel import (
 )
 
 N_ZONES = 2
-F = 6
+F = 7
 D = 32
 
 

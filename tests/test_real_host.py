@@ -41,6 +41,10 @@ class TestClosedLoop:
                         reason="no RAPL sysfs (not bare-metal)")
     def test_live_rapl(self):
         out = run_live(windows=2, interval=0.5)
+        # powercap present but unusable (no intel-rapl zones / root-only
+        # energy_uj) degrades to a documented skip, not a failure
+        if out.get("skipped"):
+            pytest.skip(out.get("reason", "RAPL unusable"))
         assert out["ok"], out
 
     def test_capture_roundtrip(self, tmp_path):

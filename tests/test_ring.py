@@ -74,7 +74,7 @@ class TestRingAttention:
 class TestTemporalModel:
     def test_predicts_shape_and_masking(self):
         params = init_temporal(jax.random.PRNGKey(0), n_zones=3, t_max=16)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (4, 7, 16, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (4, 7, 16, 7))
         valid = jnp.tile(
             jnp.array([True, True, False, True, True, False, True]), (4, 1))
         watts = predict_temporal(params, hist, valid)
@@ -85,8 +85,8 @@ class TestTemporalModel:
     def test_last_valid_timestep_pools(self):
         """Right-padded histories: padding rows must not change the output."""
         params = init_temporal(jax.random.PRNGKey(0), n_zones=2, t_max=8)
-        hist = np.zeros((1, 8, 6), np.float32)
-        hist[0, :3] = np.random.default_rng(0).uniform(0, 1, (3, 6))
+        hist = np.zeros((1, 8, 7), np.float32)
+        hist[0, :3] = np.random.default_rng(0).uniform(0, 1, (3, 7))
         tv = np.zeros((1, 8), bool)
         tv[0, :3] = True
         full = predict_temporal(params, jnp.asarray(hist)[None],
@@ -105,7 +105,7 @@ class TestTemporalModel:
         """Changing the future must not change earlier hidden states."""
         params = init_temporal(jax.random.PRNGKey(0), n_zones=2, t_max=8)
         rng = np.random.default_rng(1)
-        a = rng.uniform(0, 1, (2, 8, 6)).astype(np.float32)
+        a = rng.uniform(0, 1, (2, 8, 7)).astype(np.float32)
         b = a.copy()
         b[:, 5:] += 1.0
         tv = jnp.ones((2, 8), bool)
@@ -121,7 +121,7 @@ class TestTemporalModel:
     def test_sequence_parallel_program_matches_dense(self):
         mesh = make_mesh([8], ["seq"])
         params = init_temporal(jax.random.PRNGKey(0), n_zones=2, t_max=32)
-        hist = jax.random.uniform(jax.random.PRNGKey(2), (6, 32, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(2), (6, 32, 7))
         wv = jnp.array([True, True, False, True, True, True])
         tv = jnp.arange(32)[None, :] < jnp.array([32, 8, 32, 1, 17, 32])[:, None]
         prog = make_temporal_program(mesh, compute_dtype=jnp.float32)
@@ -159,7 +159,7 @@ class TestHistoryBuffer:
         for tick in range(3):
             buf.push(self.batch(["a"], [float(tick + 1)]), dt_s=5.0)
         feats, tv = buf.window_arrays(["a", "ghost"])
-        assert feats.shape == (2, 4, 6)
+        assert feats.shape == (2, 4, 7)
         np.testing.assert_array_equal(tv[0], [True, True, True, False])
         np.testing.assert_allclose(feats[0, :3, 0], [1.0, 2.0, 3.0])
         assert not tv[1].any()
@@ -208,7 +208,7 @@ class TestSequenceParallelTraining:
         mesh = make_mesh([8], ["seq"])
         t = 16
         params = init_temporal(jax.random.PRNGKey(0), 2, d_model=32, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (12, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (12, t, 7))
         wv = jnp.ones(12, bool)
         tv = jnp.arange(t)[None, :] < jnp.array([t] * 6 + [5] * 6)[:, None]
         targets = jax.random.uniform(jax.random.PRNGKey(2), (12, 2), (
@@ -239,7 +239,7 @@ class TestSequenceParallelTraining:
         mesh = make_mesh([8], ["seq"])
         t = 8
         params = init_temporal(jax.random.PRNGKey(0), 2, d_model=32, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (4, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (4, t, 7))
         wv = jnp.ones(4, bool)
         tv = jnp.ones((4, t), bool)
         targets = jnp.ones((4, 2)) * 10.0
@@ -259,7 +259,7 @@ class TestSequenceParallelTraining:
         mesh = make_mesh([8], ["seq"])
         t = 8
         params = init_temporal(jax.random.PRNGKey(0), 2, d_model=32, t_max=t)
-        hist = jax.random.uniform(jax.random.PRNGKey(1), (8, t, 6))
+        hist = jax.random.uniform(jax.random.PRNGKey(1), (8, t, 7))
         wv = jnp.ones(8, bool)
         tv = jnp.ones((8, t), bool)
         targets = hist[:, -1, :1] * jnp.asarray([[10.0, 20.0]])
