@@ -44,7 +44,7 @@ native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)
 
 .PHONY: native-tsan
 native-tsan: ## ThreadSanitizer pass over the native scanner (the -race analog)
-	g++ -O1 -g -fsanitize=thread -std=c++17 -Wall -Wextra \
+	g++ -O1 -g -fsanitize=thread -std=c++17 -pthread -Wall -Wextra \
 		kepler_tpu/native/src/scan.cpp \
 		kepler_tpu/native/src/scan_tsan_test.cpp \
 		-o /tmp/kepler_scan_tsan

@@ -194,7 +194,9 @@ class PowerCollector:
         if not self._is_ready():
             return b""
         try:
-            snap = self._monitor.snapshot()
+            # no deep clone: the render only reads, and published
+            # snapshots are immutable (see PowerMonitor.snapshot)
+            snap = self._monitor.snapshot(clone=False)
         except SnapshotUnavailableError as err:
             log.warning("scrape skipped: %s", err)
             return b""

@@ -188,8 +188,14 @@ class PowerMonitor:
         (the agent just enqueues)."""
         self._window_listeners.append(listener)
 
-    def snapshot(self) -> Snapshot:
+    def snapshot(self, clone: bool = True) -> Snapshot:
         """Return a deep-cloned, fresh snapshot.
+
+        ``clone=False`` returns the published object itself — safe for
+        read-only consumers because a published snapshot is never mutated
+        (every refresh builds new arrays/dicts and swaps the reference);
+        the exporter's direct text render uses it to skip a 10k-row deep
+        copy per scrape. External callers should keep the default.
 
         Freshness contract (reference :185-200, :254-302): if the current
         snapshot is older than ``staleness``, refresh first; concurrent
@@ -216,7 +222,7 @@ class PowerMonitor:
             snap = self._snapshot
         assert snap is not None
         self._exported = True  # terminated data now consumable→clearable
-        return snap.clone()
+        return snap.clone() if clone else snap
 
     def _is_fresh(self) -> bool:
         snap = self._snapshot

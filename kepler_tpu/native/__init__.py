@@ -56,7 +56,7 @@ def ensure_built(force: bool = False) -> str | None:
         # atomic rename means readers never dlopen a half-written .so
         tmp = f"{_LIB}.{os.getpid()}.tmp"
         cmd = [
-            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
             "-Wall", "-Wextra", _SRC, "-o", tmp,
         ]
         try:

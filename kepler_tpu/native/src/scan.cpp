@@ -8,9 +8,12 @@
 // round-trips. SURVEY §7 hard part (d): the procfs scan, not the TPU math,
 // is the per-node bottleneck; this is its fast path.
 //
-// Pure C ABI (called via ctypes — no pybind11 in this toolchain). No
-// allocation is done here: callers own every buffer, so the library is
-// trivially thread-safe per call and leak-free.
+// Pure C ABI (called via ctypes — no pybind11 in this toolchain). Callers
+// own every OUTPUT buffer; the scan allocates transient working vectors
+// (dirent names + per-entry results) and, for large trees, a few
+// short-lived threads. All C++ exceptions are caught at the ABI boundary
+// and surfaced as -1 (callers fall back to the pure-Python reader) — no
+// exception may unwind into ctypes frames.
 
 #include <cstdint>
 #include <cstdio>
@@ -20,6 +23,11 @@
 #include <dirent.h>
 #include <fcntl.h>
 #include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -63,58 +71,111 @@ extern "C" {
 // ABI version for the ctypes loader to sanity-check.
 int kepler_native_abi_version() { return 1; }
 
+// Parse one <pid>/stat file; true on success. Thread-safe: all state is
+// caller-provided.
+static bool ParseProcStat(const char* procfs, const char* name,
+                          int32_t* pid, double* cpu_seconds) {
+  char path[512];
+  char buf[4096];
+  snprintf(path, sizeof(path), "%s/%s/stat", procfs, name);
+  if (ReadSmallFile(path, buf, sizeof(buf)) <= 0) return false;
+  // comm may contain spaces/parens; fields resume after the LAST ')'
+  // (same parse as the Python reader and the reference's procfs lib).
+  char* rparen = strrchr(buf, ')');
+  if (rparen == nullptr || rparen[1] == '\0') return false;
+  char* rest = rparen + 2;
+  // After the ')' the next fields are state(0) ... utime(11) stime(12),
+  // 0-indexed — i.e. stat fields 14 and 15 in proc(5) numbering.
+  unsigned long long utime = 0, stime = 0;
+  int tok = 0;
+  bool ok = false;
+  char* save = nullptr;
+  for (char* t = strtok_r(rest, " ", &save); t != nullptr;
+       t = strtok_r(nullptr, " ", &save), ++tok) {
+    if (tok == 11) {
+      utime = strtoull(t, nullptr, 10);
+    } else if (tok == 12) {
+      stime = strtoull(t, nullptr, 10);
+      ok = true;
+      break;
+    }
+  }
+  if (!ok) return false;
+  *pid = static_cast<int32_t>(strtol(name, nullptr, 10));
+  *cpu_seconds = static_cast<double>(utime + stime) / kUserHz;
+  return true;
+}
+
 // Scan every numeric entry of `procfs`, parse <pid>/stat, and fill
 // pids[i] / cpu_seconds[i] with the PID and (utime+stime)/USER_HZ.
 // Returns the number of entries filled, -1 if procfs can't be opened, or
 // -2 if more than `cap` processes exist (caller retries with a bigger
 // buffer). PIDs that vanish mid-scan are skipped, matching the reference's
 // skip-on-ESRCH behavior (informer.go:186-190).
+//
+// Large trees fan the per-PID open/read/parse out to a few threads — the
+// scan is syscall-latency bound (one open+read+close per PID), and the
+// kernel serves independent /proc files concurrently. Output order stays
+// the directory order regardless of thread count.
 int kepler_scan_procs(const char* procfs, int32_t* pids, double* cpu_seconds,
-                      int32_t cap) {
+                      int32_t cap) try {
   DIR* dir = opendir(procfs);
   if (dir == nullptr) return -1;
-  int count = 0;
-  char path[512];
-  char buf[4096];
+  std::vector<std::string> names;
   struct dirent* entry;
-  int rc = 0;
   while ((entry = readdir(dir)) != nullptr) {
-    const char* name = entry->d_name;
-    if (!AllDigits(name)) continue;
-    if (count >= cap) {
-      rc = -2;
-      break;
-    }
-    snprintf(path, sizeof(path), "%s/%s/stat", procfs, name);
-    if (ReadSmallFile(path, buf, sizeof(buf)) <= 0) continue;
-    // comm may contain spaces/parens; fields resume after the LAST ')'
-    // (same parse as the Python reader and the reference's procfs lib).
-    char* rparen = strrchr(buf, ')');
-    if (rparen == nullptr || rparen[1] == '\0') continue;
-    char* rest = rparen + 2;
-    // After the ')' the next fields are state(0) ... utime(11) stime(12),
-    // 0-indexed — i.e. stat fields 14 and 15 in proc(5) numbering.
-    unsigned long long utime = 0, stime = 0;
-    int tok = 0;
-    bool ok = false;
-    char* save = nullptr;
-    for (char* t = strtok_r(rest, " ", &save); t != nullptr;
-         t = strtok_r(nullptr, " ", &save), ++tok) {
-      if (tok == 11) {
-        utime = strtoull(t, nullptr, 10);
-      } else if (tok == 12) {
-        stime = strtoull(t, nullptr, 10);
-        ok = true;
-        break;
-      }
-    }
-    if (!ok) continue;
-    pids[count] = static_cast<int32_t>(strtol(name, nullptr, 10));
-    cpu_seconds[count] = static_cast<double>(utime + stime) / kUserHz;
-    ++count;
+    if (AllDigits(entry->d_name)) names.emplace_back(entry->d_name);
   }
   closedir(dir);
-  return rc == -2 ? -2 : count;
+  const size_t n = names.size();
+  if (cap < 0) return -1;  // -2 would make callers grow-and-retry forever
+  if (n > static_cast<size_t>(cap)) return -2;
+
+  std::vector<int32_t> got_pid(n);
+  std::vector<double> got_cpu(n);
+  std::vector<char> ok(n, 0);  // vector<bool> is not thread-writable
+  auto work = [&](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; ++i) {
+      ok[i] = ParseProcStat(procfs, names[i].c_str(), &got_pid[i],
+                            &got_cpu[i]);
+    }
+  };
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned nt = (n > 512 && hw > 1)
+                    ? std::min(4u, hw)
+                    : 1u;  // small trees: threads cost more than they save
+  if (nt <= 1) {
+    work(0, n);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nt);
+    const size_t chunk = (n + nt - 1) / nt;
+    try {
+      for (unsigned t = 0; t < nt; ++t) {
+        const size_t lo = t * chunk;
+        if (lo >= n) break;
+        threads.emplace_back(work, lo, std::min(lo + chunk, n));
+      }
+    } catch (...) {
+      // thread spawn failed mid-loop (EAGAIN under task limits): join
+      // what started — a joinable thread's destructor would terminate()
+      for (auto& th : threads) th.join();
+      throw;  // outer catch returns -1 → pure-Python fallback
+    }
+    for (auto& th : threads) th.join();
+  }
+  int count = 0;
+  for (size_t i = 0; i < n; ++i) {
+    if (!ok[i]) continue;
+    pids[count] = got_pid[i];
+    cpu_seconds[count] = got_cpu[i];
+    ++count;
+  }
+  return count;
+} catch (...) {
+  // bad_alloc / system_error must not unwind into ctypes frames; -1 sends
+  // callers to the pure-Python reader (graceful-degradation contract)
+  return -1;
 }
 
 // Aggregate 'cpu' line of <procfs>/stat → (active, total) jiffies, where
