@@ -61,6 +61,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         node_bucket=cfg.tpu.node_bucket,
         workload_bucket=cfg.tpu.workload_bucket,
         backend=cfg.tpu.fleet_backend,
+        accuracy_mode=cfg.aggregator.accuracy_mode,
         history_window=cfg.aggregator.history_window,
         training_dump_dir=cfg.aggregator.training_dump_dir,
         training_dump_max_files=cfg.aggregator.training_dump_max_files,

@@ -204,6 +204,11 @@ class AggregatorConfig:
     # models.estimator.save_params
     model: str = "mlp"
     params_path: str = ""
+    # serve estimators at f32/highest matmul precision — the configuration
+    # the 0.5% accuracy budget is validated under (benchmarks/accuracy.py);
+    # off = bf16 throughput mode. Estimator shapes are tiny, so the cost
+    # is negligible at typical fleet sizes.
+    accuracy_mode: bool = False
     # temporal mode: ticks of per-workload feature history the aggregator
     # accretes per node (the model's attention window)
     history_window: int = 16
@@ -310,6 +315,7 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "meshAxes": "mesh_axes",
     "fleetBackend": "fleet_backend",
     "historyWindow": "history_window",
+    "accuracyMode": "accuracy_mode",
     "trainingDumpDir": "training_dump_dir",
     "trainingDumpMaxFiles": "training_dump_max_files",
     "fakeCpuMeter": "fake_cpu_meter",
@@ -435,6 +441,8 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         default=None)
     add("--aggregator.node-mode", dest="aggregator_node_mode", default=None,
         choices=["ratio", "model"])
+    add("--aggregator.accuracy-mode", dest="aggregator_accuracy_mode",
+        default=None, action=argparse.BooleanOptionalAction)
     add("--aggregator.history-window", dest="aggregator_history_window",
         default=None, type=int)
     add("--aggregator.training-dump-dir", dest="aggregator_dump_dir",
@@ -483,6 +491,7 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "model"), args.aggregator_model)
     set_if(("aggregator", "params_path"), args.aggregator_params_path)
     set_if(("aggregator", "node_mode"), args.aggregator_node_mode)
+    set_if(("aggregator", "accuracy_mode"), args.aggregator_accuracy_mode)
     set_if(("aggregator", "history_window"), args.aggregator_history_window)
     set_if(("aggregator", "training_dump_dir"), args.aggregator_dump_dir)
     set_if(("aggregator", "training_dump_max_files"),

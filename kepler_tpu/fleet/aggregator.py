@@ -88,6 +88,7 @@ class Aggregator:
         node_bucket: int = 8,
         workload_bucket: int = 256,
         backend: str = "einsum",
+        accuracy_mode: bool = False,
         history_window: int = 16,
         training_dump_dir: str = "",
         training_dump_max_files: int = 1000,
@@ -102,6 +103,9 @@ class Aggregator:
         self._node_bucket = node_bucket
         self._workload_bucket = workload_bucket
         self._backend = backend
+        # serve estimators at f32/highest precision (the configuration the
+        # 0.5% accuracy budget is validated under); bf16 = throughput mode
+        self._accuracy_mode = accuracy_mode
         self._clock = clock or _time.time
         self._mesh = mesh
         # temporal mode: per-node feature-history ring buffers, fed on
@@ -304,11 +308,13 @@ class Aggregator:
         if self._program is None:
             if self._model_mode == "temporal":
                 self._program = make_temporal_fleet_program(
-                    self._mesh, backend=self._backend)
+                    self._mesh, backend=self._backend,
+                    accuracy_mode=self._accuracy_mode)
             else:
                 self._program = make_fleet_program(
                     self._mesh, model_mode=self._model_mode,
-                    backend=self._backend)
+                    backend=self._backend,
+                    accuracy_mode=self._accuracy_mode)
         program = self._program
         params = self._params_for_zones(n_zones)
         t0 = _time.perf_counter()

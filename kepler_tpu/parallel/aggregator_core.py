@@ -135,6 +135,7 @@ def temporal_fleet_program(
     t_valid: jax.Array,  # bool [N, W, T]
     *,
     attribute_fn=attribute_fleet,
+    accuracy_mode: bool = False,
 ) -> FleetResult:
     """Mixed fleet with the TEMPORAL estimator: the aggregator accretes each
     workload's feature history (`kepler_tpu.monitor.history`) and the model
@@ -145,7 +146,9 @@ def temporal_fleet_program(
         zone_deltas_uj, zone_valid, usage_ratio, cpu_deltas,
         workload_valid, node_cpu_delta, dt_s,
     )
-    watts = predict_temporal(model_params, feat_hist, workload_valid, t_valid)
+    pfn = (accuracy_mode_predictor(predict_temporal, "temporal")
+           if accuracy_mode else predict_temporal)
+    watts = pfn(model_params, feat_hist, workload_valid, t_valid=t_valid)
     return mix_model_watts(ratio, watts, mode, dt_s)
 
 
@@ -180,12 +183,34 @@ def shard_by_node(fn, mesh: Mesh, in_specs):
                      out_specs=P(NODE_AXIS), check_vma=False)
 
 
+def accuracy_mode_predictor(predict_fn, model_mode: str):
+    """Wrap a registry predictor for ACCURACY-mode serving: f32 compute
+    dtype (bf16 trunks carry ~1e-3 relative noise — twice the whole 0.5%
+    budget) and matmul precision HIGHEST for the estimator's ops (TPU
+    "f32" matmuls otherwise run one bf16 MXU pass). Estimator shapes are
+    tiny, so the 3-pass cost is invisible; the bulk ratio-attribution
+    contraction stays OUTSIDE the wrapper at default precision — this is
+    the configuration `benchmarks/accuracy.py` validates to p99 ≤ 0.5%.
+    """
+    kw = {} if model_mode == "linear" else {"compute_dtype": jnp.float32}
+
+    def wrapped(params, feats, workload_valid, **extra):
+        with jax.default_matmul_precision("highest"):
+            return predict_fn(params, feats, workload_valid, **kw, **extra)
+
+    return wrapped
+
+
 def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
-                       backend: str = "einsum"):
+                       backend: str = "einsum",
+                       accuracy_mode: bool = False):
     """jit the fleet program with node-axis shardings over ``mesh``.
 
     ``model_mode``: None = ratio only; "linear"/"mlp" compiles that
     predictor into the program for mixed fleets.
+
+    ``accuracy_mode``: serve the estimator at f32/highest precision (see
+    :func:`accuracy_mode_predictor`); default bf16 is the throughput mode.
 
     ``backend``: "einsum" lets XLA fuse the attribution contraction;
     "pallas" runs it as the hand-written Mosaic kernel
@@ -195,6 +220,8 @@ def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
     semantics; interpret mode engages automatically off-TPU).
     """
     predict_fn = predictor(model_mode) if model_mode else None
+    if predict_fn is not None and accuracy_mode:
+        predict_fn = accuracy_mode_predictor(predict_fn, model_mode)
     by_node_2d = NamedSharding(mesh, P(NODE_AXIS, None))
     by_node_1d = NamedSharding(mesh, P(NODE_AXIS))
     replicated = NamedSharding(mesh, P())
@@ -225,14 +252,16 @@ def make_fleet_program(mesh: Mesh, model_mode: str | None = None,
     )
 
 
-def make_temporal_fleet_program(mesh: Mesh, backend: str = "einsum"):
+def make_temporal_fleet_program(mesh: Mesh, backend: str = "einsum",
+                                accuracy_mode: bool = False):
     """jit the TEMPORAL fleet program (extra ``feat_hist``/``t_valid``
     inputs, node-axis sharded). Params replicate — the model is tiny; for
     very long windows serve through ``parallel.sequence`` instead."""
     by_node = NamedSharding(mesh, P(NODE_AXIS))
     replicated = NamedSharding(mesh, P())
     fn = functools.partial(temporal_fleet_program,
-                           attribute_fn=resolve_attribute_fn(mesh, backend))
+                           attribute_fn=resolve_attribute_fn(mesh, backend),
+                           accuracy_mode=accuracy_mode)
     if backend == "pallas":
         data_specs = (P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),
                       P(NODE_AXIS, None), P(NODE_AXIS, None), P(NODE_AXIS),

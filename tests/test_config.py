@@ -320,6 +320,9 @@ FIELD_MATRIX = [
     FieldCase("aggregator.params_path",
               "aggregator: {paramsPath: /tmp/p.npz}", "/tmp/p.npz",
               ["--aggregator.params-path", "/tmp/q.npz"], "/tmp/q.npz"),
+    FieldCase("aggregator.accuracy_mode",
+              "aggregator: {accuracyMode: true}", True,
+              ["--no-aggregator.accuracy-mode"], False),
     FieldCase("aggregator.history_window",
               "aggregator: {historyWindow: 4}", 4,
               ["--aggregator.history-window", "9"], 9),
@@ -395,6 +398,7 @@ class TestYAMLSpellings:
         "listenAddress": "aggregator", "staleAfter": "aggregator",
         "paramsPath": "aggregator", "tlsSkipVerify": "aggregator",
         "nodeMode": "aggregator", "historyWindow": "aggregator",
+        "accuracyMode": "aggregator",
         "trainingDumpDir": "aggregator",
         "trainingDumpMaxFiles": "aggregator",
         "workloadBucket": "tpu", "nodeBucket": "tpu", "meshShape": "tpu",
@@ -414,6 +418,7 @@ class TestYAMLSpellings:
         "paramsPath": ("/tmp/p", "/tmp/p"),
         "tlsSkipVerify": ("true", True),
         "nodeMode": ("model", "model"),
+        "accuracyMode": ("true", True),
         "historyWindow": ("3", 3),
         "trainingDumpDir": ("/tmp/d", "/tmp/d"),
         "trainingDumpMaxFiles": ("2", 2),
@@ -530,3 +535,33 @@ class TestFullPrecedenceChain:
         assert cfg.monitor.interval == 9.0  # file beat default
         assert cfg.tpu.fleet_backend == "pallas"  # kebab key in file
         assert cfg.monitor.staleness == 0.5  # untouched default
+
+
+class TestAccuracyModeConfig:
+    def test_yaml_spellings(self, tmp_path):
+        from kepler_tpu.config.config import from_file
+
+        for form in ("accuracyMode: true", "accuracy-mode: true",
+                     "accuracy_mode: true"):
+            p = tmp_path / "c.yaml"
+            p.write_text(f"aggregator:\n  {form}\n")
+            assert from_file(str(p)).aggregator.accuracy_mode is True, form
+
+    def test_flag_overrides_file(self, tmp_path):
+        import argparse
+
+        from kepler_tpu.config.config import (apply_flags, from_file,
+                                              register_flags)
+
+        p = tmp_path / "c.yaml"
+        p.write_text("aggregator:\n  accuracyMode: true\n")
+        parser = argparse.ArgumentParser()
+        register_flags(parser)
+        args = parser.parse_args(["--no-aggregator.accuracy-mode"])
+        cfg = apply_flags(from_file(str(p)), args)
+        assert cfg.aggregator.accuracy_mode is False
+
+    def test_default_off(self):
+        from kepler_tpu.config.config import Config
+
+        assert Config().aggregator.accuracy_mode is False
