@@ -79,6 +79,19 @@ class PowerCollector:
         self._node_name = node_name
         self._level = metrics_level
         self._ready_timeout = ready_timeout
+        # render_text()'s cached per-row label block holds every label
+        # EXCEPT zone and is reused verbatim with `,zone="…"` appended —
+        # sound only while every other label name sorts before "zone".
+        # Enforce here (not via assert: -O must not silently change series
+        # identity) so a future label addition fails loudly at construction.
+        const_keys = ["node_name"] if node_name else []
+        for kind, names in _META_LABEL_SETS.items():
+            bad = [k for k in [*names, "state", *const_keys] if k >= "zone"]
+            if bad:
+                raise ValueError(
+                    f"label names {bad} for kind {kind!r} sort at/after "
+                    "'zone'; the cached-prefix text render requires all "
+                    "non-zone labels to sort before it")
 
     def _is_ready(self) -> bool:
         return self._monitor.data_channel().wait(self._ready_timeout)
@@ -257,10 +270,9 @@ class PowerCollector:
                                                             fmt_float)
 
         label_names = list(_META_LABEL_SETS[kind])
-        # the cached per-row block holds every label EXCEPT zone; valid
-        # only because "zone" sorts after all label names we emit
-        assert all(k < "zone" for k in
-                   label_names + ["state"] + list(const))
+        # the cached per-row block holds every label EXCEPT zone; sound
+        # because every other label name sorts before "zone" — enforced
+        # with a real ValueError in __init__
         nonzone = label_names + ["state"] + list(const)
         order = sorted(range(len(nonzone)), key=lambda i: nonzone[i])
         jname = f"kepler_{kind}_cpu_joules_total"

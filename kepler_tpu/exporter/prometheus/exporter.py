@@ -68,6 +68,21 @@ class PrometheusExporter:
         return "prometheus-exporter"
 
     def init(self) -> None:
+        # classic-text byte-identity with a full registry render requires
+        # every PowerCollector to be registered BEFORE any aux collector
+        # (the fast path concatenates power-then-aux); enforce rather than
+        # assume create_collectors' ordering
+        seen_aux = False
+        for c in self._collectors:
+            if isinstance(c, PowerCollector):
+                if seen_aux:
+                    raise ValueError(
+                        "PowerCollector registered after a non-power "
+                        "collector; the classic-text fast path renders "
+                        "power families first, so this ordering would "
+                        "change family order vs the stock renderer")
+            else:
+                seen_aux = True
         for c in self._collectors:
             self._registry.register(c)  # type: ignore[arg-type]
             if not isinstance(c, PowerCollector):

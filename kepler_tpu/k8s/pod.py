@@ -132,11 +132,24 @@ class PodInformer:
         kubeconfig: str = "",
         resync_interval: float = 300.0,
         client: KubeClient | None = None,
+        backoff_base: float = 1.0,
+        backoff_cap: float = 30.0,
+        rng=None,
     ) -> None:
+        import random
+
         self._node_name = node_name
         self._kubeconfig = kubeconfig
         self._resync = resync_interval
         self._client = client
+        # jittered exponential backoff for consecutive watch failures
+        # (controller-runtime reflector analog; jitter keeps a fleet of
+        # node agents from hitting a flapping API server in lockstep)
+        self._backoff_base = backoff_base
+        self._backoff_cap = (min(backoff_cap, resync_interval)
+                             if resync_interval > 0 else backoff_cap)
+        self._rng = rng or random.Random()
+        self._made_progress = False
         self._lock = threading.Lock()
         # containerID → (pod_id, pod_name, namespace, container_name)
         self._index: dict[str, tuple[str, str, str, str]] = {}
@@ -163,33 +176,61 @@ class PodInformer:
         compacts our resourceVersion) triggers an *immediate* re-list rather
         than waiting out the stream timeout — the recovery controller-runtime
         performs for the reference (``internal/k8s/pod/pod.go:136-196``).
-        Only the FIRST consecutive ERROR gets the fast path: if the fresh
-        resourceVersion is rejected again, fall back to the normal wait so a
-        persistently failing watch can't become a tight LIST/WATCH loop
-        against the API server (the reflector's backoff analog).
+        Only the FIRST consecutive failure gets the fast path; repeated
+        failures (the server rejecting watch after watch, or the re-list
+        itself failing) wait out a *jittered exponential backoff*
+        (base·2^k capped, ×[0.5, 1.5) jitter) so a flapping API server is
+        not hit in lockstep by every node agent — the reflector's backoff
+        analog. Any successfully-applied watch event resets the streak.
         """
-        error_streak = 0
+        failures = 0
         while not ctx.cancelled():
             expired = False
+            failed = False
+            self._made_progress = False
             try:
                 expired = self._watch(ctx)
             except Exception as err:
+                failed = True
                 log.warning("pod watch interrupted: %s", err)
             if ctx.cancelled():
                 return
-            error_streak = error_streak + 1 if expired else 0
-            if expired and error_streak == 1:
+            if self._made_progress:
+                failures = 0  # the stream was healthy before it ended
+            if expired and failures == 0:
                 try:
                     self.relist()
+                    failures = 1  # a second rejection backs off
                     continue  # fresh resourceVersion: re-watch right away
                 except Exception as err:
+                    failed = True
                     log.warning("pod re-list after ERROR failed: %s", err)
-            if ctx.wait(min(5.0, self._resync)):
+            if expired or failed:
+                failures += 1
+                delay = self._watch_backoff(failures)
+                log.warning("pod watch failing (streak=%d); backing off "
+                            "%.2fs", failures, delay)
+            else:
+                # clean close (even with zero events on a quiet node) is
+                # healthy: isolated errors hours apart must not accumulate
+                # into a "consecutive" streak
+                failures = 0
+                delay = min(5.0, self._resync)
+            if ctx.wait(delay):
                 return
             try:
                 self.relist()
             except Exception as err:
+                failures += 1
                 log.warning("pod re-list failed: %s", err)
+
+    def _watch_backoff(self, failures: int) -> float:
+        """Jittered exponential delay for the k-th consecutive failure.
+        The exponent is clamped — a multi-hour outage must saturate at the
+        cap, not overflow float exponentiation (2.0**1024 raises)."""
+        base = min(self._backoff_base * (2.0 ** min(failures - 1, 30)),
+                   self._backoff_cap)
+        return base * (0.5 + self._rng.random())
 
     # -- cache maintenance -------------------------------------------------
 
@@ -254,6 +295,7 @@ class PodInformer:
                 self._resource_version = ""
             return True
         rv = pod.get("metadata", {}).get("resourceVersion")
+        self._made_progress = True  # healthy event: reset the failure streak
         with self._lock:
             if rv:
                 self._resource_version = rv

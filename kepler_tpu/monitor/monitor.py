@@ -433,7 +433,15 @@ class PowerMonitor:
             power_rows = power_wz[idx] if n else np.zeros((0, nz))
             # gather prev cumulative, one vectorized add, scatter views
             # back (rows alias energy_rows — safe: snapshot arrays are
-            # never mutated after publication, each refresh builds new)
+            # never mutated after publication, each refresh builds new).
+            # PRECONDITION: ids within a kind are unique (they come from
+            # dict-keyed informer views) — a duplicate would silently drop
+            # one delta in the last-writer-wins scatter below, so fail
+            # loudly (not assert: -O must not change energy accounting)
+            if len(set(ids)) != len(ids):
+                raise ValueError(
+                    f"duplicate {kind_name} ids in feature batch; "
+                    "cumulative energy accounting requires unique ids")
             get = store.get
             for row, wid in enumerate(ids):
                 acc = get(wid)

@@ -92,10 +92,17 @@ static bool ParseProcStat(const char* procfs, const char* name,
   char* save = nullptr;
   for (char* t = strtok_r(rest, " ", &save); t != nullptr;
        t = strtok_r(nullptr, " ", &save), ++tok) {
+    // endptr checks: a corrupt stat line (non-numeric utime/stime) must
+    // skip the process, matching the pure-Python reader's raise-and-skip
+    // semantics — not admit it with cpu_seconds=0. strtok_r tokens are
+    // NUL-terminated, so a fully-numeric token ends exactly at '\0'.
+    char* end = nullptr;
     if (tok == 11) {
-      utime = strtoull(t, nullptr, 10);
+      utime = strtoull(t, &end, 10);
+      if (end == t || *end != '\0') return false;
     } else if (tok == 12) {
-      stime = strtoull(t, nullptr, 10);
+      stime = strtoull(t, &end, 10);
+      if (end == t || *end != '\0') return false;
       ok = true;
       break;
     }

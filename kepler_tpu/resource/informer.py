@@ -200,8 +200,10 @@ class ResourceInformer:
                                      exe=info.executable(),
                                      cpu_total_time=cpu, cpu_time_delta=cpu)
                     self._classify(info, cached)
-                except OSError:
-                    continue  # PID vanished mid-scan
+                except (OSError, ValueError, IndexError):
+                    # vanished mid-scan, or truncated/garbage proc files
+                    # mid-exit — same tolerance as the legacy scan loop
+                    continue
                 cache[pid] = cached
                 running[pid] = cached
                 continue
@@ -215,8 +217,8 @@ class ResourceInformer:
                     cached.comm = info.comm()
                     if not cached.classified:
                         self._classify(info, cached)
-                except OSError:
-                    pass
+                except (OSError, ValueError, IndexError):
+                    pass  # mid-exit garbage: keep cached identity
             running[pid] = cached
         return running
 

@@ -313,6 +313,127 @@ class TestWatchFaults:
         assert inf.lookup_by_container_id("c1") is not None
 
 
+class RecordingCtx(CancelContext):
+    """Records every wait() delay without sleeping; cancels after N."""
+
+    def __init__(self, stop_after):
+        super().__init__()
+        self.delays = []
+        self._stop_after = stop_after
+
+    def wait(self, timeout=None):
+        if self.cancelled():
+            return True
+        self.delays.append(timeout)
+        if len(self.delays) >= self._stop_after:
+            self.cancel()
+            return True
+        return False
+
+
+class RejectingClient:
+    """LIST always succeeds; every WATCH is rejected with ERROR 410."""
+
+    def __init__(self):
+        self.paths = []
+        self.rv = 100
+
+    def get(self, path, timeout=30.0):
+        self.paths.append(path)
+        if "watch=true" in path:
+            frame = json.dumps({"type": "ERROR", "object": {
+                "kind": "Status", "code": 410, "reason": "Expired"}})
+            return io.BytesIO(frame.encode() + b"\n")
+        self.rv += 1
+        return io.BytesIO(json.dumps({
+            "metadata": {"resourceVersion": str(self.rv)},
+            "items": []}).encode())
+
+
+class TestWatchBackoff:
+    """Jittered exponential backoff under persistent watch rejection
+    (controller-runtime reflector behavior, reference pod.go:136-144)."""
+
+    def run_rejected(self, n_waits, seed=7, base=1.0, cap=30.0):
+        import random
+
+        client = RejectingClient()
+        inf = PodInformer("node-1", client=client, resync_interval=300.0,
+                          backoff_base=base, backoff_cap=cap,
+                          rng=random.Random(seed))
+        inf.init()
+        ctx = RecordingCtx(n_waits)
+        inf.run(ctx)
+        return inf, client, ctx
+
+    def test_rejected_watches_back_off_exponentially(self):
+        _, client, ctx = self.run_rejected(6)
+        # first rejection takes the fast re-list path (no wait); every
+        # later one must wait out base·2^(k-1) × [0.5, 1.5) jitter
+        assert len(ctx.delays) == 6
+        for i, delay in enumerate(ctx.delays):
+            envelope = min(1.0 * 2.0 ** (i + 1), 30.0)
+            assert 0.5 * envelope <= delay < 1.5 * envelope, \
+                f"delay[{i}]={delay} outside jitter envelope {envelope}"
+        # delays saturate at the cap (±jitter), never beyond 1.5×cap
+        assert max(ctx.delays) < 1.5 * 30.0
+
+    def test_backoff_caps(self):
+        _, _, ctx = self.run_rejected(12, cap=4.0)
+        assert all(d < 1.5 * 4.0 for d in ctx.delays[-5:])
+
+    def test_jitter_differs_across_agents(self):
+        _, _, ctx_a = self.run_rejected(5, seed=1)
+        _, _, ctx_b = self.run_rejected(5, seed=2)
+        assert ctx_a.delays != ctx_b.delays  # no fleet lockstep
+
+    def test_only_first_failure_gets_fast_relist(self):
+        _, client, ctx = self.run_rejected(4)
+        kinds = ["watch" if "watch=true" in p else "list"
+                 for p in client.paths]
+        # init LIST, rejected WATCH, fast re-list, then strictly
+        # alternating backoff-wait → LIST → WATCH (no tight loop)
+        assert kinds[:3] == ["list", "watch", "list"]
+        assert kinds.count("list") <= kinds.count("watch") + 2
+
+    def test_healthy_event_resets_streak(self):
+        """A stream that applied events before failing gets the fast
+        re-list path again — the streak is consecutive *failures*."""
+        import random
+
+        class FlapClient(RejectingClient):
+            def __init__(self):
+                super().__init__()
+                self.watch_n = 0
+
+            def get(self, path, timeout=30.0):
+                if "watch=true" not in path:
+                    return super().get(path, timeout)
+                self.paths.append(path)
+                self.watch_n += 1
+                if self.watch_n == 3:
+                    # healthy stream: one applied event, then clean close
+                    frame = json.dumps({"type": "ADDED", "object": pod_obj(
+                        UID_A, "web",
+                        containers=[("app", "containerd://ok")], rv="500")})
+                    return io.BytesIO(frame.encode() + b"\n")
+                frame = json.dumps({"type": "ERROR", "object": {
+                    "kind": "Status", "code": 410, "reason": "Expired"}})
+                return io.BytesIO(frame.encode() + b"\n")
+
+        client = FlapClient()
+        inf = PodInformer("node-1", client=client, resync_interval=300.0,
+                          rng=random.Random(3))
+        inf.init()
+        ctx = RecordingCtx(4)
+        inf.run(ctx)
+        # watch 3 was healthy (clean close → resync wait of 5 s, streak
+        # reset); watch 4's ERROR takes the fast path again, so the wait
+        # after it is the FIRST backoff level again, not the third
+        resync_waits = [d for d in ctx.delays if d == 5.0]
+        assert resync_waits, f"expected a clean resync wait in {ctx.delays}"
+
+
 class TestResourceLayerIntegration:
     def test_informer_feeds_pod_lookup(self):
         """ResourceInformer resolves container → pod via the k8s index

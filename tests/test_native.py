@@ -77,6 +77,28 @@ def test_scan_skips_vanished_pid(scanner, fake_proc):
     assert 7777 not in pids.tolist()
 
 
+def test_scan_skips_corrupt_stat_like_python(scanner, fake_proc):
+    """Hostile /proc content: non-numeric utime/stime must SKIP the
+    process (python-reader parity), not admit it with cpu_seconds=0."""
+    d = fake_proc / "8888"
+    d.mkdir()
+    head = "8888 (evil) S 1 1 1 0 -1 4194560 100 0 0 0"
+    tail = "NaNN garbage 0 0 20 0 1 0 100 0 0 " + " ".join(["0"] * 29)
+    (d / "stat").write_text(head + " " + tail)
+    pids, _ = scanner.scan_procs(str(fake_proc))
+    assert 8888 not in pids.tolist()
+    ref = ProcFSReader(str(fake_proc))
+    got_py = []
+    for p in ref.all_procs():
+        try:
+            p.cpu_time()
+            got_py.append(p.pid())
+        except (ValueError, IndexError):
+            pass
+    assert 8888 not in got_py  # both readers agree: skipped
+    assert sorted(pids.tolist()) == sorted(got_py)
+
+
 def test_stat_totals_matches_python(scanner, fake_proc):
     active, total = scanner.stat_totals(str(fake_proc))
     want = ProcFSReader(str(fake_proc))._read_stat_totals()
