@@ -226,6 +226,12 @@ def run(n_procs: int = 10_000, iters: int = 11, root: str | None = None
         "node_scrape_reader": "native" if native else "python",
         "node_scrape_py_p99_ms": python["p99_ms"],
         "node_scrape_py_p50_ms": python["p50_ms"],
+        # budget gate: the whole on-node hot path (refresh + render) at
+        # 10k procs must beat 100 ms p99 — "matching a Go exporter"
+        # territory (VERDICT r3 item 2). Informational on the pure-Python
+        # fallback; the native reader is the shipped configuration.
+        "node_scrape_budget_ms": 100.0,
+        "node_scrape_budget_ok": bool(best["p99_ms"] < 100.0),
     }
     if native:
         out["native_scan_speedup"] = round(

@@ -43,8 +43,7 @@ _ZONES = ("package", "dram")
 def _table(kind: str) -> WorkloadTable:
     meta = {
         "process": {"comm": "bash", "exe": "/bin/bash", "type": "regular",
-                    "container_id": "", "vm_id": "",
-                    "_cpu_total_seconds": 1.0},
+                    "container_id": "", "vm_id": ""},
         "container": {"container_name": "web", "runtime": "docker",
                       "pod_id": "p-1"},
         "vm": {"vm_name": "guest", "hypervisor": "kvm"},
@@ -54,6 +53,7 @@ def _table(kind: str) -> WorkloadTable:
         ids=("1",), meta=(meta,),
         energy_uj=np.full((1, len(_ZONES)), 1e6),
         power_uw=np.full((1, len(_ZONES)), 1e6),
+        seconds=np.ones(1) if kind == "process" else None,
     )
 
 

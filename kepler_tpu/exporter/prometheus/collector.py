@@ -65,6 +65,21 @@ def _node_family_doc(desc: str, state: str) -> str:
             " at node level")
 
 
+_NATIVE_RENDERER = False  # False = unresolved; None = unavailable
+
+
+def _native_renderer():
+    """Process-wide native sample renderer, or None (pure-Python render)."""
+    global _NATIVE_RENDERER
+    if _NATIVE_RENDERER is False:
+        try:
+            from kepler_tpu import native
+            _NATIVE_RENDERER = native.scanner()
+        except Exception:  # no compiler / load failure → Python fallback
+            _NATIVE_RENDERER = None
+    return _NATIVE_RENDERER
+
+
 class PowerCollector:
     """Custom collector; registered into the exporter's registry."""
 
@@ -178,10 +193,12 @@ class PowerCollector:
                     lv = values + [state, zone] + list(const.values())
                     joules.add_metric(lv, float(table.energy_uj[i, z]) / JOULE)
                     watts.add_metric(lv, float(table.power_uw[i, z]) / WATT)
-                if seconds is not None and "_cpu_total_seconds" in meta:
+                if seconds is not None and table.seconds is not None:
+                    # 6-decimal rounding matches the reference's seconds
+                    # formatting (and the native renderer's round6 flag)
                     seconds.add_metric(
                         values + [state] + list(const.values()),
-                        float(meta["_cpu_total_seconds"]))
+                        float(f"{float(table.seconds[i]):.6f}"))
         yield joules
         yield watts
         if seconds is not None:
@@ -201,7 +218,16 @@ class PowerCollector:
     def render_text(self) -> bytes:
         """Classic-text exposition of this collector's families (fast
         path). Empty bytes when not ready / snapshot unavailable — the
-        same scrapes collect() would skip."""
+        same scrapes collect() would skip.
+
+        Per-row label blocks are cached as bytes across scrapes (labels
+        change on exec/reclassify; values change every tick); when the
+        native library is present the value formatting and line assembly
+        for a whole family happen in ONE C call
+        (``kepler_render_samples``), so a 10k-process scrape does no
+        per-sample Python work at all. Byte parity with the stock
+        renderer is pinned by tests/test_exporter_wire.py either way.
+        """
         from kepler_tpu.exporter.prometheus.fastexpo import _escape_value
 
         if not self._is_ready():
@@ -214,9 +240,11 @@ class PowerCollector:
             log.warning("scrape skipped: %s", err)
             return b""
         const = {"node_name": self._node_name} if self._node_name else {}
-        out: list[str] = []
+        out: list[bytes] = []
         if Level.NODE in self._level:
-            self._render_node_text(out, snap, const)
+            node_out: list[str] = []
+            self._render_node_text(node_out, snap, const)
+            out.append("".join(node_out).encode("utf-8"))
         ezones = [(z, _escape_value(z)) for z in snap.node.zone_names]
         new_cache: dict = {}
         for kind, level, run_attr, term_attr in _KIND_TABLES:
@@ -227,7 +255,7 @@ class PowerCollector:
                                        getattr(snap, term_attr), const,
                                        new_cache)
         self._label_cache = new_cache  # drop vanished workloads' entries
-        return "".join(out).encode("utf-8")
+        return b"".join(out)
 
     def _render_node_text(self, out: list[str], snap, const) -> None:
         from prometheus_client.utils import floatToGoString
@@ -262,7 +290,7 @@ class PowerCollector:
         out.append(f"{name}{labelstr} "
                    f"{floatToGoString(node.usage_ratio)}\n")
 
-    def _render_workload_text(self, out: list[str], kind: str, ezones,
+    def _render_workload_text(self, out: list[bytes], kind: str, ezones,
                               running: WorkloadTable,
                               terminated: WorkloadTable, const,
                               new_cache: dict) -> None:
@@ -277,64 +305,112 @@ class PowerCollector:
         order = sorted(range(len(nonzone)), key=lambda i: nonzone[i])
         jname = f"kepler_{kind}_cpu_joules_total"
         wname = f"kepler_{kind}_cpu_watts"
-        j_lines: list[str] = []
-        w_lines: list[str] = []
-        s_lines: list[str] = []
         cache = getattr(self, "_label_cache", {})
         const_vals = tuple(const.values())
         is_process = kind == "process"
-        for state, table in (("running", running),
-                             ("terminated", terminated)):
-            energy = table.energy_uj
-            power = table.power_uw
+        states = (("running", running), ("terminated", terminated))
+        # pass 1: per-row label blocks. The whole (prefix list, joined
+        # blob, offsets) is cached per state keyed on the table's id and
+        # meta tuples — meta dicts are object-cached by the informer, so
+        # in the steady state (values change, labels don't) this is two
+        # tuple comparisons, not 10k dict probes.
+        prefixes_by_state: list[tuple[list[bytes], bytes, object]] = []
+        blob_cache = getattr(self, "_blob_cache", {})
+        new_blobs = {}
+        for state, table in states:
+            bkey = (kind, state)
+            blob_cached = blob_cache.get(bkey)
+            if (blob_cached is not None and blob_cached[0] == table.ids
+                    and blob_cached[1] == table.meta):
+                new_blobs[bkey] = blob_cached
+                prefixes_by_state.append(blob_cached[2])
+                # keep the per-row cache warm for the next membership change
+                for key, entry in blob_cached[3].items():
+                    new_cache[key] = entry
+                continue
             metas = table.meta
+            prefixes: list[bytes] = []
+            row_cache: dict = {}
             for i, wid in enumerate(table.ids):
                 meta = metas[i]
                 key = (kind, state, wid)
                 cached = cache.get(key)
-                # meta dicts are rebuilt per refresh but rarely CHANGE;
-                # one C-speed dict compare replaces label extraction,
-                # escaping, and sorting for the unchanged 90%+
-                if cached is not None and cached[0] == meta:
-                    prefix, s_val = cached[1], cached[2]
+                if cached is not None and (cached[0] is meta
+                                           or cached[0] == meta):
+                    prefix = cached[1]
                     new_cache[key] = cached
                 else:
                     values = self._label_values(kind, wid, meta,
                                                 label_names)
                     row = tuple(values) + (state,) + const_vals
-                    prefix = "{" + ",".join(
+                    prefix = ("{" + ",".join(
                         f'{nonzone[i_]}="{_escape_value(row[i_])}"'
-                        for i_ in order)
-                    s_val = (fmt_float(float(meta["_cpu_total_seconds"]))
-                             if is_process and "_cpu_total_seconds" in meta
-                             else None)
-                    new_cache[key] = (meta, prefix, s_val)
-                for z, (_, ez) in enumerate(ezones):
-                    # divide (not multiply-by-inverse): byte parity with
-                    # collect()'s float(x) / JOULE rounding
-                    j_lines.append(
-                        f'{jname}{prefix},zone="{ez}"}} '
-                        f"{fmt_float(float(energy[i, z]) / JOULE)}\n")
-                    w_lines.append(
-                        f'{wname}{prefix},zone="{ez}"}} '
-                        f"{fmt_float(float(power[i, z]) / WATT)}\n")
-                if s_val is not None:
-                    s_lines.append(
-                        f"kepler_process_cpu_seconds_total{prefix}}} "
-                        f"{s_val}\n")
+                        for i_ in order)).encode("utf-8")
+                    cached = (meta, prefix)
+                    new_cache[key] = cached
+                row_cache[key] = cached
+                prefixes.append(prefix)
+            import numpy as np
+            off = np.zeros(len(prefixes) + 1, np.int64)
+            if prefixes:
+                np.cumsum([len(p) for p in prefixes], out=off[1:])
+            entry3 = (prefixes, b"".join(prefixes), off)
+            new_blobs[bkey] = (table.ids, table.meta, entry3, row_cache)
+            prefixes_by_state.append(entry3)
+        blob_cache.update(new_blobs)
+        self._blob_cache = blob_cache
+        ztails = [f',zone="{ez}"}} '.encode("utf-8") for _, ez in ezones]
+        native = _native_renderer()
+        # pass 2: families — joules, watts, then (processes) seconds; each
+        # family lists running rows then terminated rows, matching the
+        # registry renderer's sample order
         out.append(f"# HELP {jname} Energy consumption of cpu at {kind} "
-                   "level in joules\n")
-        out.append(f"# TYPE {jname} counter\n")
-        out.extend(j_lines)
+                   f"level in joules\n# TYPE {jname} counter\n".encode())
+        self._render_family(out, jname.encode(), prefixes_by_state, states,
+                            "energy_uj", ztails, JOULE, native, fmt_float)
         out.append(f"# HELP {wname} Power consumption of cpu at {kind} "
-                   "level in watts\n")
-        out.append(f"# TYPE {wname} gauge\n")
-        out.extend(w_lines)
-        if kind == "process":
-            out.append("# HELP kepler_process_cpu_seconds_total Total user "
-                       "and system time of the process in seconds\n")
-            out.append("# TYPE kepler_process_cpu_seconds_total counter\n")
-            out.extend(s_lines)
+                   f"level in watts\n# TYPE {wname} gauge\n".encode())
+        self._render_family(out, wname.encode(), prefixes_by_state, states,
+                            "power_uw", ztails, WATT, native, fmt_float)
+        if is_process:
+            out.append(b"# HELP kepler_process_cpu_seconds_total Total "
+                       b"user and system time of the process in seconds\n"
+                       b"# TYPE kepler_process_cpu_seconds_total counter\n")
+            self._render_family(out, b"kepler_process_cpu_seconds_total",
+                                prefixes_by_state, states, "seconds",
+                                [b"} "], 1.0, native, fmt_float,
+                                round6=True)
+
+    @staticmethod
+    def _render_family(out: list[bytes], name: bytes,
+                       prefixes_by_state, states, attr: str,
+                       ztails: list[bytes], div: float, native,
+                       fmt_float, round6: bool = False) -> None:
+        """One family's sample lines (running then terminated rows):
+        native renderer when available, else a per-sample Python loop
+        producing identical bytes."""
+        import numpy as np
+        for (prefixes, blob, off), (_state, table) in zip(
+                prefixes_by_state, states):
+            values = getattr(table, attr)
+            if values is None or not len(prefixes):
+                continue
+            if values.ndim == 1:
+                values = values[:, None]
+            if native is not None:
+                zoff = np.zeros(len(ztails) + 1, np.int32)
+                np.cumsum([len(z) for z in ztails], out=zoff[1:])
+                out.append(native.render_samples(
+                    name, blob, off, b"".join(ztails), zoff,
+                    values, div, round6=round6))
+                continue
+            for i, prefix in enumerate(prefixes):
+                for z, ztail in enumerate(ztails):
+                    v = float(values[i, z]) / div
+                    if round6:
+                        v = float(f"{v:.6f}")
+                    out.append(name + prefix + ztail
+                               + fmt_float(v).encode() + b"\n")
 
     @staticmethod
     def _label_values(kind: str, wid: str, meta, label_names: Iterable[str]

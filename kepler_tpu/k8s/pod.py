@@ -295,7 +295,11 @@ class PodInformer:
                 self._resource_version = ""
             return True
         rv = pod.get("metadata", {}).get("resourceVersion")
-        self._made_progress = True  # healthy event: reset the failure streak
+        if kind in ("ADDED", "MODIFIED", "DELETED"):
+            # only real object events count as progress — a BOOKMARK
+            # applies nothing, and a server that serves bookmark-then-410
+            # every cycle must still escalate the backoff, not reset it
+            self._made_progress = True
         with self._lock:
             if rv:
                 self._resource_version = rv

@@ -59,6 +59,71 @@ _KIND_CODES = (
 )
 
 
+class _CumStore:
+    """Cumulative per-workload energy accumulators for one kind.
+
+    Values live in one dense f64 ``[cap, Z]`` array; ids map to rows that
+    persist for the workload's lifetime (freed on termination). The
+    per-tick update is a single gather-add-scatter over a row-index
+    array cached while the id tuple is unchanged — no per-row Python.
+    """
+
+    def __init__(self, n_zones: int) -> None:
+        self._z = n_zones
+        self.arr = np.zeros((64, n_zones))
+        self.rows: dict[str, int] = {}
+        self._free: list[int] = list(range(63, -1, -1))
+        self._cached: tuple[tuple[str, ...], np.ndarray] | None = None
+
+    def __contains__(self, wid: str) -> bool:
+        return wid in self.rows
+
+    def row_indices(self, ids: tuple[str, ...]) -> np.ndarray:
+        cached = self._cached
+        if cached is not None and cached[0] == ids:
+            return cached[1]
+        if len(set(ids)) != len(ids):
+            # a duplicate id would collapse onto one row and the scatter
+            # in accumulate() would drop a delta — fail loudly (not
+            # assert: -O must not change energy accounting)
+            raise ValueError(
+                "duplicate workload ids in feature batch; cumulative "
+                "energy accounting requires unique ids per kind")
+        idx = np.empty(len(ids), np.intp)
+        get = self.rows.get
+        for j, wid in enumerate(ids):
+            r = get(wid)
+            if r is None:
+                if not self._free:
+                    grow = len(self.arr)
+                    self.arr = np.vstack(
+                        [self.arr, np.zeros((grow, self._z))])
+                    self._free = list(range(2 * grow - 1, grow - 1, -1))
+                r = self._free.pop()
+                self.arr[r] = 0.0
+                self.rows[wid] = r
+            idx[j] = r
+        self._cached = (ids, idx)
+        return idx
+
+    def accumulate(self, ids: tuple[str, ...],
+                   deltas: np.ndarray) -> np.ndarray:
+        """arr[ids] += deltas; → the new cumulative values [n, Z]."""
+        idx = self.row_indices(ids)
+        vals = self.arr[idx] + deltas
+        self.arr[idx] = vals
+        return vals
+
+    def value(self, wid: str) -> np.ndarray:
+        return self.arr[self.rows[wid]]
+
+    def pop(self, wid: str) -> None:
+        r = self.rows.pop(wid, None)
+        if r is not None:
+            self._free.append(r)
+            self._cached = None
+
+
 @dataclass(frozen=True)
 class WindowSample:
     """Raw per-refresh inputs, before attribution — the feature rows a fleet
@@ -106,15 +171,10 @@ class PowerMonitor:
         self._batch_plan = _UNSET  # lazily-resolved native zone-read plan
         self._last_read_ts: float | None = None
 
-        # cumulative f64 accumulators: kind → id → [Z] µJ
-        self._cumulative: dict[str, dict[str, np.ndarray]] = {
-            k: {} for k in _KINDS
-        }
-        # last-known labels so terminated rows keep their metadata
-        # (reference pulls terminated entries from the previous snapshot)
-        self._meta_cache: dict[str, dict[str, Mapping[str, str]]] = {
-            k: {} for k in _KINDS
-        }
+        # cumulative f64 accumulators: kind → dense row store (id-keyed)
+        self._cumulative: dict[str, _CumStore] = {}
+        # per-kind meta tuple cache keyed on (informer meta_gen, view id)
+        self._meta_rows_cache: dict[str, tuple] = {}
         self._node_energy = np.zeros(0)
         self._node_active = np.zeros(0)
         self._node_idle = np.zeros(0)
@@ -147,6 +207,9 @@ class PowerMonitor:
         primary = self._meter.primary_energy_zone().name()
         primary_idx = self._zone_names.index(primary)
         for kind in _KINDS:
+            store = self._cumulative.get(kind)
+            if store is None or store.arr.shape[1] != z:
+                self._cumulative[kind] = _CumStore(z)
             self._trackers[kind] = TerminatedTracker(
                 n_zones=z,
                 primary_zone_index=primary_idx,
@@ -382,109 +445,159 @@ class PowerMonitor:
             usage_ratio=float(usage_ratio),
         )
 
-    def _workload_meta(self) -> dict[str, dict[str, Mapping[str, str]]]:
-        """Exporter label metadata per kind/id, from the informer's views."""
+    @staticmethod
+    def _process_meta(p) -> Mapping[str, str]:
+        m = p.meta_cache
+        if m is None:
+            m = {"comm": p.comm, "exe": p.exe,
+                 "type": ("container" if p.container else
+                          "vm" if p.virtual_machine else "regular"),
+                 "container_id": p.container.id if p.container else "",
+                 "vm_id": (p.virtual_machine.id
+                           if p.virtual_machine else "")}
+            p.meta_cache = m
+        return m
+
+    @staticmethod
+    def _container_meta(c) -> Mapping[str, str]:
+        m = c.meta_cache
+        if m is None:
+            m = {"container_name": c.name, "runtime": c.runtime.value,
+                 "pod_id": c.pod_id or ""}
+            c.meta_cache = m
+        return m
+
+    @staticmethod
+    def _vm_meta(v) -> Mapping[str, str]:
+        m = v.meta_cache
+        if m is None:
+            m = {"vm_name": v.name, "hypervisor": v.hypervisor.value}
+            v.meta_cache = m
+        return m
+
+    @staticmethod
+    def _pod_meta(p) -> Mapping[str, str]:
+        m = p.meta_cache
+        if m is None:
+            m = {"pod_name": p.name, "namespace": p.namespace}
+            p.meta_cache = m
+        return m
+
+    def _meta_rows(self, kind: str) -> tuple[Mapping[str, str], ...]:
+        """Label dicts for the running workloads of ``kind``, in informer
+        view order (== feature-batch row order: both walk the same dicts).
+        Dicts are cached on the objects and invalidated by the informer on
+        identity changes; the whole tuple is reused between ticks while the
+        informer's ``meta_gen`` and the view dict are unchanged."""
         res = self._resources
-        meta: dict[str, dict[str, Mapping[str, str]]] = {
-            "processes": {
-                str(pid): {"comm": p.comm, "exe": p.exe,
-                           "type": ("container" if p.container else
-                                    "vm" if p.virtual_machine else "regular"),
-                           "container_id": p.container.id if p.container else "",
-                           "vm_id": (p.virtual_machine.id
-                                     if p.virtual_machine else ""),
-                           # numeric pseudo-label consumed (and stripped) by
-                           # the collector for kepler_process_cpu_seconds_total
-                           "_cpu_total_seconds": f"{p.cpu_total_time:.6f}"}
-                for pid, p in res.processes().running.items()
-            },
-            "containers": {
-                c.id: {"container_name": c.name, "runtime": c.runtime.value,
-                       "pod_id": c.pod_id or ""}
-                for c in res.containers().running.values()
-            },
-            "virtual_machines": {
-                v.id: {"vm_name": v.name, "hypervisor": v.hypervisor.value}
-                for v in res.virtual_machines().running.values()
-            },
-            "pods": {
-                p.id: {"pod_name": p.name, "namespace": p.namespace}
-                for p in res.pods().running.values()
-            },
-        }
-        return meta
+        if kind == "processes":
+            running, f = res.processes().running, self._process_meta
+        elif kind == "containers":
+            running, f = res.containers().running, self._container_meta
+        elif kind == "virtual_machines":
+            running, f = res.virtual_machines().running, self._vm_meta
+        else:
+            # pods' running dict is rebuilt every tick — not cacheable by
+            # identity, and small; always materialize
+            return tuple(self._pod_meta(p)
+                         for p in res.pods().running.values())
+        gen = getattr(res, "meta_gen", None)
+        if gen is None:
+            return tuple(f(o) for o in running.values())
+        key = (gen, id(running), len(running))
+        cached = self._meta_rows_cache.get(kind)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        rows = tuple(f(o) for o in running.values())
+        self._meta_rows_cache[kind] = (key, rows)
+        return rows
 
     def _accumulate_workloads(self, batch: FeatureBatch, result, w: int
                               ) -> dict[str, WorkloadTable]:
         energy_delta_wz = np.asarray(result.workloads.energy_uj,
                                      np.float64)[:w]
         power_wz = np.asarray(result.workloads.power_uw, np.float64)[:w]
-        meta_by_kind = self._workload_meta()
         tables: dict[str, WorkloadTable] = {}
         kinds = batch.kinds
-        for kind_name, kind_code in zip(_KINDS, _KIND_CODES):
-            idx = np.nonzero(kinds == kind_code)[0]
+        offsets = batch.kind_offsets
+        nz = len(self._zone_names)
+        for k, (kind_name, kind_code) in enumerate(zip(_KINDS, _KIND_CODES)):
+            if offsets is not None:
+                sl = slice(offsets[k], offsets[k + 1])
+                ids = tuple(batch.ids[sl])
+                idx: slice | np.ndarray = sl
+            else:
+                nz_idx = np.nonzero(kinds == kind_code)[0]
+                ids = tuple(batch.ids[i] for i in nz_idx)
+                idx = nz_idx
             store = self._cumulative[kind_name]
-            ids = [batch.ids[i] for i in idx]
-            kind_meta = meta_by_kind[kind_name]
-            nz = len(self._zone_names)
             n = len(ids)
-            energy_rows = np.zeros((n, nz))
             power_rows = power_wz[idx] if n else np.zeros((0, nz))
-            # gather prev cumulative, one vectorized add, scatter views
-            # back (rows alias energy_rows — safe: snapshot arrays are
-            # never mutated after publication, each refresh builds new).
             # PRECONDITION: ids within a kind are unique (they come from
             # dict-keyed informer views) — a duplicate would silently drop
-            # one delta in the last-writer-wins scatter below, so fail
-            # loudly (not assert: -O must not change energy accounting)
-            if len(set(ids)) != len(ids):
-                raise ValueError(
-                    f"duplicate {kind_name} ids in feature batch; "
-                    "cumulative energy accounting requires unique ids")
-            get = store.get
-            for row, wid in enumerate(ids):
-                acc = get(wid)
-                if acc is not None:
-                    energy_rows[row] = acc
+            # one delta in the last-writer-wins scatter inside the store,
+            # so fail loudly (not assert: -O must not change accounting).
+            # The check is O(1) when the id tuple is unchanged (cached).
             if n:
-                energy_rows += energy_delta_wz[idx]
-            for row, wid in enumerate(ids):
-                store[wid] = energy_rows[row]
-            meta_rows = tuple(kind_meta.get(wid, {}) for wid in ids)
-            self._meta_cache[kind_name].update(zip(ids, meta_rows))
-            # terminated ids stay in the store until _handle_terminated has
-            # captured their final cumulative values
+                energy_rows = store.accumulate(ids, energy_delta_wz[idx])
+            else:
+                energy_rows = np.zeros((0, nz))
+            meta_rows = self._meta_rows(kind_name)
+            if len(meta_rows) != n:
+                raise ValueError(
+                    f"{kind_name}: feature batch has {n} rows but the "
+                    f"informer view has {len(meta_rows)} — views and "
+                    "batch must be built from the same refresh")
+            seconds = None
+            if kind_name == "processes" and batch.cpu_totals is not None:
+                seconds = np.asarray(batch.cpu_totals[idx], np.float64)
+            # terminated ids stay in the store until _handle_terminated
+            # has captured their final cumulative values
             tables[kind_name] = WorkloadTable(
-                ids=tuple(ids),
+                ids=ids,
                 meta=meta_rows,
                 energy_uj=energy_rows,
                 power_uw=power_rows,
+                seconds=seconds,
             )
         return tables
 
     def _terminated_views(self) -> dict[str, WorkloadTable]:
-        """Final cumulative usage of workloads that vanished this refresh."""
+        """Final cumulative usage of workloads that vanished this refresh.
+        Labels come straight from the informer's terminated objects (their
+        cached meta survives termination)."""
         res = self._resources
         views: dict[str, WorkloadTable] = {}
-        terminated_ids = {
-            "processes": [str(pid) for pid in res.processes().terminated],
-            "containers": list(res.containers().terminated),
-            "virtual_machines": list(res.virtual_machines().terminated),
-            "pods": list(res.pods().terminated),
+        term = {
+            "processes": [(str(pid), p, self._process_meta)
+                          for pid, p in res.processes().terminated.items()],
+            "containers": [(cid, c, self._container_meta)
+                           for cid, c in res.containers()
+                           .terminated.items()],
+            "virtual_machines": [(vid, v, self._vm_meta)
+                                 for vid, v in res.virtual_machines()
+                                 .terminated.items()],
+            "pods": [(pid_, p, self._pod_meta)
+                     for pid_, p in res.pods().terminated.items()],
         }
         nz = len(self._zone_names)
         for kind in _KINDS:
             store = self._cumulative[kind]
-            ids = [wid for wid in terminated_ids[kind] if wid in store]
-            energy = (np.stack([store[wid] for wid in ids])
+            rows = [(wid, obj, f) for wid, obj, f in term[kind]
+                    if wid in store]
+            ids = tuple(wid for wid, _, _ in rows)
+            energy = (np.stack([store.value(wid) for wid in ids])
                       if ids else np.zeros((0, nz)))
-            meta_cache = self._meta_cache[kind]
+            seconds = None
+            if kind == "processes":
+                seconds = np.asarray(
+                    [obj.cpu_total_time for _, obj, _ in rows], np.float64)
             views[kind] = WorkloadTable(
-                ids=tuple(ids),
-                meta=tuple(meta_cache.get(wid, {}) for wid in ids),
+                ids=ids,
+                meta=tuple(f(obj) for _, obj, f in rows),
                 energy_uj=energy,
                 power_uw=np.zeros((len(ids), nz)),
+                seconds=seconds,
             )
         return views
 
@@ -501,7 +614,5 @@ class PowerMonitor:
         # now that final values are tracked, drop them from the stores
         for kind in _KINDS:
             store = self._cumulative[kind]
-            meta_cache = self._meta_cache[kind]
             for wid in views[kind].ids:
-                store.pop(wid, None)
-                meta_cache.pop(wid, None)
+                store.pop(wid)

@@ -72,6 +72,9 @@ class WorkloadTable:
     meta: tuple[Mapping[str, str], ...]  # exporter labels (comm, runtime, …)
     energy_uj: np.ndarray  # [W, Z] cumulative f64
     power_uw: np.ndarray  # [W, Z] f64
+    # process kind only: cumulative CPU seconds per row (the
+    # kepler_process_cpu_seconds_total column); None for other kinds
+    seconds: np.ndarray | None = None
 
     @staticmethod
     def empty(n_zones: int) -> "WorkloadTable":
@@ -97,6 +100,8 @@ class WorkloadTable:
             meta=tuple(dict(m) for m in self.meta),
             energy_uj=self.energy_uj.copy(),
             power_uw=self.power_uw.copy(),
+            seconds=(self.seconds.copy()
+                     if self.seconds is not None else None),
         )
 
 

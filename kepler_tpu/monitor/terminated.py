@@ -35,6 +35,8 @@ class TerminatedTracker:
         self._meta: list[Mapping[str, str]] = []
         self._energy: list[np.ndarray] = []
         self._power: list[np.ndarray] = []
+        self._seconds: list[float] = []  # process kind; 0.0 elsewhere
+        self._has_seconds = False
         self._known: set[str] = set()
 
     def __len__(self) -> int:
@@ -44,6 +46,8 @@ class TerminatedTracker:
         """Add terminated workloads (with their final cumulative usage)."""
         if self._max_size == 0:
             return
+        if table.seconds is not None:
+            self._has_seconds = True
         for i, wid in enumerate(table.ids):
             if wid in self._known:
                 continue
@@ -55,6 +59,8 @@ class TerminatedTracker:
             self._meta.append(table.meta[i])
             self._energy.append(np.asarray(energy, dtype=np.float64))
             self._power.append(np.asarray(table.power_uw[i], np.float64))
+            self._seconds.append(float(table.seconds[i])
+                                 if table.seconds is not None else 0.0)
         self._compact()
 
     def _compact(self) -> None:
@@ -67,6 +73,7 @@ class TerminatedTracker:
         self._meta = [self._meta[i] for i in keep_set]
         self._energy = [self._energy[i] for i in keep_set]
         self._power = [self._power[i] for i in keep_set]
+        self._seconds = [self._seconds[i] for i in keep_set]
         self._known = set(self._ids)
 
     def items(self) -> WorkloadTable:
@@ -77,6 +84,8 @@ class TerminatedTracker:
             meta=tuple(self._meta),
             energy_uj=np.stack(self._energy),
             power_uw=np.stack(self._power),
+            seconds=(np.asarray(self._seconds)
+                     if self._has_seconds else None),
         )
 
     def clear(self) -> None:
@@ -84,4 +93,5 @@ class TerminatedTracker:
         self._meta.clear()
         self._energy.clear()
         self._power.clear()
+        self._seconds.clear()
         self._known.clear()

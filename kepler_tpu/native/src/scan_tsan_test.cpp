@@ -24,10 +24,22 @@
 extern "C" {
 int kepler_native_abi_version();
 int kepler_scan_procs(const char* procfs, int32_t* pids, double* cpu_seconds,
-                      int32_t cap);
+                      char* comms, int32_t cap);
 int kepler_read_stat_totals(const char* procfs, double* active,
                             double* total);
 int kepler_read_counter_files(const char* paths, int32_t n, uint64_t* out);
+int kepler_read_files(const char* paths, int32_t n, char* out,
+                      int32_t per_cap, int32_t* sizes);
+int kepler_read_links(const char* paths, int32_t n, char* out,
+                      int32_t per_cap, int32_t* sizes);
+int kepler_fmt_double(double v, char* out);
+int64_t kepler_render_samples(const char* name, int32_t name_len,
+                              const char* prefix_blob,
+                              const int64_t* prefix_off, int32_t n,
+                              const char* ztail_blob,
+                              const int32_t* ztail_off, int32_t nz,
+                              const double* values, double div,
+                              int32_t flags, char* out, int64_t cap);
 }
 
 namespace {
@@ -84,11 +96,21 @@ int main() {
     threads.emplace_back([&, t] {
       int32_t pids[256];
       double cpu[256];
+      char comms[256 * 32];
       double active = 0, total = 0;
       uint64_t counters[2];
+      char files_out[2 * 128];
+      int32_t files_sizes[2];
+      char fmt_out[48];
+      char render_out[512];
+      const char* prefix_blob = "{pid=\"1\"}{pid=\"2\"}";
+      const int64_t prefix_off[3] = {0, 9, 18};
+      const char* ztail_blob = ",zone=\"pkg\"} ";
+      const int32_t ztail_off[2] = {0, 13};
+      const double render_vals[2] = {1.5, 2.5e8};
       for (int i = 0; i < 200; ++i) {
         // pid dirs are never mutated: the scan count is a hard invariant
-        int n = kepler_scan_procs(proc.c_str(), pids, cpu, 256);
+        int n = kepler_scan_procs(proc.c_str(), pids, cpu, comms, 256);
         if (n != 64) failures.fetch_add(1);
         // stat/counter files race a truncating writer below — transient
         // read errors are the mid-write window (callers skip it); what
@@ -96,6 +118,13 @@ int main() {
         (void)kepler_read_stat_totals(proc.c_str(), &active, &total);
         int ok = kepler_read_counter_files(blob.c_str(), 2, counters);
         if (ok < 0 || ok > 2) failures.fetch_add(1);
+        ok = kepler_read_files(blob.c_str(), 2, files_out, 128, files_sizes);
+        if (ok < 0 || ok > 2) failures.fetch_add(1);
+        if (kepler_fmt_double(1234.5 + i, fmt_out) <= 0) failures.fetch_add(1);
+        int64_t r = kepler_render_samples(
+            "kepler_x", 8, prefix_blob, prefix_off, 2, ztail_blob, ztail_off,
+            1, render_vals, 1.0, 0, render_out, sizeof(render_out));
+        if (r <= 0) failures.fetch_add(1);
         if (t == 0 && i % 10 == 0) {
           // one writer mutates the tree while others scan (live /proc)
           write_file(counter_a, std::to_string(1000 + i) + "\n");

@@ -74,6 +74,16 @@ def _name_from_cmdline(cmdline: list[str]) -> str:
     return ""
 
 
+def container_name(env: dict[str, str], cmdline: list[str],
+                   container_id: str) -> str:
+    """Resolve a container's display name: env beats cmdline beats the
+    id-prefix fallback (reference container.go:144-190)."""
+    name = _name_from_env(env)
+    if not name:
+        name = _name_from_cmdline(cmdline)
+    return name or container_id[:12]
+
+
 def container_info_from_proc(proc: ProcInfo) -> Container | None:
     """Detect containment; None when the process isn't in a container."""
     try:
@@ -85,16 +95,16 @@ def container_info_from_proc(proc: ProcInfo) -> Container | None:
     runtime, container_id = container_info_from_cgroup_paths(paths)
     if not container_id:
         return None
-    name = ""
+    env: dict[str, str] = {}
+    cmdline: list[str] = []
     try:
-        name = _name_from_env(proc.environ())
+        env = proc.environ()
     except OSError:
         pass
-    if not name:
-        try:
-            name = _name_from_cmdline(proc.cmdline())
-        except OSError:
-            pass
-    if not name:
-        name = container_id[:12]
-    return Container(id=container_id, name=name, runtime=runtime)
+    try:
+        cmdline = proc.cmdline()
+    except OSError:
+        pass
+    return Container(id=container_id,
+                     name=container_name(env, cmdline, container_id),
+                     runtime=runtime)

@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import logging
 
+import numpy as np
+
 from kepler_tpu import native
 from kepler_tpu.resource.procfs import ProcFSInfo, ProcFSReader
 
@@ -40,23 +42,37 @@ class FastProcFSReader(ProcFSReader):
         self._scanner = scanner
 
     def all_procs(self) -> list[FastProcInfo]:
-        pids, cpu = self._scanner.scan_procs(self._procfs)
+        pids, cpu, _ = self._scanner.scan_procs(self._procfs,
+                                                want_comms=False)
         return [
             FastProcInfo(self._procfs, int(p), float(c))
             for p, c in zip(pids, cpu)
         ]
 
-    def scan_arrays(self) -> tuple[list[int], list[float]]:
-        """→ (pids, cpu_seconds) as plain lists — the allocation-free tick
-        path: the informer updates its cache straight from these and only
-        materializes a ProcInfo for NEW pids (classification) or procs
-        whose comm needs re-reading. One C call, zero per-proc objects."""
-        pids, cpu = self._scanner.scan_procs(self._procfs)
-        return pids.tolist(), cpu.tolist()
+    def scan_arrays(self) -> "tuple[np.ndarray, np.ndarray, np.ndarray]":
+        """→ (pids int32, cpu_seconds f64, comms S32) numpy arrays — the
+        allocation-free tick path: the informer updates its cache straight
+        from these and only materializes a ProcInfo for NEW pids
+        (classification). One C call, zero per-proc objects; comm comes
+        from the stat line (the same field /proc/<pid>/comm serves), so no
+        per-PID comm reads happen at all."""
+        return self._scanner.scan_procs(self._procfs)
 
     def proc_info(self, pid: int) -> ProcFSInfo:
         """Cold-path reader for one PID (classification/comm/exe)."""
         return ProcFSInfo(self._procfs, pid)
+
+    def read_proc_files(self, relpaths: list[str], per_cap: int = 16384
+                        ) -> list[bytes | None]:
+        """Batch-read ``<procfs>/<relpath>`` files in one threaded C call
+        (first-sight classification bursts stay native)."""
+        paths = [f"{self._procfs}/{rel}" for rel in relpaths]
+        return self._scanner.read_files(paths, per_cap=per_cap)
+
+    def read_proc_links(self, relpaths: list[str]) -> list[str | None]:
+        """Batch-readlink ``<procfs>/<relpath>`` (e.g. ``<pid>/exe``)."""
+        paths = [f"{self._procfs}/{rel}" for rel in relpaths]
+        return self._scanner.read_links(paths)
 
     def _read_stat_totals(self) -> tuple[float, float]:
         return self._scanner.stat_totals(self._procfs)

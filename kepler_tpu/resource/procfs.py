@@ -20,6 +20,32 @@ from typing import Iterable, Protocol
 USER_HZ = 100.0
 
 
+def parse_cgroup_text(text: str) -> list[str]:
+    """Cgroup paths from /proc/<pid>/cgroup content (v1 and v2 lines)."""
+    paths = []
+    for line in text.splitlines():
+        # format: hierarchy-ID:controller-list:cgroup-path
+        parts = line.split(":", 2)
+        if len(parts) == 3 and parts[2]:
+            paths.append(parts[2])
+    return paths
+
+
+def parse_environ_bytes(raw: bytes) -> dict[str, str]:
+    """Env dict from /proc/<pid>/environ content (NUL-separated)."""
+    env: dict[str, str] = {}
+    for entry in raw.decode("utf-8", "replace").split("\0"):
+        if "=" in entry:
+            k, _, v = entry.partition("=")
+            env[k] = v
+    return env
+
+
+def parse_cmdline_bytes(raw: bytes) -> list[str]:
+    """Argv from /proc/<pid>/cmdline content (NUL-separated)."""
+    return [a for a in raw.decode("utf-8", "replace").split("\0") if a]
+
+
 class ProcInfo(Protocol):
     """Per-process accessor (reference procInfo, procfs_reader.go:18-26)."""
 
@@ -62,29 +88,20 @@ class ProcFSInfo:
 
     def cgroups(self) -> list[str]:
         """Cgroup paths from /proc/<pid>/cgroup (v1 and v2 lines)."""
-        paths = []
-        for line in self._read("cgroup").splitlines():
-            # format: hierarchy-ID:controller-list:cgroup-path
-            parts = line.split(":", 2)
-            if len(parts) == 3 and parts[2]:
-                paths.append(parts[2])
-        return paths
+        return parse_cgroup_text(self._read("cgroup"))
 
     def environ(self) -> dict[str, str]:
-        env = {}
         try:
-            raw = self._read("environ")
+            with open(os.path.join(self._dir, "environ"), "rb") as f:
+                raw = f.read()
         except OSError:
-            return env
-        for entry in raw.split("\0"):
-            if "=" in entry:
-                k, _, v = entry.partition("=")
-                env[k] = v
-        return env
+            return {}
+        return parse_environ_bytes(raw)
 
     def cmdline(self) -> list[str]:
-        raw = self._read("cmdline")
-        return [a for a in raw.split("\0") if a]
+        with open(os.path.join(self._dir, "cmdline"), "rb") as f:
+            raw = f.read()
+        return parse_cmdline_bytes(raw)
 
     def cpu_time(self) -> float:
         """(utime + stime) / USER_HZ seconds from /proc/<pid>/stat."""

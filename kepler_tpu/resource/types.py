@@ -36,6 +36,9 @@ class Pod:
     namespace: str = ""
     cpu_total_time: float = 0.0
     cpu_time_delta: float = 0.0
+    # exporter label dict, built lazily and treated as immutable; set to
+    # None whenever an identity field changes so label caches re-render
+    meta_cache: dict | None = None
 
     def clone(self) -> "Pod":
         return replace(self)
@@ -49,6 +52,7 @@ class Container:
     pod_id: str | None = None
     cpu_total_time: float = 0.0
     cpu_time_delta: float = 0.0
+    meta_cache: dict | None = None
 
     def clone(self) -> "Container":
         return replace(self)
@@ -61,6 +65,7 @@ class VirtualMachine:
     hypervisor: Hypervisor = Hypervisor.UNKNOWN
     cpu_total_time: float = 0.0
     cpu_time_delta: float = 0.0
+    meta_cache: dict | None = None
 
     def clone(self) -> "VirtualMachine":
         return replace(self)
@@ -79,6 +84,10 @@ class Process:
     # classification already ran (container/VM/regular verdict is cached;
     # reference caches via Process.Type in populateProcessFields)
     classified: bool = False
+    # raw comm bytes from the batched stat scan (cheap change detection
+    # without decoding 10k strings per tick)
+    comm_raw: bytes = b""
+    meta_cache: dict | None = None
 
     def clone(self) -> "Process":
         c = replace(self, cmdline=list(self.cmdline))

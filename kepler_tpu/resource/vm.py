@@ -36,11 +36,9 @@ def _guest_name(name_arg: str) -> str:
     return ""
 
 
-def vm_info_from_proc(proc: ProcInfo) -> VirtualMachine | None:
-    try:
-        cmdline = proc.cmdline()
-    except OSError:
-        return None
+def vm_info_from_cmdline(cmdline: list[str]) -> VirtualMachine | None:
+    """QEMU/KVM detection from an already-read cmdline (the batched
+    first-sight path hands contents over; no file IO here)."""
     if not cmdline:
         return None
     joined = " ".join(cmdline)
@@ -55,3 +53,11 @@ def vm_info_from_proc(proc: ProcInfo) -> VirtualMachine | None:
             vm_id = hashlib.sha256(joined.encode()).hexdigest()[:16]
     return VirtualMachine(id=vm_id, name=name or vm_id,
                           hypervisor=Hypervisor.KVM)
+
+
+def vm_info_from_proc(proc: ProcInfo) -> VirtualMachine | None:
+    try:
+        cmdline = proc.cmdline()
+    except OSError:
+        return None
+    return vm_info_from_cmdline(cmdline)

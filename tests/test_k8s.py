@@ -433,6 +433,39 @@ class TestWatchBackoff:
         resync_waits = [d for d in ctx.delays if d == 5.0]
         assert resync_waits, f"expected a clean resync wait in {ctx.delays}"
 
+    def test_bookmark_does_not_reset_streak(self):
+        """A degraded API server serving bookmark-then-410 every cycle
+        must still escalate the backoff — BOOKMARK applies nothing, so it
+        is not 'progress' (else every agent re-lists in a tight loop)."""
+        import random
+
+        class BookmarkFlapClient(RejectingClient):
+            def get(self, path, timeout=30.0):
+                if "watch=true" not in path:
+                    return super().get(path, timeout)
+                self.paths.append(path)
+                frames = (json.dumps({"type": "BOOKMARK", "object": {
+                    "metadata": {"resourceVersion": str(self.rv)}}})
+                    + "\n"
+                    + json.dumps({"type": "ERROR", "object": {
+                        "kind": "Status", "code": 410,
+                        "reason": "Expired"}}) + "\n")
+                return io.BytesIO(frames.encode())
+
+        client = BookmarkFlapClient()
+        inf = PodInformer("node-1", client=client, resync_interval=300.0,
+                          backoff_base=1.0, backoff_cap=30.0,
+                          rng=random.Random(5))
+        inf.init()
+        ctx = RecordingCtx(5)
+        inf.run(ctx)
+        # delays must escalate like the pure-ERROR case: each within the
+        # growing jitter envelope, NOT repeated fast re-lists
+        for i, delay in enumerate(ctx.delays):
+            envelope = min(1.0 * 2.0 ** (i + 1), 30.0)
+            assert 0.5 * envelope <= delay < 1.5 * envelope, \
+                f"delay[{i}]={delay}: bookmark reset the backoff streak"
+
 
 class TestResourceLayerIntegration:
     def test_informer_feeds_pod_lookup(self):

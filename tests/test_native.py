@@ -56,7 +56,7 @@ def fake_proc(tmp_path):
 
 
 def test_scan_procs_matches_python(scanner, fake_proc):
-    pids, cpu = scanner.scan_procs(str(fake_proc))
+    pids, cpu, comms = scanner.scan_procs(str(fake_proc))
     got = dict(zip(pids.tolist(), cpu.tolist()))
     ref = ProcFSReader(str(fake_proc))
     want = {p.pid(): p.cpu_time() for p in ref.all_procs()}
@@ -67,13 +67,13 @@ def test_scan_procs_matches_python(scanner, fake_proc):
 
 
 def test_scan_procs_grows_past_cap(scanner, fake_proc):
-    pids, cpu = scanner.scan_procs(str(fake_proc), cap=1)
+    pids, cpu, _ = scanner.scan_procs(str(fake_proc), cap=1)
     assert len(pids) == 3 and len(cpu) == 3
 
 
 def test_scan_skips_vanished_pid(scanner, fake_proc):
     (fake_proc / "7777").mkdir()  # PID dir with no stat (mid-exit)
-    pids, _ = scanner.scan_procs(str(fake_proc))
+    pids, _, _ = scanner.scan_procs(str(fake_proc))
     assert 7777 not in pids.tolist()
 
 
@@ -85,7 +85,7 @@ def test_scan_skips_corrupt_stat_like_python(scanner, fake_proc):
     head = "8888 (evil) S 1 1 1 0 -1 4194560 100 0 0 0"
     tail = "NaNN garbage 0 0 20 0 1 0 100 0 0 " + " ".join(["0"] * 29)
     (d / "stat").write_text(head + " " + tail)
-    pids, _ = scanner.scan_procs(str(fake_proc))
+    pids, _, _ = scanner.scan_procs(str(fake_proc))
     assert 8888 not in pids.tolist()
     ref = ProcFSReader(str(fake_proc))
     got_py = []
@@ -174,6 +174,75 @@ def test_informer_with_fast_reader(scanner, fake_proc):
     write_stat(fake_proc, 1, "init", 600, 250)  # +1s utime
     informer.refresh()
     assert informer.processes().running[1].cpu_time_delta == pytest.approx(1.0)
+
+
+def test_scan_comm_updates_on_exec(scanner, fake_proc):
+    """comm comes from the batched stat parse; an exec'd process (new comm,
+    nonzero delta) must refresh its label and invalidate the meta cache."""
+    from kepler_tpu.resource import ResourceInformer
+
+    informer = ResourceInformer(
+        reader=FastProcFSReader(scanner, str(fake_proc)))
+    informer.refresh()
+    p = informer.processes().running[1]
+    assert p.comm == "init"
+    p.meta_cache = {"stale": "yes"}
+    write_stat(fake_proc, 1, "renamed", 700, 250)
+    informer.refresh()
+    assert p.comm == "renamed"
+    assert p.meta_cache is None  # label caches must re-render
+
+
+def test_batched_classification_matches_python(scanner, tmp_path):
+    """First-sight classification through the batched native reads must
+    produce the same container verdicts as the pure-Python reader."""
+    from kepler_tpu.resource import ResourceInformer
+
+    proc = tmp_path / "proc"
+    proc.mkdir()
+    (proc / "stat").write_text("cpu  100 20 300 4000 500 60 70 0 0 0\n")
+    cid = "f" * 64
+    write_stat(proc, 10, "app", 100, 50)
+    (proc / "10" / "cgroup").write_text(
+        f"0::/system.slice/docker-{cid}.scope\n")
+    (proc / "10" / "environ").write_bytes(b"CONTAINER_NAME=webapp\0")
+    write_stat(proc, 11, "qemu", 10, 5)
+    (proc / "11" / "cmdline").write_bytes(
+        b"/usr/bin/qemu-system-x86_64\0-name\0guest=vm1\0")
+
+    for use_native in (True, False):
+        informer = ResourceInformer(
+            reader=make_proc_reader(str(proc), use_native=use_native))
+        informer.refresh()
+        procs = informer.processes().running
+        assert procs[10].container is not None, f"native={use_native}"
+        assert procs[10].container.id == cid
+        assert procs[10].container.name == "webapp"
+        assert procs[11].virtual_machine is not None
+        assert procs[11].virtual_machine.name == "vm1"
+
+
+def test_truncated_environ_reread(scanner, tmp_path):
+    """An environ larger than the batched-read slot must be re-read
+    unbatched so container_name never depends on which reader ran."""
+    from kepler_tpu.resource import ResourceInformer
+    from kepler_tpu.resource.informer import ResourceInformer as RI
+
+    proc = tmp_path / "proc"
+    proc.mkdir()
+    (proc / "stat").write_text("cpu  100 20 300 4000 500 60 70 0 0 0\n")
+    cid = "a" * 64
+    write_stat(proc, 20, "big", 100, 50)
+    (proc / "20" / "cgroup").write_text(
+        f"0::/system.slice/docker-{cid}.scope\n")
+    filler = b"".join(b"SVC_%d=x%d\0" % (i, i) for i in range(3000))
+    assert len(filler) > RI._BATCH_FILE_CAP  # forces slot truncation
+    (proc / "20" / "environ").write_bytes(
+        filler + b"CONTAINER_NAME=at-the-end\0")
+    informer = ResourceInformer(
+        reader=make_proc_reader(str(proc), use_native=True))
+    informer.refresh()
+    assert informer.processes().running[20].container.name == "at-the-end"
 
 
 class TestBatchedZoneReads:
@@ -269,7 +338,7 @@ class TestNativeConcurrency:
         def worker():
             try:
                 for _ in range(20):
-                    pids, cpu = scanner.scan_procs(str(fake_proc))
+                    pids, cpu, _ = scanner.scan_procs(str(fake_proc))
                     results.append(dict(zip(pids.tolist(), cpu.tolist())))
             except Exception as err:  # pragma: no cover
                 errors.append(err)
@@ -296,7 +365,7 @@ class TestNativeConcurrency:
         def scan_loop():
             while not stop.is_set():
                 try:
-                    pids, _ = scanner.scan_procs(str(fake_proc))
+                    pids, _, _ = scanner.scan_procs(str(fake_proc))
                     assert len(pids) == 3
                 except Exception as err:  # pragma: no cover
                     errors.append(err)
