@@ -29,7 +29,7 @@ import numpy as np
 
 log = logging.getLogger("kepler.train")
 
-FAMILIES = ("linear", "mlp", "moe", "deep")
+FAMILIES = ("linear", "mlp", "moe", "deep", "temporal")
 
 
 def load_windows(data_dir: str):
@@ -56,8 +56,15 @@ def load_windows(data_dir: str):
     nz = len(zone_names)
     w_max = max(r["cpu_deltas"].shape[1] for r in raw)
 
+    # temporal dumps carry per-workload history windows; T can vary
+    # across files if aggregator.historyWindow changed — right-pad to the
+    # longest (the temporal model pools the last VALID position)
+    has_hist = [("feat_hist" in r) for r in raw]
+    t_max = max((r["feat_hist"].shape[2] for r, h in zip(raw, has_hist)
+                 if h), default=0)
+
     cols: dict[str, list[np.ndarray]] = {}
-    for r in raw:
+    for r, hist in zip(raw, has_hist):
         rows, w = r["cpu_deltas"].shape
         targets = np.zeros((rows, w_max, nz), np.float32)
         lvalid = np.zeros((rows, w_max, nz), bool)
@@ -76,6 +83,18 @@ def load_windows(data_dir: str):
         cols.setdefault("label_valid", []).append(lvalid)
         for k in ("node_cpu_delta", "usage_ratio", "dt_s"):
             cols.setdefault(k, []).append(r[k])
+        if t_max:
+            f_dim = (r["feat_hist"].shape[3] if hist
+                     else next(x["feat_hist"].shape[3]
+                               for x, h in zip(raw, has_hist) if h))
+            fh = np.zeros((rows, w_max, t_max, f_dim), np.float32)
+            tv = np.zeros((rows, w_max, t_max), bool)
+            if hist:
+                _, wh, th, _ = r["feat_hist"].shape
+                fh[:, :wh, :th] = r["feat_hist"]
+                tv[:, :wh, :th] = r["t_valid"]
+            cols.setdefault("feat_hist", []).append(fh)
+            cols.setdefault("t_valid", []).append(tv)
     data = {k: np.concatenate(v, axis=0) for k, v in cols.items()}
     data["zone_names"] = zone_names
     return data, files
@@ -108,6 +127,7 @@ def main(argv: Sequence[str] | None = None) -> int:
     from kepler_tpu.models.train import (
         create_train_state,
         make_optimizer,
+        make_temporal_train_step,
         make_train_step,
     )
 
@@ -118,21 +138,45 @@ def main(argv: Sequence[str] | None = None) -> int:
              "zones %s, %d labelled workloads", len(files), b, w,
              data["zone_names"], int(data["workload_valid"].sum()))
 
-    feats = build_features(
-        jnp.asarray(data["cpu_deltas"]),
-        jnp.asarray(data["workload_valid"]),
-        jnp.asarray(data["node_cpu_delta"]),
-        jnp.asarray(data["usage_ratio"]),
-        jnp.asarray(data["dt_s"]),
-    )
     valid = jnp.asarray(data["workload_valid"])
     targets = jnp.asarray(data["target_watts"], jnp.float32)
     label_valid = jnp.asarray(data["label_valid"])
-
-    params = initializer(args.model)(jax.random.PRNGKey(args.seed), n_zones)
     optimizer = make_optimizer(args.lr)
-    state = create_train_state(params, optimizer)
-    step_fn = make_train_step(predictor(args.model), optimizer)
+
+    if args.model == "temporal":
+        if "feat_hist" not in data:
+            log.error(
+                "--model temporal needs history windows in the dumps — "
+                "run the aggregator with model=temporal AND a "
+                "trainingDumpDir so ratio nodes' feature histories are "
+                "captured (fleet/aggregator.py:_dump_training_window)")
+            return 2
+        feat_hist = jnp.asarray(data["feat_hist"])
+        t_valid = jnp.asarray(data["t_valid"])
+        t_max = int(feat_hist.shape[2])
+        params = initializer("temporal")(
+            jax.random.PRNGKey(args.seed), n_zones,
+            t_max=max(t_max, 128))
+        state = create_train_state(params, optimizer)
+        temporal_step = make_temporal_train_step(optimizer)
+
+        def step_fn(state, feats_, valid_, targets_, label_valid_):
+            return temporal_step(state, feat_hist, valid_, t_valid,
+                                 targets_, label_valid_)
+
+        feats = None
+    else:
+        feats = build_features(
+            jnp.asarray(data["cpu_deltas"]),
+            jnp.asarray(data["workload_valid"]),
+            jnp.asarray(data["node_cpu_delta"]),
+            jnp.asarray(data["usage_ratio"]),
+            jnp.asarray(data["dt_s"]),
+        )
+        params = initializer(args.model)(jax.random.PRNGKey(args.seed),
+                                         n_zones)
+        state = create_train_state(params, optimizer)
+        step_fn = make_train_step(predictor(args.model), optimizer)
 
     ck = None
     if args.ckpt_dir:

@@ -174,10 +174,12 @@ def temporal_step_fn(
     if remat:
         forward = jax.checkpoint(forward)
 
-    def train_step(state, feat_hist, workload_valid, t_valid, target_watts):
+    def train_step(state, feat_hist, workload_valid, t_valid, target_watts,
+                   label_valid=None):
         def loss_fn(params):
             pred = forward(params, feat_hist, workload_valid, t_valid)
-            return masked_mse(pred, target_watts, workload_valid)
+            return masked_mse(pred, target_watts, workload_valid,
+                              label_valid)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, opt_state = optimizer.update(grads, state.opt_state,

@@ -59,69 +59,9 @@ _KIND_CODES = (
 )
 
 
-class _CumStore:
-    """Cumulative per-workload energy accumulators for one kind.
-
-    Values live in one dense f64 ``[cap, Z]`` array; ids map to rows that
-    persist for the workload's lifetime (freed on termination). The
-    per-tick update is a single gather-add-scatter over a row-index
-    array cached while the id tuple is unchanged — no per-row Python.
-    """
-
-    def __init__(self, n_zones: int) -> None:
-        self._z = n_zones
-        self.arr = np.zeros((64, n_zones))
-        self.rows: dict[str, int] = {}
-        self._free: list[int] = list(range(63, -1, -1))
-        self._cached: tuple[tuple[str, ...], np.ndarray] | None = None
-
-    def __contains__(self, wid: str) -> bool:
-        return wid in self.rows
-
-    def row_indices(self, ids: tuple[str, ...]) -> np.ndarray:
-        cached = self._cached
-        if cached is not None and cached[0] == ids:
-            return cached[1]
-        if len(set(ids)) != len(ids):
-            # a duplicate id would collapse onto one row and the scatter
-            # in accumulate() would drop a delta — fail loudly (not
-            # assert: -O must not change energy accounting)
-            raise ValueError(
-                "duplicate workload ids in feature batch; cumulative "
-                "energy accounting requires unique ids per kind")
-        idx = np.empty(len(ids), np.intp)
-        get = self.rows.get
-        for j, wid in enumerate(ids):
-            r = get(wid)
-            if r is None:
-                if not self._free:
-                    grow = len(self.arr)
-                    self.arr = np.vstack(
-                        [self.arr, np.zeros((grow, self._z))])
-                    self._free = list(range(2 * grow - 1, grow - 1, -1))
-                r = self._free.pop()
-                self.arr[r] = 0.0
-                self.rows[wid] = r
-            idx[j] = r
-        self._cached = (ids, idx)
-        return idx
-
-    def accumulate(self, ids: tuple[str, ...],
-                   deltas: np.ndarray) -> np.ndarray:
-        """arr[ids] += deltas; → the new cumulative values [n, Z]."""
-        idx = self.row_indices(ids)
-        vals = self.arr[idx] + deltas
-        self.arr[idx] = vals
-        return vals
-
-    def value(self, wid: str) -> np.ndarray:
-        return self.arr[self.rows[wid]]
-
-    def pop(self, wid: str) -> None:
-        r = self.rows.pop(wid, None)
-        if r is not None:
-            self._free.append(r)
-            self._cached = None
+# cumulative per-workload accumulators (shared with the fleet
+# aggregator's per-node totals — one row-store implementation)
+from kepler_tpu.utils.rowstore import RowStore as _CumStore  # noqa: E402
 
 
 @dataclass(frozen=True)

@@ -66,6 +66,8 @@ def assemble_fleet_batch(
     n_zones: int,
     node_bucket: int = 8,
     workload_bucket: int = 256,
+    zone_deltas_mat: np.ndarray | None = None,
+    zone_valid_mat: np.ndarray | None = None,
 ) -> FleetBatch:
     """Pad/mask ragged node reports into one rectangular batch.
 
@@ -73,46 +75,85 @@ def assemble_fleet_batch(
     keeps its row with those zones masked. Shapes are
     ``[pad(N), pad(max_w)]`` so the jit cache sees O(buckets²) shapes, not
     O(fleet compositions).
+
+    ``zone_deltas_mat`` / ``zone_valid_mat``: optional pre-aligned
+    ``[n_real, n_zones]`` matrices (the aggregator's grouped zone-align
+    produces them directly); when given, the per-report zone arrays are
+    not touched.
     """
     n_real = len(reports)
     n = pad_to_bucket(max(n_real, 1), node_bucket)
     max_w = max((len(r.cpu_deltas) for r in reports), default=1)
     w = pad_to_bucket(max_w, workload_bucket)
 
-    zone_deltas = np.zeros((n, n_zones), np.float32)
-    zone_valid = np.zeros((n, n_zones), bool)
-    usage = np.zeros(n, np.float32)
     cpu = np.zeros((n, w), np.float32)
     valid = np.zeros((n, w), bool)
-    node_delta = np.zeros(n, np.float32)
-    dt = np.zeros(n, np.float32)
-    mode = np.zeros(n, np.int32)
-    names: list[str] = []
-    counts: list[int] = []
-    ids: list[list[str]] = []
+    if n_real:
+        zone_deltas = np.zeros((n, n_zones), np.float32)
+        zone_valid = np.zeros((n, n_zones), bool)
+        if zone_deltas_mat is not None:
+            if zone_deltas_mat.shape != (n_real, n_zones):
+                raise ValueError(
+                    f"zone matrix shape {zone_deltas_mat.shape}, expected "
+                    f"({n_real}, {n_zones})")
+            zone_deltas[:n_real] = zone_deltas_mat
+            zone_valid[:n_real] = zone_valid_mat
+        else:
+            for r in reports:
+                zd = np.asarray(r.zone_deltas_uj)
+                if zd.shape != (n_zones,):
+                    raise ValueError(
+                        f"node {r.node_name}: {zd.shape} zones, expected "
+                        f"({n_zones},)")
+                zv = np.asarray(r.zone_valid)
+                if zv.shape != (n_zones,):
+                    raise ValueError(
+                        f"node {r.node_name}: zone_valid shape {zv.shape}, "
+                        f"expected ({n_zones},)")
+            zone_deltas[:n_real] = np.stack(
+                [np.asarray(r.zone_deltas_uj, np.float32)
+                 for r in reports])
+            zone_valid[:n_real] = np.stack(
+                [np.asarray(r.zone_valid, bool) for r in reports])
+        usage = np.zeros(n, np.float32)
+        usage[:n_real] = np.fromiter((r.usage_ratio for r in reports),
+                                     np.float64, n_real)
+        node_delta = np.zeros(n, np.float32)
+        node_delta[:n_real] = np.fromiter(
+            (r.node_cpu_delta for r in reports), np.float64, n_real)
+        dt = np.zeros(n, np.float32)
+        dt[:n_real] = np.fromiter((r.dt_s for r in reports), np.float64,
+                                  n_real)
+        mode = np.zeros(n, np.int32)
+        mode[:n_real] = np.fromiter((r.mode for r in reports), np.int64,
+                                    n_real)
+        # ragged cpu rows → one flat concat + a vectorized 2-D scatter
+        # (the per-row python assignments used to dominate 1k-node windows)
+        lengths = np.fromiter((len(r.cpu_deltas) for r in reports),
+                              np.int64, n_real)
+        total = int(lengths.sum())
+        if total:
+            flat = np.concatenate(
+                [np.asarray(r.cpu_deltas, np.float32) for r in reports])
+            rows = np.repeat(np.arange(n_real), lengths)
+            starts = np.concatenate(([0], np.cumsum(lengths)[:-1]))
+            cols = np.arange(total) - np.repeat(starts, lengths)
+            cpu[rows, cols] = flat
+            valid[rows, cols] = True
+        counts = lengths.tolist()
+        names = [r.node_name for r in reports]
+        # id lists are referenced, not copied — callers treat reports as
+        # immutable once handed over (the wire decoder builds fresh lists)
+        ids = [r.workload_ids for r in reports]
+    else:
+        zone_deltas = np.zeros((n, n_zones), np.float32)
+        zone_valid = np.zeros((n, n_zones), bool)
+        usage = np.zeros(n, np.float32)
+        node_delta = np.zeros(n, np.float32)
+        dt = np.zeros(n, np.float32)
+        mode = np.zeros(n, np.int32)
+        names, counts, ids = [], [], []
 
-    for i, r in enumerate(reports):
-        k = len(r.cpu_deltas)
-        zd = np.asarray(r.zone_deltas_uj, np.float32)
-        zv = np.asarray(r.zone_valid, bool)
-        if zd.shape != (n_zones,):
-            raise ValueError(
-                f"node {r.node_name}: {zd.shape} zones, expected ({n_zones},)")
-        if zv.shape != (n_zones,):
-            raise ValueError(
-                f"node {r.node_name}: zone_valid shape {zv.shape}, "
-                f"expected ({n_zones},)")
-        zone_deltas[i] = zd
-        zone_valid[i] = zv
-        usage[i] = r.usage_ratio
-        cpu[i, :k] = np.asarray(r.cpu_deltas, np.float32)
-        valid[i, :k] = True
-        node_delta[i] = r.node_cpu_delta
-        dt[i] = r.dt_s
-        mode[i] = r.mode
-        names.append(r.node_name)
-        counts.append(k)
-        ids.append(list(r.workload_ids))
     names += [""] * (n - n_real)
     counts += [0] * (n - n_real)
     ids += [[] for _ in range(n - n_real)]

@@ -193,7 +193,7 @@ class TestAggregator:
         agg.aggregate_once()
         assert agg._stats["last_batch_nodes"] == 1
         with agg._results_lock:
-            assert set(agg._results) == {"node-b"}
+            assert set(agg._results.names) == {"node-b"}
 
     def test_rejects_garbage_post(self, server):
         agg = Aggregator(server, model_mode=None)
@@ -224,18 +224,22 @@ class TestAggregator:
                          clock=lambda: now[0], node_bucket=8,
                          workload_bucket=16)
         agg.init()
+        def cum(agg, name):
+            return dict(zip(agg._cum_zones,
+                            agg._cum.value(name).tolist()))
+
         post_report(server, make_report("node-a"))
         agg.aggregate_once()
-        joules_before = dict(agg._cumulative["node-a"])
+        before = cum(agg, "node-a")
         now[0] += 100.0  # node-a silent past stale_after but < retention
         post_report(server, make_report("node-b", seed=1))
         agg.aggregate_once()
-        assert agg._cumulative["node-a"] == joules_before  # kept
+        assert cum(agg, "node-a") == before  # kept
         now[0] += 10.0
         post_report(server, make_report("node-a", seed=2), seq=2)
         agg.aggregate_once()
-        for zone, uj in agg._cumulative["node-a"].items():
-            assert uj >= joules_before[zone]  # accumulated, not reset
+        for zone, uj in cum(agg, "node-a").items():
+            assert uj >= before.get(zone, 0.0)  # accumulated, not reset
 
     def test_stale_after_accepts_duration_string(self, tmp_path):
         from kepler_tpu.config.config import from_file
@@ -332,7 +336,7 @@ class TestAgent:
         result = agg.aggregate_once()
         assert result is not None
         with agg._results_lock:
-            res = agg._results["test-node"]
+            res = agg._results.render_node("test-node")
         assert [w["id"] for w in res["workloads"]] == ["p1", "c1"]
         # workload kinds survive the wire
         assert [w["kind"] for w in res["workloads"]] == [0, 1]
@@ -446,7 +450,7 @@ class TestTemporalAggregator:
         for seq in range(1, 4):
             post_report(server, make_report("node-a", mode=MODE_MODEL),
                         seq=seq)
-        buf = agg._history["node-a"]
+        _, buf = agg._history["node-a"]
         feats, tv = buf.window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, True, True, False]
 
@@ -497,7 +501,7 @@ class TestTemporalAggregator:
         agg.init()
         for _ in range(2):  # LB retry redelivers the same seq
             post_report(server, make_report("node-a", mode=MODE_MODEL), seq=1)
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, False, False, False]
 
     def test_restart_with_same_seq_still_pushes_history(self, server):
@@ -510,7 +514,7 @@ class TestTemporalAggregator:
                     seq=1, run="run-1")
         post_report(server, make_report("node-a", mode=MODE_MODEL),
                     seq=1, run="run-2")  # restarted agent, same seq
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, True, False, False]
 
     def test_superseded_run_straggler_rejected(self, server):
@@ -534,7 +538,7 @@ class TestTemporalAggregator:
         assert agg._reports["node-a"].seq == 1
         # exactly two windows pushed (run-1 seq=7, run-2 seq=1) — the
         # straggler must not have advanced the temporal window
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, True, False, False]
         # and the next report from the LIVE run still lands normally
         post_report(server, make_report("node-a", mode=MODE_MODEL),
@@ -562,7 +566,7 @@ class TestTemporalAggregator:
         post_report(server, make_report("node-a", mode=MODE_MODEL),
                     seq=2, run="run-3")
         assert agg._reports["node-a"].seq == 2
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].sum() == 4  # 3 restarts + seq advance, no straggler
 
     def test_results_node_query_url_decoded(self, server):
@@ -594,7 +598,7 @@ class TestTemporalAggregator:
         post_report(server, make_report("node-a", mode=MODE_MODEL),
                     seq=1, run="run-1")  # late duplicate of the first
         assert agg._reports["node-a"].seq == 3
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, True, True, False]
 
     def test_same_run_duplicate_seq_not_pushed_twice(self, server):
@@ -604,7 +608,7 @@ class TestTemporalAggregator:
         for _ in range(2):  # retransmission within ONE run
             post_report(server, make_report("node-a", mode=MODE_MODEL),
                         seq=1, run="run-1")
-        _, tv = agg._history["node-a"].window_arrays(["node-a-w0"])
+        _, tv = agg._history["node-a"][1].window_arrays(["node-a-w0"])
         assert tv[0].tolist() == [True, False, False, False]
 
     def test_ratio_nodes_accrete_no_history(self, server):

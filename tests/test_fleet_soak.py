@@ -176,7 +176,8 @@ class TestFleetChurnSoak:
             result = agg.aggregate_once()
             assert result is not None
             with agg._results_lock:
-                results = dict(agg._results)
+                results = {name: agg._results.render_node(name)
+                           for name in agg._results.names}
             for name, row in results.items():
                 if name not in agents:
                     continue  # node left mid-window; skip
@@ -248,7 +249,7 @@ class TestTemporalHistorySoak:
             assert result is not None
             assert np.isfinite(
                 np.asarray(result.workload_power_uw)).all()
-            for buf in agg._history.values():
+            for _, buf in agg._history.values():
                 assert buf.window == 4  # ring never grows
         assert "t-5" not in agg._history  # evicted with its node
         assert len(agg._history) == len(agents)
