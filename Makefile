@@ -62,9 +62,14 @@ lint:
 gen-metric-docs: ## regenerate docs/user/metrics.md from the live collectors
 	$(PYTHON) hack/gen_metric_docs.py
 
+.PHONY: gen-config-docs
+gen-config-docs: ## regenerate docs/user/configuration.md from the Config schema
+	$(PYTHON) hack/gen_config_docs.py
+
 .PHONY: check-metric-docs
 check-metric-docs:
 	$(PYTHON) hack/gen_metric_docs.py --check
+	$(PYTHON) hack/gen_config_docs.py --check
 
 # -- run ----------------------------------------------------------------------
 .PHONY: run

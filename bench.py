@@ -321,7 +321,20 @@ def main() -> None:
     result.update(node_fields)
     result.update(soak_fields)
     print(json.dumps(result))
-    if not acc_fields["accuracy_ok"]:
+    # gates with teeth (after the JSON so the driver always gets the row):
+    # accuracy everywhere; the pipelined-vs-floor ratio on real TPU (on a
+    # CPU host the "floor" is µs-scale noise, not an RPC period); the
+    # soak's own verdict when it ran
+    failed = not acc_fields["accuracy_ok"]
+    if on_tpu and not result.get("e2e_pipeline_ok", True):
+        print(f"GATE: pipelined e2e p99 {result['e2e_pipelined_p99_ms']} ms "
+              f"> 1.2x sync floor {result['sync_floor_p50_ms']} ms",
+              file=sys.stderr)
+        failed = True
+    if soak_fields.get("soak_ok") is False:
+        print("GATE: aggregator ingest soak failed its SLOs", file=sys.stderr)
+        failed = True
+    if failed:
         sys.exit(1)
 
 

@@ -90,6 +90,9 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     stop = threading.Event()
 
     def agent(idx: int) -> None:
+        # per-thread generator: np.random.Generator is NOT thread-safe,
+        # and all agents draw at thread start
+        rng_local = np.random.default_rng(idx)
         cpu = rng_local.uniform(0.1, 5.0, workloads).astype(np.float32)
         rep = NodeReport(
             node_name=f"soak-{idx:04d}",
@@ -122,6 +125,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                 errors[idx] += 1
                 conn.close()
                 conn = http.client.HTTPConnection(host, port, timeout=30)
+                stop.wait(interval)  # no tight reconnect spin
                 continue
             lat.append((time.perf_counter() - t0) * 1e3)
             if status != 204:
@@ -129,7 +133,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             stop.wait(interval)
         conn.close()
 
-    rng_local = rng  # shared construction rng; only used pre-loop
+    del rng  # each agent thread builds its own generator
     rss_start = rss_mib()
     t_start = time.time()
     agents = [threading.Thread(target=agent, args=(i,), daemon=True)

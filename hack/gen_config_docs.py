@@ -1,0 +1,320 @@
+#!/usr/bin/env python3
+"""Generate ``docs/user/configuration.md`` from the live config schema.
+
+The reference ships a hand-written option catalog
+(``docs/user/configuration.md`` upstream); here the catalog is GENERATED
+the same way ``hack/gen_metric_docs.py`` generates the metrics doc: walk
+the ``Config`` dataclass tree for every key, default, and type; pull the
+flag spellings out of the real argparse registration; and render the
+user-facing reference. Teeth:
+
+  * every config leaf MUST have a description below — adding a field
+    without documenting it fails the generator (and the freshness test);
+  * every registered CLI flag must be mentioned — a flag the doc doesn't
+    know about fails the generator.
+
+Usage:  python hack/gen_config_docs.py [--check]
+  --check   exit 1 if docs/user/configuration.md is stale (CI mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kepler_tpu.config.config import (  # noqa: E402
+    _CANONICAL_YAML_KEYS,
+    default_config,
+    register_flags,
+)
+from kepler_tpu.config.level import Level  # noqa: E402
+
+OUT_PATH = os.path.join(REPO, "docs", "user", "configuration.md")
+
+# one description per leaf (dotted snake_case path). The generator fails
+# on any undocumented field, so this dict can never silently lag the
+# schema.
+DESCRIPTIONS = {
+    "log.level": "Log verbosity: `debug`, `info`, `warn`, `error`.",
+    "log.format": "Log output format: `text` or `json`.",
+    "host.sysfs": "Sysfs mount point (RAPL zones are discovered under "
+                  "`<sysfs>/class/powercap`).",
+    "host.procfs": "Procfs mount point (process scan, `/proc/stat` usage "
+                   "ratio, cpuinfo).",
+    "monitor.interval": "Refresh interval for the attribution loop "
+                        "(Go-style duration; reference default 5s).",
+    "monitor.staleness": "Snapshot freshness window: a scrape older than "
+                         "this triggers a refresh; two scrapes inside it "
+                         "see identical data (HA Prometheus pairs).",
+    "monitor.max_terminated": "Terminated workloads kept for export, "
+                              "top-N by primary-zone energy; 0 disables "
+                              "tracking, negative is unbounded.",
+    "monitor.min_terminated_energy_threshold":
+        "Joules a terminated workload must have consumed to be tracked.",
+    "rapl.zones": "Zone-name filter (e.g. `[package, dram]`); empty "
+                  "means every discovered zone.",
+    "msr.enabled": "Opt-in MSR fallback: read RAPL counters from "
+                   "`/dev/cpu/*/msr` when powercap is unavailable. "
+                   "SECURITY: MSR reads enable PLATYPUS-class side "
+                   "channels (CVE-2020-8694/95) — deliberately YAML-only, "
+                   "no CLI flag.",
+    "msr.force": "Use the MSR meter even when powercap works (testing "
+                 "only).",
+    "msr.device_path": "MSR device tree (mounted as `host/dev/cpu` in "
+                       "containers).",
+    "exporter.stdout.enabled": "Periodic node-power table on stdout "
+                               "(logs move to stderr).",
+    "exporter.prometheus.enabled": "Serve `/metrics` on the API server.",
+    "exporter.prometheus.debug_collectors":
+        "Extra runtime collectors (`go` = python runtime analog of the "
+        "reference's Go collector set).",
+    "exporter.prometheus.metrics_level":
+        "Bitmask of exported families: any of `node`, `process`, "
+        "`container`, `vm`, `pod` (cumulative `--metrics` flag).",
+    "web.config_file": "exporter-toolkit-style web config (TLS, basic "
+                       "auth) applied to every listener.",
+    "web.listen_addresses": "API server listen addresses (repeatable "
+                            "`--web.listen-address`).",
+    "debug.pprof.enabled": "Mount the pprof-style debug service "
+                           "(`/debug/pprof/`: stacks, profile, JAX "
+                           "trace).",
+    "kube.enabled": "Enable the pod informer (node-filtered LIST+WATCH) "
+                    "so containers resolve to pods.",
+    "kube.config": "Kubeconfig path; empty uses in-cluster service "
+                   "account.",
+    "kube.node_name": "This node's name (the informer watch filters "
+                      "`spec.nodeName`; also the `node_name` metric "
+                      "label).",
+    "tpu.platform": "Device selection for the attribution program: "
+                    "`auto`, `tpu`, or `cpu`.",
+    "tpu.workload_bucket": "Workload-axis padding bucket — ragged "
+                           "workload counts round up to a multiple so "
+                           "the jit cache sees O(buckets) shapes.",
+    "tpu.node_bucket": "Node-axis padding bucket for the fleet batch "
+                       "(rounded up to the mesh size).",
+    "tpu.mesh_shape": "Device mesh shape for the aggregator program "
+                      "(empty = all visible devices, 1-D).",
+    "tpu.mesh_axes": "Mesh axis names (the node axis shards the fleet).",
+    "tpu.fleet_backend": "Attribution contraction backend: `einsum` "
+                         "(XLA-fused) or `pallas` (hand-written Mosaic "
+                         "kernel).",
+    "aggregator.enabled": "Run the cluster-aggregator role (ingest node "
+                          "reports, batched fleet attribution).",
+    "aggregator.listen_address": "Aggregator API listen address.",
+    "aggregator.endpoint": "Agent role: aggregator base URL to POST "
+                           "window reports to (empty disables the "
+                           "agent).",
+    "aggregator.tls_skip_verify": "Agent: skip TLS certificate "
+                                  "verification toward the aggregator.",
+    "aggregator.interval": "Fleet attribution cadence (duration).",
+    "aggregator.stale_after": "A node whose newest report is older than "
+                              "this falls out of the batch (duration).",
+    "aggregator.model": "Estimator family serving non-RAPL nodes: "
+                        "`linear`, `mlp`, `moe`, `deep`, `temporal` "
+                        "(empty = ratio-only).",
+    "aggregator.params_path": "Trained estimator params (`.npz` from "
+                              "`kepler-tpu-train`); empty serves "
+                              "untrained initialization with a warning.",
+    "aggregator.accuracy_mode": "Serve estimators at f32/highest matmul "
+                                "precision (the configuration validated "
+                                "to ≤0.5% error) instead of bf16 "
+                                "throughput mode.",
+    "aggregator.history_window": "Temporal model: feature-history ticks "
+                                 "kept per workload.",
+    "aggregator.training_dump_dir": "Capture RAPL nodes' windows + ratio "
+                                    "watts as training files for "
+                                    "`kepler-tpu-train` (empty "
+                                    "disables).",
+    "aggregator.training_dump_max_files": "Training-dump retention: "
+                                          "oldest files beyond this are "
+                                          "pruned.",
+    "aggregator.node_mode": "Agent: report as a `ratio` (RAPL ground "
+                            "truth) or `model` (estimator-served) node.",
+    "dev.fake_cpu_meter.enabled": "Dev-only synthetic meter (YAML-only, "
+                                  "never a flag — reference "
+                                  "config.go:104,189).",
+    "dev.fake_cpu_meter.zones": "Zone names the fake meter exposes "
+                                "(empty = package/core/dram/uncore).",
+}
+
+# dotted path → CLI flag (only paths that HAVE flags; YAML-only settings
+# simply aren't listed). Checked against the real parser below.
+FLAG_OF = {
+    "log.level": "--log.level",
+    "log.format": "--log.format",
+    "host.sysfs": "--host.sysfs",
+    "host.procfs": "--host.procfs",
+    "monitor.interval": "--monitor.interval",
+    "monitor.max_terminated": "--monitor.max-terminated",
+    "debug.pprof.enabled": "--debug.pprof / --no-debug.pprof",
+    "web.config_file": "--web.config-file",
+    "web.listen_addresses": "--web.listen-address (repeatable)",
+    "exporter.stdout.enabled": "--exporter.stdout / --no-exporter.stdout",
+    "exporter.prometheus.enabled":
+        "--exporter.prometheus / --no-exporter.prometheus",
+    "exporter.prometheus.metrics_level": "--metrics (cumulative)",
+    "kube.enabled": "--kube.enable / --no-kube.enable",
+    "kube.config": "--kube.config",
+    "kube.node_name": "--kube.node-name",
+    "aggregator.enabled": "--aggregator.enable / --no-aggregator.enable",
+    "aggregator.listen_address": "--aggregator.listen-address",
+    "aggregator.endpoint": "--aggregator.endpoint",
+    "aggregator.tls_skip_verify": "--aggregator.tls-skip-verify",
+    "aggregator.model": "--aggregator.model",
+    "aggregator.params_path": "--aggregator.params-path",
+    "aggregator.node_mode": "--aggregator.node-mode",
+    "aggregator.accuracy_mode": "--aggregator.accuracy-mode",
+    "aggregator.history_window": "--aggregator.history-window",
+    "aggregator.training_dump_dir": "--aggregator.training-dump-dir",
+    "aggregator.training_dump_max_files":
+        "--aggregator.training-dump-max-files",
+    "tpu.platform": "--tpu.platform",
+    "tpu.fleet_backend": "--tpu.fleet-backend",
+}
+
+_SNAKE_TO_CAMEL = {v: k for k, v in _CANONICAL_YAML_KEYS.items()}
+
+_DURATION_PATHS = {"monitor.interval", "monitor.staleness",
+                   "aggregator.interval", "aggregator.stale_after"}
+
+
+def yaml_path(path: str) -> str:
+    parts = [_SNAKE_TO_CAMEL.get(p, p) for p in path.split(".")]
+    return ".".join(parts)
+
+
+def fmt_default(path: str, value) -> str:
+    if path in _DURATION_PATHS:
+        secs = float(value)
+        return f"`{secs:g}s`"
+    if isinstance(value, Level):
+        return "`[node, process, container, vm, pod]`"
+    if isinstance(value, bool):
+        return f"`{str(value).lower()}`"
+    if isinstance(value, str):
+        return f"`{value!r}`" if value == "" else f"`{value}`"
+    return f"`{value}`"
+
+
+def leaves(obj, prefix=""):
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if dataclasses.is_dataclass(v):
+            yield from leaves(v, f"{prefix}{f.name}.")
+        else:
+            yield f"{prefix}{f.name}", v
+
+
+def registered_flags() -> set[str]:
+    parser = argparse.ArgumentParser(add_help=False)
+    register_flags(parser)
+    out = set()
+    for action in parser._actions:
+        for opt in action.option_strings:
+            out.add(opt)
+    return out
+
+
+def render() -> str:
+    cfg = default_config()
+    rows = list(leaves(cfg))
+    missing = [p for p, _ in rows if p not in DESCRIPTIONS]
+    if missing:
+        raise SystemExit(
+            f"gen_config_docs: undocumented config fields {missing} — add "
+            "DESCRIPTIONS entries")
+    stale = [p for p in DESCRIPTIONS if p not in {p for p, _ in rows}]
+    if stale:
+        raise SystemExit(
+            f"gen_config_docs: DESCRIPTIONS has stale paths {stale}")
+    doc_flags = " ".join(FLAG_OF.values())
+    unmentioned = [
+        f for f in registered_flags()
+        if f not in doc_flags and not f.startswith("--no-")
+        and f not in ("--config.file",)
+    ]
+    if unmentioned:
+        raise SystemExit(
+            f"gen_config_docs: flags missing from FLAG_OF: {unmentioned}")
+
+    lines = [
+        "# Configuration",
+        "",
+        "Every option, generated from the live `Config` schema by",
+        "`hack/gen_config_docs.py` — do not edit by hand. Regenerate with",
+        "`python hack/gen_config_docs.py` (CI checks freshness with",
+        "`--check`).",
+        "",
+        "Precedence (reference `config.go:285-395`): built-in defaults <",
+        "YAML file (`--config.file`) < explicitly-passed CLI flags. YAML",
+        "keys accept camelCase (`maxTerminated`) and kebab-case",
+        "(`max-terminated`) spellings interchangeably. Durations accept",
+        "Go syntax (`5s`, `500ms`, `1m30s`).",
+        "",
+        "Settings without a flag are YAML-only — either dev-only",
+        "(`dev.*`) or security-sensitive (`msr.*`), per the reference's",
+        "stance of not exposing those on the command line.",
+        "",
+        "| Key (YAML path) | Default | Flag | Description |",
+        "|---|---|---|---|",
+    ]
+    for path, value in rows:
+        flag = FLAG_OF.get(path, "—")
+        if flag != "—":
+            flag = f"`{flag}`"
+        desc = DESCRIPTIONS[path].replace("\n", " ")
+        lines.append(
+            f"| `{yaml_path(path)}` | {fmt_default(path, value)} | "
+            f"{flag} | {desc} |")
+    lines += [
+        "",
+        "## Example",
+        "",
+        "```yaml",
+        "log: {level: info}",
+        "monitor: {interval: 5s, staleness: 500ms}",
+        "exporter:",
+        "  stdout: {enabled: false}",
+        "  prometheus:",
+        "    enabled: true",
+        "    metricsLevel: [node, process, container, vm, pod]",
+        "web: {listenAddresses: [':28282']}",
+        "kube: {enabled: true, node-name: worker-1}",
+        "# agent half of the fleet plane:",
+        "aggregator: {endpoint: 'https://aggregator:28283'}",
+        "```",
+        "",
+        "See `docs/user/installation.md` for deployment-specific",
+        "configuration (DaemonSet mounts, Helm values, compose).",
+    ]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main() -> int:
+    text = render()
+    if "--check" in sys.argv:
+        try:
+            with open(OUT_PATH, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print(f"{OUT_PATH} is stale; run python hack/gen_config_docs.py",
+                  file=sys.stderr)
+            return 1
+        print(f"{OUT_PATH} is up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"wrote {OUT_PATH} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ from kepler_tpu.exporter.prometheus.collector import PowerCollector  # noqa: E40
 from kepler_tpu.exporter.prometheus.info_collectors import (  # noqa: E402
     BuildInfoCollector,
     CPUInfoCollector,
+    PowerMeterInfoCollector,
 )
 from kepler_tpu.monitor.snapshot import (  # noqa: E402
     NodeUsage,
@@ -104,6 +105,7 @@ def harvest():
         PowerCollector(FixtureMonitor(), node_name="node-a"),  # type: ignore
         BuildInfoCollector(),
         CPUInfoCollector(procfs=tmp),
+        PowerMeterInfoCollector("rapl-powercap"),
     ]
     seen: dict[str, tuple[str, str, tuple[str, ...]]] = {}
     for collector in collectors:

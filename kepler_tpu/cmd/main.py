@@ -11,6 +11,7 @@ console script.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from typing import Sequence
 
@@ -38,10 +39,33 @@ from kepler_tpu.utils.logger import new_logger
 log = logging.getLogger("kepler.main")
 
 
+def _powercap_usable(sysfs: str) -> bool:
+    powercap = os.path.join(sysfs, "class", "powercap")
+    try:
+        return any(e.startswith("intel-rapl") for e in os.listdir(powercap))
+    except OSError:
+        return False
+
+
 def create_cpu_meter(cfg: Config):
-    """reference createCPUMeter (main.go:227-241)."""
+    """reference createCPUMeter (main.go:227-241), extended with the MSR
+    fallback the reference proposed (EP-002): powercap stays primary; MSR
+    engages only when opted in AND powercap is unusable (or force, for
+    testing)."""
     if cfg.dev.fake_cpu_meter.enabled:
         return FakeCPUMeter(zones=cfg.dev.fake_cpu_meter.zones)
+    if cfg.msr.enabled:
+        from kepler_tpu.device.msr import MsrPowerMeter
+
+        if cfg.msr.force:
+            return MsrPowerMeter(device_path=cfg.msr.device_path,
+                                 zone_filter=cfg.rapl.zones)
+        if (not _powercap_usable(cfg.host.sysfs)
+                and MsrPowerMeter.available(cfg.msr.device_path)):
+            log.warning("powercap unusable under %s; falling back to the "
+                        "MSR meter", cfg.host.sysfs)
+            return MsrPowerMeter(device_path=cfg.msr.device_path,
+                                 zone_filter=cfg.rapl.zones)
     return RaplPowerMeter(sysfs_path=cfg.host.sysfs,
                           zone_filter=cfg.rapl.zones)
 
@@ -75,11 +99,14 @@ def create_services(cfg: Config) -> list:
         services.append(pod_lookup)
     services += [resources, monitor, server]
     if cfg.exporter.prometheus.enabled:
+        source = {"rapl": "rapl-powercap", "rapl-msr": "rapl-msr",
+                  "fake-cpu-meter": "fake"}.get(meter.name(), meter.name())
         collectors = create_collectors(
             monitor,
             node_name=cfg.kube.node_name,
             metrics_level=cfg.exporter.prometheus.metrics_level,
             procfs=cfg.host.procfs,
+            meter_source=source,
         )
         services.append(PrometheusExporter(
             server, collectors,

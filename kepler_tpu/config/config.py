@@ -97,6 +97,17 @@ class RaplConfig:
 
 
 @dataclass
+class MsrConfig:
+    """MSR fallback meter (reference proposal EP-002). YAML-only — no CLI
+    flags, so the security-sensitive backend can't be enabled by a stray
+    argument (proposal §Configuration)."""
+
+    enabled: bool = False  # opt-in: MSR reads are a PLATYPUS side channel
+    force: bool = False  # use MSR even when powercap works (testing only)
+    device_path: str = "/dev/cpu"
+
+
+@dataclass
 class MonitorConfig:
     interval: float = 5.0  # seconds (reference default 5s, config.go:207)
     staleness: float = 0.5  # seconds (reference default 500ms)
@@ -227,6 +238,7 @@ class Config:
     host: HostConfig = field(default_factory=HostConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     rapl: RaplConfig = field(default_factory=RaplConfig)
+    msr: MsrConfig = field(default_factory=MsrConfig)
     exporter: ExporterConfig = field(default_factory=ExporterConfig)
     web: WebConfig = field(default_factory=WebConfig)
     debug: DebugConfig = field(default_factory=DebugConfig)
@@ -319,6 +331,7 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "trainingDumpDir": "training_dump_dir",
     "trainingDumpMaxFiles": "training_dump_max_files",
     "fakeCpuMeter": "fake_cpu_meter",
+    "devicePath": "device_path",
 }
 
 

@@ -33,15 +33,23 @@ def create_collectors(
     metrics_level: Level = Level.all(),
     procfs: str = "/proc",
     ready_timeout: float = 0.0,
+    meter_source: str = "",
 ) -> list:
     """Standard collector set (reference CreateCollectors :139-158)."""
-    return [
+    collectors = [
         PowerCollector(monitor, node_name=node_name,
                        metrics_level=metrics_level,
                        ready_timeout=ready_timeout),
         BuildInfoCollector(),
         CPUInfoCollector(procfs=procfs),
     ]
+    if meter_source:
+        from kepler_tpu.exporter.prometheus.info_collectors import (
+            PowerMeterInfoCollector,
+        )
+
+        collectors.append(PowerMeterInfoCollector(meter_source))
+    return collectors
 
 
 class PrometheusExporter:

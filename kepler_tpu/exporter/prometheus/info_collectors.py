@@ -30,6 +30,25 @@ class BuildInfoCollector:
         yield family
 
 
+class PowerMeterInfoCollector:
+    """``kepler_node_cpu_power_meter{source=...} 1`` — which hardware
+    backend feeds attribution (reference proposal EP-002 §Metrics:
+    ``rapl-powercap`` vs ``rapl-msr``; plus ``fake`` for the dev meter).
+    """
+
+    def __init__(self, source: str) -> None:
+        self._source = source
+
+    def collect(self):
+        family = GaugeMetricFamily(
+            "kepler_node_cpu_power_meter",
+            "A metric with a constant '1' value labeled by the active "
+            "CPU power meter backend",
+            labels=["source"])
+        family.add_metric([self._source], 1.0)
+        yield family
+
+
 class CPUInfoCollector:
     def __init__(self, procfs: str = "/proc") -> None:
         self._path = os.path.join(procfs, "cpuinfo")

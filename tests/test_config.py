@@ -268,6 +268,11 @@ FIELD_MATRIX = [
     FieldCase("monitor.min_terminated_energy_threshold",
               "monitor: {minTerminatedEnergyThreshold: 25}", 25),
     FieldCase("rapl.zones", "rapl: {zones: [package]}", ["package"]),
+    # MSR fallback (EP-002): YAML-only, no flags — security-sensitive
+    FieldCase("msr.enabled", "msr: {enabled: true}", True),
+    FieldCase("msr.force", "msr: {force: true}", True),
+    FieldCase("msr.device_path", "msr: {devicePath: /host/dev/cpu}",
+              "/host/dev/cpu"),
     FieldCase("exporter.stdout.enabled",
               "exporter: {stdout: {enabled: true}}", True,
               ["--no-exporter.stdout"], False),
@@ -404,6 +409,7 @@ class TestYAMLSpellings:
         "workloadBucket": "tpu", "nodeBucket": "tpu", "meshShape": "tpu",
         "meshAxes": "tpu", "fleetBackend": "tpu",
         "fakeCpuMeter": "dev",
+        "devicePath": "msr",
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -428,6 +434,7 @@ class TestYAMLSpellings:
         "meshAxes": ("[x]", ["x"]),
         "fleetBackend": ("pallas", "pallas"),
         "fakeCpuMeter": ("{enabled: true}", None),  # subsection
+        "devicePath": ("/tmp/cpu", "/tmp/cpu"),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))

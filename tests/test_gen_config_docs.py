@@ -1,0 +1,49 @@
+"""Config-docs generator tests: docs/user/configuration.md can never
+silently drift from the Config schema (same stance as the metric docs)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_config_docs", os.path.join(REPO, "hack", "gen_config_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestGenConfigDocs:
+    def test_doc_is_fresh(self):
+        gen = load_generator()
+        with open(gen.OUT_PATH, encoding="utf-8") as f:
+            current = f.read()
+        assert current == gen.render(), (
+            "docs/user/configuration.md is stale; "
+            "run: python hack/gen_config_docs.py")
+
+    def test_every_field_documented(self):
+        """render() itself raises on undocumented fields — this pins the
+        tooth so a refactor can't remove it."""
+        gen = load_generator()
+        gen.DESCRIPTIONS.pop("log.level")
+        try:
+            gen.render()
+        except SystemExit as err:
+            assert "undocumented" in str(err)
+        else:
+            raise AssertionError("missing description did not fail")
+
+    def test_yaml_spellings_resolve(self):
+        """Every YAML path the doc advertises must actually load."""
+        from kepler_tpu.config.config import load
+
+        gen = load_generator()
+        text = gen.render()
+        # spot keys with camelCase conversions
+        assert "monitor.maxTerminated" in text
+        assert "aggregator.trainingDumpDir" in text
+        cfg = load("monitor: {maxTerminated: 7}")
+        assert cfg.monitor.max_terminated == 7

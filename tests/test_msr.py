@@ -1,0 +1,277 @@
+"""MSR fallback meter tests against a fake MSR device tree.
+
+The reference only PROPOSED this backend
+(EP-002-MSR-Fallback-Power-Meter.md); these tests pin the implemented
+behavior: register decoding, unit scaling, 32-bit wraparound through the
+monitor's delta math, multi-socket aggregation, fallback selection, and
+the backend-info metric.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from kepler_tpu.device.msr import (
+    MSR_RAPL_POWER_UNIT,
+    MsrPowerMeter,
+    energy_unit_uj,
+    read_msr,
+)
+
+# the classic Intel energy-status unit: 1 / 2^16 J per count
+_UNIT_RAW = 0x10 << 8
+_UNIT_UJ = 1e6 / 65536
+
+PKG, PP0, DRAM, PP1 = 0x611, 0x639, 0x619, 0x641
+
+
+def write_msr_file(path, registers: dict[int, int]):
+    """A fake MSR device: sparse file with 8-byte registers at their
+    offsets (pread semantics identical to /dev/cpu/N/msr)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        for reg, value in registers.items():
+            f.seek(reg)
+            f.write(struct.pack("<Q", value))
+
+
+def make_tree(root, sockets=1, counters=None, registers=(PKG, PP0, DRAM)):
+    """Fake /dev/cpu + topology trees; 2 CPUs per socket."""
+    dev = root / "dev" / "cpu"
+    topo = root / "sys_cpu"
+    counters = counters or {}
+    for s in range(sockets):
+        for c in range(2):
+            cpu = s * 2 + c
+            regs = {MSR_RAPL_POWER_UNIT: _UNIT_RAW}
+            for reg in registers:
+                regs[reg] = counters.get((s, reg), 1000 * (s + 1))
+            write_msr_file(str(dev / str(cpu) / "msr"), regs)
+            tdir = topo / f"cpu{cpu}" / "topology"
+            os.makedirs(tdir, exist_ok=True)
+            (tdir / "physical_package_id").write_text(f"{s}\n")
+    return str(dev), str(topo)
+
+
+def test_energy_unit_decoding():
+    assert energy_unit_uj(_UNIT_RAW) == pytest.approx(_UNIT_UJ)
+    # ESU=14 (some Atom parts): 1/2^14 J
+    assert energy_unit_uj(0x0E << 8) == pytest.approx(1e6 / 16384)
+
+
+def test_read_msr_roundtrip(tmp_path):
+    path = str(tmp_path / "msr")
+    write_msr_file(path, {0x611: 0xDEADBEEF, 0x606: _UNIT_RAW})
+    assert read_msr(path, 0x611) == 0xDEADBEEF
+    assert read_msr(path, 0x606) == _UNIT_RAW
+
+
+def test_discovers_zones_with_sysfs_names(tmp_path):
+    dev, topo = make_tree(tmp_path, counters={(0, PKG): 65536})
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo)
+    meter.init()
+    names = {z.name() for z in meter.zones()}
+    assert names == {"package-0", "core-0", "dram-0"}
+    assert meter.primary_energy_zone().name() == "package-0"
+    pkg = next(z for z in meter.zones() if z.name() == "package-0")
+    # 65536 counts × (1/2^16 J) = 1 J = 1e6 µJ
+    assert int(pkg.energy()) == 1_000_000
+    # wrap point: 2^32 counts in µJ
+    assert int(pkg.max_energy()) == int((1 << 32) * _UNIT_UJ)
+
+
+def test_unimplemented_register_is_skipped(tmp_path):
+    dev, topo = make_tree(tmp_path, registers=(PKG,))
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo)
+    meter.init()
+    assert {z.name() for z in meter.zones()} == {"package-0"}
+
+
+def test_zone_filter(tmp_path):
+    dev, topo = make_tree(tmp_path)
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo,
+                          zone_filter=["package"])
+    meter.init()
+    assert {z.name() for z in meter.zones()} == {"package-0"}
+
+
+def test_zone_filter_accepts_suffixed_names(tmp_path):
+    """`rapl: {zones: [package-0]}` must select the same zones on either
+    backend — the sysfs meter accepts suffixed spellings, so MSR must."""
+    dev, topo = make_tree(tmp_path)
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo,
+                          zone_filter=["package-0"])
+    meter.init()
+    assert {z.name() for z in meter.zones()} == {"package-0"}
+
+
+def test_multi_socket_aggregates_by_name(tmp_path):
+    dev, topo = make_tree(tmp_path, sockets=2,
+                          counters={(0, PKG): 1000, (1, PKG): 500})
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo)
+    meter.init()
+    names = {z.name() for z in meter.zones()}
+    # same-stem zones from both sockets merge into ONE logical zone
+    assert names == {"package-0", "core-0", "dram-0"}
+    pkg = next(z for z in meter.zones() if z.name() == "package-0")
+    first = int(pkg.energy())
+    # advance socket 1's counter by 2^16 counts = 1 J
+    write_msr_file(os.path.join(dev, "2", "msr"),
+                   {MSR_RAPL_POWER_UNIT: _UNIT_RAW, PKG: 500 + 65536,
+                    PP0: 2000, DRAM: 2000})
+    assert int(pkg.energy()) - first == pytest.approx(1_000_000, abs=2)
+
+
+def test_counter_wrap_through_monitor_delta(tmp_path):
+    """A 32-bit counter wrap must read as a small forward delta through
+    the monitor's wraparound math, not a huge negative jump."""
+    from kepler_tpu.ops.deltas import energy_delta
+
+    dev, topo = make_tree(tmp_path,
+                          counters={(0, PKG): (1 << 32) - 65536})
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo)
+    meter.init()
+    pkg = next(z for z in meter.zones() if z.name() == "package-0")
+    before = int(pkg.energy())
+    # wrap: counter advances 2×65536 counts, passing 2^32
+    write_msr_file(os.path.join(dev, "0", "msr"),
+                   {MSR_RAPL_POWER_UNIT: _UNIT_RAW, PKG: 65536,
+                    PP0: 1000, DRAM: 1000})
+    after = int(pkg.energy())
+    delta = energy_delta(after, before, int(pkg.max_energy()))
+    assert delta == pytest.approx(2_000_000, rel=1e-5)  # 2 J forward
+
+
+def test_no_msr_tree_raises(tmp_path):
+    meter = MsrPowerMeter(device_path=str(tmp_path / "missing"))
+    with pytest.raises(RuntimeError, match="MSR"):
+        meter.init()
+    assert not MsrPowerMeter.available(str(tmp_path / "missing"))
+
+
+def test_available_predicate(tmp_path):
+    dev, _ = make_tree(tmp_path)
+    assert MsrPowerMeter.available(dev)
+
+
+class TestMeterSelection:
+    def make_cfg(self, tmp_path, msr_enabled, force=False,
+                 with_powercap=False):
+        from kepler_tpu.config.config import load as load_config
+
+        sysfs = tmp_path / "sys"
+        if with_powercap:
+            zdir = sysfs / "class" / "powercap" / "intel-rapl:0"
+            os.makedirs(zdir)
+            for fname, val in (("name", "package-0"), ("energy_uj", 100),
+                               ("max_energy_range_uj", 2**40)):
+                (zdir / fname).write_text(f"{val}\n")
+        else:
+            os.makedirs(sysfs / "class" / "powercap", exist_ok=True)
+        dev, _ = make_tree(tmp_path)
+        return load_config(f"""
+host: {{sysfs: {sysfs}}}
+msr: {{enabled: {str(msr_enabled).lower()}, force: {str(force).lower()},
+       device-path: {dev}}}
+""")
+
+    def test_powercap_preferred_when_usable(self, tmp_path):
+        from kepler_tpu.cmd.main import create_cpu_meter
+        from kepler_tpu.device.rapl import RaplPowerMeter
+
+        cfg = self.make_cfg(tmp_path, msr_enabled=True, with_powercap=True)
+        assert isinstance(create_cpu_meter(cfg), RaplPowerMeter)
+
+    def test_falls_back_to_msr_when_powercap_empty(self, tmp_path):
+        from kepler_tpu.cmd.main import create_cpu_meter
+
+        cfg = self.make_cfg(tmp_path, msr_enabled=True)
+        meter = create_cpu_meter(cfg)
+        assert isinstance(meter, MsrPowerMeter)
+        assert meter.name() == "rapl-msr"
+
+    def test_no_fallback_without_opt_in(self, tmp_path):
+        from kepler_tpu.cmd.main import create_cpu_meter
+        from kepler_tpu.device.rapl import RaplPowerMeter
+
+        cfg = self.make_cfg(tmp_path, msr_enabled=False)
+        assert isinstance(create_cpu_meter(cfg), RaplPowerMeter)
+
+    def test_force_uses_msr_despite_powercap(self, tmp_path):
+        from kepler_tpu.cmd.main import create_cpu_meter
+
+        cfg = self.make_cfg(tmp_path, msr_enabled=True, force=True,
+                            with_powercap=True)
+        assert isinstance(create_cpu_meter(cfg), MsrPowerMeter)
+
+
+def test_monitor_end_to_end_on_msr(tmp_path):
+    """Whole node pipeline on the MSR backend: monitor + attribution over
+    a fake MSR tree — backend-independence of everything downstream."""
+    from kepler_tpu.monitor.monitor import PowerMonitor
+    from kepler_tpu.resource.informer import FeatureBatch
+
+    dev, topo = make_tree(tmp_path, counters={(0, PKG): 0})
+
+    from kepler_tpu.resource.informer import (Containers, Pods, Processes,
+                                              VirtualMachines)
+    from kepler_tpu.resource.types import Process
+
+    class OneProc:
+        def __init__(self):
+            self._proc = Process(pid=42, comm="spin", cpu_total_time=1.0,
+                                 cpu_time_delta=1.0)
+
+        def refresh(self):
+            pass
+
+        def processes(self):
+            return Processes(running={42: self._proc})
+
+        def containers(self):
+            return Containers()
+
+        def virtual_machines(self):
+            return VirtualMachines()
+
+        def pods(self):
+            return Pods()
+
+        def feature_batch(self):
+            return FeatureBatch(
+                kinds=np.zeros(1, np.int8), ids=["42"],
+                cpu_deltas=np.ones(1, np.float32),
+                node_cpu_delta=1.0, usage_ratio=0.5,
+                cpu_totals=np.ones(1),
+                kind_offsets=(0, 1, 1, 1, 1))
+
+    meter = MsrPowerMeter(device_path=dev, topology_path=topo)
+    monitor = PowerMonitor(meter, OneProc(), interval=0, staleness=0.0)
+    monitor.init()
+    monitor.refresh()  # seeds counters
+    write_msr_file(os.path.join(dev, "0", "msr"),
+                   {MSR_RAPL_POWER_UNIT: _UNIT_RAW, PKG: 2 * 65536,
+                    PP0: 65536, DRAM: 65536 // 2})
+    monitor.refresh()
+    snap = monitor.snapshot()
+    zi = snap.node.zone_names.index("package-0")
+    assert snap.node.energy_uj[zi] == pytest.approx(2e6, rel=1e-5)
+    # conservation: the single workload owns all active energy
+    assert snap.processes.energy_uj[0, zi] == pytest.approx(
+        snap.node.active_uj[zi], rel=1e-6)
+
+
+def test_power_meter_info_collector():
+    from prometheus_client import CollectorRegistry
+    from prometheus_client.exposition import generate_latest
+
+    from kepler_tpu.exporter.prometheus.info_collectors import (
+        PowerMeterInfoCollector,
+    )
+
+    reg = CollectorRegistry()
+    reg.register(PowerMeterInfoCollector("rapl-msr"))
+    text = generate_latest(reg).decode()
+    assert 'kepler_node_cpu_power_meter{source="rapl-msr"} 1.0' in text
