@@ -103,6 +103,10 @@ DESCRIPTIONS = {
     "tpu.fleet_backend": "Attribution contraction backend: `einsum` "
                          "(XLA-fused) or `pallas` (hand-written Mosaic "
                          "kernel).",
+    "tpu.compilation_cache_dir": "Persistent XLA compilation cache "
+                                 "directory (empty = off): "
+                                 "bucket-crossing and restart compiles "
+                                 "become disk hits.",
     "aggregator.enabled": "Run the cluster-aggregator role (ingest node "
                           "reports, batched fleet attribution).",
     "aggregator.listen_address": "Aggregator API listen address.",

@@ -72,6 +72,13 @@ def create_cpu_meter(cfg: Config):
 
 def create_services(cfg: Config) -> list:
     """reference createServices (main.go:124-225)."""
+    if cfg.tpu.compilation_cache_dir:
+        # persistent XLA cache: bucket-crossing / restart compiles become
+        # disk hits (statelessness stays intact — it is only a cache)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          cfg.tpu.compilation_cache_dir)
     meter = create_cpu_meter(cfg)
 
     pod_lookup = None

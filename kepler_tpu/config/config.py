@@ -180,6 +180,9 @@ class TPUConfig:
     node_bucket: int = 8  # fleet aggregator node-axis bucket
     mesh_shape: list[int] = field(default_factory=list)  # [] = all devices, 1D
     mesh_axes: list[str] = field(default_factory=lambda: ["node"])
+    # persistent XLA compilation cache dir ("" = off): bucket-crossing and
+    # restart compiles become disk hits instead of fresh XLA runs
+    compilation_cache_dir: str = ""
     # fleet attribution contraction: "einsum" (XLA-fused) | "pallas"
     # (hand-written Mosaic kernel, shard_map over the node axis)
     fleet_backend: str = "einsum"
@@ -332,6 +335,7 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "trainingDumpMaxFiles": "training_dump_max_files",
     "fakeCpuMeter": "fake_cpu_meter",
     "devicePath": "device_path",
+    "compilationCacheDir": "compilation_cache_dir",
 }
 
 

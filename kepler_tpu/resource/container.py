@@ -26,6 +26,16 @@ _PATTERNS: list[tuple[re.Pattern[str], ContainerRuntime]] = [
      ContainerRuntime.KUBEPODS),
 ]
 
+# Cheap PREFILTER: one alternation (group-free, patterns verbatim) that
+# matches iff ANY runtime pattern would. The per-pattern deepest-match
+# loop below is exact but ~7 scans per path; most processes on a real
+# node are NOT containers, and a burst of new system procs classifies in
+# one combined scan each. A single left-to-right alternation cannot
+# REPLACE the loop — a long early match (kubepods) would consume the
+# span and hide a deeper-starting inner match (libpod nested inside) —
+# so it only gates it.
+_PREFILTER = re.compile("|".join(f"(?:{p.pattern})" for p, _ in _PATTERNS))
+
 
 def container_info_from_cgroup_paths(
     paths: list[str],
@@ -39,6 +49,8 @@ def container_info_from_cgroup_paths(
     """
     best: tuple[int, ContainerRuntime, str] | None = None
     for path in paths:
+        if _PREFILTER.search(path) is None:
+            continue
         for pattern, runtime in _PATTERNS:
             for m in pattern.finditer(path):
                 if best is None or m.start() > best[0]:

@@ -268,6 +268,9 @@ FIELD_MATRIX = [
     FieldCase("monitor.min_terminated_energy_threshold",
               "monitor: {minTerminatedEnergyThreshold: 25}", 25),
     FieldCase("rapl.zones", "rapl: {zones: [package]}", ["package"]),
+    FieldCase("tpu.compilation_cache_dir",
+              "tpu: {compilationCacheDir: /var/cache/kepler-xla}",
+              "/var/cache/kepler-xla"),
     # MSR fallback (EP-002): YAML-only, no flags — security-sensitive
     FieldCase("msr.enabled", "msr: {enabled: true}", True),
     FieldCase("msr.force", "msr: {force: true}", True),
@@ -410,6 +413,7 @@ class TestYAMLSpellings:
         "meshAxes": "tpu", "fleetBackend": "tpu",
         "fakeCpuMeter": "dev",
         "devicePath": "msr",
+        "compilationCacheDir": "tpu",
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -435,6 +439,7 @@ class TestYAMLSpellings:
         "fleetBackend": ("pallas", "pallas"),
         "fakeCpuMeter": ("{enabled: true}", None),  # subsection
         "devicePath": ("/tmp/cpu", "/tmp/cpu"),
+        "compilationCacheDir": ("/tmp/xla", "/tmp/xla"),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))

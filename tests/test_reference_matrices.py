@@ -28,6 +28,25 @@ H = "0123456789abcdef" * 4  # a 64-hex container id
 H2 = "fedcba9876543210" * 4
 
 
+class TestNestedRuntimeDeepestMatch:
+    def test_inner_match_hidden_by_outer_span_still_wins(self):
+        """A later-STARTING match nested inside an earlier pattern's span
+        must win (deepest-match contract): a left-to-right alternation
+        would consume the outer span and miss it — this pins the
+        per-pattern scan semantics against that optimization."""
+        from kepler_tpu.resource.container import (
+            container_info_from_cgroup_paths,
+        )
+        from kepler_tpu.resource.types import ContainerRuntime
+
+        hex_a = "a" * 64
+        hex_b = "b" * 64
+        path = f"/kubepods/libpod-{hex_a}/pod12/{hex_b}"
+        runtime, cid = container_info_from_cgroup_paths([path])
+        assert runtime == ContainerRuntime.PODMAN
+        assert cid == hex_a  # the libpod match starts deeper
+
+
 class TestContainerCgroupMatrix:
     """container_test.go:14-141's runtime × path-format matrix."""
 
