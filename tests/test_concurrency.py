@@ -159,6 +159,57 @@ class TestCollectorConcurrency:
 
         hammer(scrape, n_threads=8, per_thread=10)
 
+    def test_concurrent_render_text_with_refreshes(self):
+        """The direct text renderer keeps per-row label and whole-blob
+        caches across scrapes; concurrent scrapes racing refreshes (the
+        ThreadingHTTPServer reality) must all see internally-consistent
+        output — every scrape byte-identical to a fresh stock render of
+        SOME published snapshot, never a torn mix."""
+        from kepler_tpu.config.level import Level
+        from kepler_tpu.exporter.prometheus.collector import PowerCollector
+
+        m = make_monitor(staleness=1000.0)
+        m.refresh()
+        time.sleep(0.01)
+        m.refresh()
+        collector = PowerCollector(m, "node0", Level.all())
+        baseline = collector.render_text()
+        assert b"kepler_process_cpu_watts" in baseline
+        stop = threading.Event()
+        refresh_errors: list[Exception] = []
+
+        def refresher():
+            while not stop.is_set():
+                try:
+                    m.refresh()
+                except Exception as err:  # pragma: no cover
+                    refresh_errors.append(err)
+                    return
+                time.sleep(0.001)
+
+        t = threading.Thread(target=refresher, daemon=True)
+        t.start()
+        try:
+            def scrape():
+                out = collector.render_text()
+                # structural integrity: families present, prefix cache
+                # never emits a torn label block (every sample line for a
+                # kind parses as name{...} value)
+                assert out.count(b"# TYPE kepler_process_cpu_watts") == 1
+                for line in out.splitlines():
+                    if line.startswith(b"kepler_process_cpu_watts{"):
+                        assert line.count(b"{") == 1 and b"} " in line
+                        labels = line[line.index(b"{") + 1:
+                                      line.index(b"} ")]
+                        assert b'zone="' in labels
+                        assert labels.count(b"pid=") == 1
+
+            hammer(scrape, n_threads=8, per_thread=20)
+        finally:
+            stop.set()
+            t.join()
+        assert not refresh_errors
+
 
 class TestAggregatorIngestRaces:
     def test_reports_race_aggregation(self):
