@@ -159,16 +159,21 @@ class TestCollectorConcurrency:
 
         hammer(scrape, n_threads=8, per_thread=10)
 
-    def test_concurrent_render_text_with_refreshes(self):
+    def test_concurrent_render_text_with_refreshes_and_churn(self):
         """The direct text renderer keeps per-row label and whole-blob
-        caches across scrapes; concurrent scrapes racing refreshes (the
-        ThreadingHTTPServer reality) must all see internally-consistent
-        output — every scrape byte-identical to a fresh stock render of
-        SOME published snapshot, never a torn mix."""
+        caches across scrapes; concurrent scrapes racing refreshes THAT
+        CHURN MEMBERSHIP (procs appear and vanish, so the meta_gen
+        invalidation and cache rebuilds fire mid-hammer, like a pod
+        reschedule under ThreadingHTTPServer) must see consistent
+        output. When no refresh interleaves a scrape, its bytes must
+        equal a cold fresh-collector render of the same published
+        snapshot — a torn cached-labels/new-values mix cannot pass that.
+        """
         from kepler_tpu.config.level import Level
         from kepler_tpu.exporter.prometheus.collector import PowerCollector
 
         m = make_monitor(staleness=1000.0)
+        reader = m._resources._fs
         m.refresh()
         time.sleep(0.01)
         m.refresh()
@@ -179,8 +184,15 @@ class TestCollectorConcurrency:
         refresh_errors: list[Exception] = []
 
         def refresher():
+            pid = 100
             while not stop.is_set():
                 try:
+                    # membership churn: one proc appears, an earlier
+                    # synthetic one vanishes (keeps the set bounded)
+                    reader.procs.append(MockProc(pid, cpu=1.0))
+                    if len(reader.procs) > 6:
+                        reader.procs.pop(3)
+                    pid += 1
                     m.refresh()
                 except Exception as err:  # pragma: no cover
                     refresh_errors.append(err)
@@ -191,23 +203,33 @@ class TestCollectorConcurrency:
         t.start()
         try:
             def scrape():
+                snap_before = m._snapshot
                 out = collector.render_text()
-                # structural integrity: families present, prefix cache
-                # never emits a torn label block (every sample line for a
-                # kind parses as name{...} value)
-                assert out.count(b"# TYPE kepler_process_cpu_watts") == 1
-                for line in out.splitlines():
-                    if line.startswith(b"kepler_process_cpu_watts{"):
-                        assert line.count(b"{") == 1 and b"} " in line
-                        labels = line[line.index(b"{") + 1:
-                                      line.index(b"} ")]
-                        assert b'zone="' in labels
-                        assert labels.count(b"pid=") == 1
+                fresh = PowerCollector(m, "node0", Level.all())
+                out_cold = fresh.render_text()
+                if m._snapshot is snap_before:
+                    # the published snapshot was stable across BOTH
+                    # renders: warm caches must reproduce the cold
+                    # render byte-for-byte (a torn mix cannot)
+                    assert out == out_cold
+                else:
+                    # a refresh interleaved: still structurally whole
+                    assert out.count(
+                        b"# TYPE kepler_process_cpu_watts") == 1
+                    for line in out.splitlines():
+                        if line.startswith(b"kepler_process_cpu_watts{"):
+                            assert (line.count(b"{") == 1
+                                    and b"} " in line)
+                            labels = line[line.index(b"{") + 1:
+                                          line.index(b"} ")]
+                            assert b'zone="' in labels
+                            assert labels.count(b"pid=") == 1
 
             hammer(scrape, n_threads=8, per_thread=20)
         finally:
             stop.set()
-            t.join()
+            t.join(timeout=30)
+        assert not t.is_alive(), "refresher deadlocked against scrapes"
         assert not refresh_errors
 
 
