@@ -1,0 +1,87 @@
+"""Multi-process worker for the cross-host fleet-program test.
+
+Each process initializes ``jax.distributed`` (CPU backend, Gloo
+collectives — the DCN stand-in), joins a GLOBAL mesh spanning both
+processes' devices, device_puts its node-axis shard of one deterministic
+fleet batch, and runs the SAME sharded attribution program the
+aggregator serves. It prints a JSON line with conservation figures and a
+digest of the node powers; the parent test asserts both processes agree
+with each other and with a single-process reference.
+
+Run by ``tests/test_multihost.py`` — not a test module itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    n_proc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # the same entry point cmd/aggregator calls (env-driven in prod)
+    from kepler_tpu.parallel import initialize_multihost
+
+    assert initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n_proc, process_id=pid)
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from kepler_tpu.models import init_mlp
+    from kepler_tpu.parallel.aggregator_core import make_fleet_program
+    from kepler_tpu.parallel.mesh import make_mesh
+    from tests.test_multihost import make_global_batch
+
+    devs = jax.devices()  # GLOBAL device list across processes
+    mesh = make_mesh()  # the production helper must span every host
+    batch = make_global_batch(n_nodes=len(devs) * 4)
+    params = init_mlp(jax.random.PRNGKey(0), n_zones=2)
+    program = make_fleet_program(mesh, model_mode="mlp")
+
+    by_node_2d = NamedSharding(mesh, P("node", None))
+    by_node_1d = NamedSharding(mesh, P("node"))
+    args = [
+        jax.device_put(params, NamedSharding(mesh, P())),
+        jax.device_put(batch.zone_deltas_uj, by_node_2d),
+        jax.device_put(batch.zone_valid, by_node_2d),
+        jax.device_put(batch.usage_ratio, by_node_1d),
+        jax.device_put(batch.cpu_deltas, by_node_2d),
+        jax.device_put(batch.workload_valid, by_node_2d),
+        jax.device_put(batch.node_cpu_delta, by_node_1d),
+        jax.device_put(batch.dt_s, by_node_1d),
+        jax.device_put(batch.mode.astype(np.int32), by_node_1d),
+    ]
+    result = program(*args)
+    # replicate the outputs so every process holds the full value (the
+    # all_gather rides the cross-process collective backend)
+    gather = jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+    node_power = np.asarray(
+        gather(result.node_power_uw).addressable_data(0))
+    wl_power = np.asarray(
+        gather(result.workload_power_uw).addressable_data(0))
+    print(json.dumps({
+        "process": pid,
+        "global_devices": len(devs),
+        "local_devices": len(jax.local_devices()),
+        "node_power_digest": hashlib.sha256(
+            np.ascontiguousarray(node_power, np.float32).tobytes()
+        ).hexdigest(),
+        "node_power_sum": float(node_power.sum()),
+        "wl_power_sum": float(wl_power.sum()),
+        "finite": bool(np.isfinite(node_power).all()
+                       and np.isfinite(wl_power).all()),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
