@@ -31,6 +31,11 @@ from kepler_tpu.parallel.pipeline import (
     make_pipeline,
     make_pipelined_deep,
 )
+from kepler_tpu.parallel.ulysses import (
+    make_ulysses_attention,
+    make_ulysses_temporal_program,
+    ulysses_attention_shardmap,
+)
 from kepler_tpu.parallel.ring import (
     SEQ_AXIS,
     full_attention,
@@ -57,6 +62,9 @@ __all__ = [
     "make_temporal_fleet_program",
     "temporal_fleet_program",
     "make_ring_attention",
+    "make_ulysses_attention",
+    "make_ulysses_temporal_program",
+    "ulysses_attention_shardmap",
     "make_sequence_parallel_train_step",
     "make_temporal_program",
     "top1_route",

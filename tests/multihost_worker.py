@@ -26,12 +26,15 @@ def main() -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    # the same entry point cmd/aggregator calls (env-driven in prod)
+    # the same entry point cmd/aggregator calls (env-driven in prod).
+    # NOT inside an assert: python -O must still initialize
     from kepler_tpu.parallel import initialize_multihost
 
-    assert initialize_multihost(
+    joined = initialize_multihost(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=n_proc, process_id=pid)
+    if not joined:
+        raise RuntimeError("initialize_multihost declined to initialize")
 
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
