@@ -50,6 +50,14 @@ native-tsan: ## ThreadSanitizer pass over the native scanner (the -race analog)
 		-o /tmp/kepler_scan_tsan
 	/tmp/kepler_scan_tsan
 
+.PHONY: native-asan
+native-asan: ## AddressSanitizer pass over the native scanner/renderer
+	g++ -O1 -g -fsanitize=address -std=c++17 -pthread -Wall -Wextra \
+		kepler_tpu/native/src/scan.cpp \
+		kepler_tpu/native/src/scan_tsan_test.cpp \
+		-o /tmp/kepler_scan_asan
+	/tmp/kepler_scan_asan
+
 # -- lint ---------------------------------------------------------------------
 .PHONY: lint
 lint:

@@ -13,7 +13,6 @@ cumulative history — the node-agent analog of the aggregator's RSS soak
 import os
 import shutil
 
-import numpy as np
 import pytest
 
 from kepler_tpu.config.level import Level
@@ -28,13 +27,13 @@ pytestmark = pytest.mark.skipif(
 
 
 def write_proc(proc, pid, utime, container=False):
+    # stat-line layout comes from the benchmarks' canonical fixture
+    # writer — one definition of the fake stat format repo-wide
+    from benchmarks.node_path import write_stat_line
+
     d = os.path.join(proc, str(pid))
     os.makedirs(d, exist_ok=True)
-    head = f"{pid} (churn-{pid}) S 1 1 1 0 -1 4194560 100 0 0 0"
-    tail = (f"{utime} {utime // 2} 0 0 20 0 1 0 100 0 0 "
-            + " ".join(["0"] * 29))
-    with open(os.path.join(d, "stat"), "w") as f:
-        f.write(head + " " + tail)
+    write_stat_line(d, pid, f"churn-{pid}", utime, utime // 2)
     with open(os.path.join(d, "comm"), "w") as f:
         f.write(f"churn-{pid}\n")
     cg = (f"0::/system.slice/docker-{pid:064x}.scope\n" if container

@@ -245,6 +245,50 @@ def test_truncated_environ_reread(scanner, tmp_path):
     assert informer.processes().running[20].container.name == "at-the-end"
 
 
+class TestFloatFormatParity:
+    """kepler_fmt_double must be byte-identical to prometheus_client's
+    floatToGoString (Python-repr semantics + the Go e+NN munge) — the
+    native text renderer's output identity rests on it."""
+
+    EDGE = [0.0, -0.0, 1.0, -1.0, 0.1, 1e6, 1e7 - 1, 1e7, 12345678.9,
+            1e15, 1e16, 1e-4, 1e-5, 1.5e-5, 123.456, 2.5e8 / 1e6,
+            float("inf"), float("-inf"), float("nan"), 1e21, 5e-324,
+            1.7976931348623157e308, 999999.9999999999, 1000000.0000001,
+            4.9e-324, 2.2250738585072014e-308]
+
+    def test_edge_cases(self, scanner):
+        from prometheus_client.utils import floatToGoString
+
+        for v in self.EDGE:
+            assert scanner.fmt_double(v).decode() == floatToGoString(v), v
+
+    def test_random_sweep(self, scanner):
+        import random
+        import struct
+
+        from prometheus_client.utils import floatToGoString
+
+        rng = random.Random(0)
+        for i in range(20000):
+            kind = rng.random()
+            if kind < 0.5:
+                v = rng.uniform(0, 1e9)
+            elif kind < 0.7:
+                v = rng.uniform(-1e9, 1e9)
+            elif kind < 0.9:
+                v = rng.uniform(0, 1e3) * 10.0 ** rng.randint(-30, 30)
+            else:  # raw bit patterns (subnormals, extremes)
+                v = struct.unpack(
+                    "<d", struct.pack("<Q", rng.getrandbits(64)))[0]
+                import math
+
+                if math.isnan(v):
+                    continue
+            got = scanner.fmt_double(v).decode()
+            want = floatToGoString(v)
+            assert got == want, f"iter {i}: {v!r}: {got} != {want}"
+
+
 class TestBatchedZoneReads:
     """The native fast path for RAPL reads: one C call for all zones, with
     identical semantics to per-zone Python file reads (wraparound included
