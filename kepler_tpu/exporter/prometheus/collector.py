@@ -215,10 +215,10 @@ class PowerCollector:
     # identical to prometheus_client's generate_latest over this collector
     # — pinned by tests/test_exporter_wire.py.
 
-    def render_text(self) -> bytes:
-        """Classic-text exposition of this collector's families (fast
-        path). Empty bytes when not ready / snapshot unavailable — the
-        same scrapes collect() would skip.
+    def render_text(self, openmetrics: bool = False) -> bytes:
+        """Text exposition of this collector's families (fast path).
+        Empty bytes when not ready / snapshot unavailable — the same
+        scrapes collect() would skip.
 
         Per-row label blocks are cached as bytes across scrapes (labels
         change on exec/reclassify; values change every tick); when the
@@ -227,6 +227,14 @@ class PowerCollector:
         (``kepler_render_samples``), so a 10k-process scrape does no
         per-sample Python work at all. Byte parity with the stock
         renderer is pinned by tests/test_exporter_wire.py either way.
+
+        ``openmetrics=True`` emits the OpenMetrics exposition instead —
+        sample lines are byte-identical to classic text for these
+        families; only the counter HELP/TYPE header names differ (base
+        name instead of ``*_total``). Modern Prometheus negotiates
+        OpenMetrics BY DEFAULT, so this path is just as hot as classic.
+        The caller appends the ``# EOF`` terminator (the exporter
+        concatenates several renders first).
         """
         from kepler_tpu.exporter.prometheus.fastexpo import _escape_value
 
@@ -243,7 +251,7 @@ class PowerCollector:
         out: list[bytes] = []
         if Level.NODE in self._level:
             node_out: list[str] = []
-            self._render_node_text(node_out, snap, const)
+            self._render_node_text(node_out, snap, const, openmetrics)
             out.append("".join(node_out).encode("utf-8"))
         ezones = [(z, _escape_value(z)) for z in snap.node.zone_names]
         new_cache: dict = {}
@@ -253,11 +261,20 @@ class PowerCollector:
             self._render_workload_text(out, kind, ezones,
                                        getattr(snap, run_attr),
                                        getattr(snap, term_attr), const,
-                                       new_cache)
+                                       new_cache, openmetrics)
         self._label_cache = new_cache  # drop vanished workloads' entries
         return b"".join(out)
 
-    def _render_node_text(self, out: list[str], snap, const) -> None:
+    @staticmethod
+    def _header_name(sample_name: str, openmetrics: bool) -> str:
+        """OpenMetrics HELP/TYPE lines carry the FAMILY name (no _total);
+        classic text carries the suffixed sample name."""
+        if openmetrics and sample_name.endswith("_total"):
+            return sample_name[:-len("_total")]
+        return sample_name
+
+    def _render_node_text(self, out: list[str], snap, const,
+                          openmetrics: bool = False) -> None:
         from prometheus_client.utils import floatToGoString
 
         from kepler_tpu.exporter.prometheus.fastexpo import _escape_value
@@ -268,8 +285,9 @@ class PowerCollector:
                 values = getattr(node, attr)
                 name = f"kepler_node_cpu_{state}{suffix}"
                 doc = _node_family_doc(desc, state)
-                out.append(f"# HELP {name} {doc}\n")
-                out.append(f"# TYPE {name} {mtype}\n")
+                hname = self._header_name(name, openmetrics)
+                out.append(f"# HELP {hname} {doc}\n")
+                out.append(f"# TYPE {hname} {mtype}\n")
                 for z, zone in enumerate(node.zone_names):
                     pairs = sorted({"zone": zone, "path": "",
                                     **const}.items())
@@ -293,7 +311,8 @@ class PowerCollector:
     def _render_workload_text(self, out: list[bytes], kind: str, ezones,
                               running: WorkloadTable,
                               terminated: WorkloadTable, const,
-                              new_cache: dict) -> None:
+                              new_cache: dict,
+                              openmetrics: bool = False) -> None:
         from kepler_tpu.exporter.prometheus.fastexpo import (_escape_value,
                                                             fmt_float)
 
@@ -364,8 +383,9 @@ class PowerCollector:
         # pass 2: families — joules, watts, then (processes) seconds; each
         # family lists running rows then terminated rows, matching the
         # registry renderer's sample order
-        out.append(f"# HELP {jname} Energy consumption of cpu at {kind} "
-                   f"level in joules\n# TYPE {jname} counter\n".encode())
+        jhead = self._header_name(jname, openmetrics)
+        out.append(f"# HELP {jhead} Energy consumption of cpu at {kind} "
+                   f"level in joules\n# TYPE {jhead} counter\n".encode())
         self._render_family(out, jname.encode(), prefixes_by_state, states,
                             "energy_uj", ztails, JOULE, native, fmt_float)
         out.append(f"# HELP {wname} Power consumption of cpu at {kind} "
@@ -373,9 +393,11 @@ class PowerCollector:
         self._render_family(out, wname.encode(), prefixes_by_state, states,
                             "power_uw", ztails, WATT, native, fmt_float)
         if is_process:
-            out.append(b"# HELP kepler_process_cpu_seconds_total Total "
-                       b"user and system time of the process in seconds\n"
-                       b"# TYPE kepler_process_cpu_seconds_total counter\n")
+            shead = self._header_name("kepler_process_cpu_seconds_total",
+                                      openmetrics)
+            out.append(f"# HELP {shead} Total user and system time of "
+                       f"the process in seconds\n"
+                       f"# TYPE {shead} counter\n".encode())
             self._render_family(out, b"kepler_process_cpu_seconds_total",
                                 prefixes_by_state, states, "seconds",
                                 [b"} "], 1.0, native, fmt_float,

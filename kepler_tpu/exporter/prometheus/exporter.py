@@ -119,15 +119,22 @@ class PrometheusExporter:
     def _handle(self, request) -> tuple[int, dict[str, str], bytes]:
         # content negotiation (reference enables OpenMetrics on its
         # promhttp handler): serve the OpenMetrics exposition when the
-        # scraper asks for it, classic text format otherwise
+        # scraper asks for it, classic text format otherwise. BOTH paths
+        # use the collectors' direct fast render — modern Prometheus
+        # negotiates OpenMetrics by default, so it is just as hot as
+        # classic; only the tiny aux registry goes through the stock
+        # renderer (which also supplies the `# EOF` terminator).
         accept = ""
         if request is not None and getattr(request, "headers", None):
             accept = request.headers.get("Accept") or ""
         if "application/openmetrics-text" in accept:
             from prometheus_client.openmetrics import exposition as om_exposition
+            payload = (b"".join(c.render_text(openmetrics=True)
+                                for c in self._power)
+                       + om_exposition.generate_latest(self._aux_registry))
             return (200,
                     {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
-                    om_exposition.generate_latest(self._registry))
+                    payload)
         payload = (b"".join(c.render_text() for c in self._power)
                    + fast_generate_latest(self._aux_registry))
         return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload

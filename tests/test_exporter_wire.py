@@ -301,6 +301,46 @@ class TestFastExpositionParity:
         mon.refresh()
         assert col.render_text() == generate_latest(registry)
 
+    def test_openmetrics_byte_parity(self):
+        """Prometheus negotiates OpenMetrics BY DEFAULT — the fast path
+        must be byte-identical to the stock OpenMetrics renderer too
+        (sample lines are shared with classic; counter headers carry the
+        base family name, the caller appends `# EOF`)."""
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as om_latest,
+        )
+
+        procs = [
+            MockProc(1, cpu=2.0),
+            MockProc(7, cpu=1.0, comm='we"ird\\name\n'),
+            MockProc(9, cpu=3.0, cgroups=[
+                f"/kubepods.slice/cri-containerd-{CID}.scope"]),
+        ]
+        mon, _, zones, clock = make_monitor(procs)
+        mon.refresh()
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        col, registry = self.make_registry(mon)
+        want = om_latest(registry)
+        assert col.render_text(openmetrics=True) + b"# EOF\n" == want
+        # cached scrape and after label churn, still identical
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        assert (col.render_text(openmetrics=True) + b"# EOF\n"
+                == om_latest(registry))
+        procs[0]._comm = "om-exec-rename"
+        self.advance(procs, zones, clock)
+        mon.refresh()
+        assert (col.render_text(openmetrics=True) + b"# EOF\n"
+                == om_latest(registry))
+        # classic render interleaved with OM: caches are shared, neither
+        # may poison the other
+        from prometheus_client.exposition import generate_latest
+
+        assert col.render_text() == generate_latest(registry)
+        assert (col.render_text(openmetrics=True) + b"# EOF\n"
+                == om_latest(registry))
+
     def test_parity_with_terminated_rows(self):
         from prometheus_client.exposition import generate_latest
 
