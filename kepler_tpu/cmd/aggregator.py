@@ -70,19 +70,18 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     if cfg.exporter.prometheus.enabled:
         from prometheus_client import CollectorRegistry
-        from prometheus_client.exposition import (
-            CONTENT_TYPE_LATEST,
-            generate_latest,
+
+        from kepler_tpu.exporter.prometheus.exporter import (
+            make_registry_handler,
         )
         registry = CollectorRegistry()
         registry.register(aggregator)
-
-        def metrics_handler(_request):
-            return (200, {"Content-Type": CONTENT_TYPE_LATEST},
-                    generate_latest(registry))
-
+        # ~2× the stock renderer at 1k-node fleets in BOTH negotiated
+        # formats (byte-identical; fastexpo falls back wholesale on
+        # anything beyond the simple kepler families)
         server.register("/metrics", "Metrics",
-                        "Fleet-level Prometheus metrics", metrics_handler)
+                        "Fleet-level Prometheus metrics",
+                        make_registry_handler(registry))
 
     services.append(SignalHandler())
     try:

@@ -124,10 +124,11 @@ class PrometheusExporter:
         # negotiates OpenMetrics by default, so it is just as hot as
         # classic; only the tiny aux registry goes through the stock
         # renderer (which also supplies the `# EOF` terminator).
-        accept = ""
-        if request is not None and getattr(request, "headers", None):
-            accept = request.headers.get("Accept") or ""
-        if "application/openmetrics-text" in accept:
+        from kepler_tpu.exporter.prometheus.fastexpo import (
+            wants_openmetrics,
+        )
+
+        if wants_openmetrics(request):
             from prometheus_client.openmetrics import exposition as om_exposition
             payload = (b"".join(c.render_text(openmetrics=True)
                                 for c in self._power)
@@ -142,3 +143,27 @@ class PrometheusExporter:
     @property
     def registry(self) -> CollectorRegistry:
         return self._registry
+
+
+def make_registry_handler(registry: CollectorRegistry):
+    """Generic /metrics handler over one registry with content
+    negotiation, both formats on the fast renderers (byte-identical to
+    the stock ones, with wholesale fallback). The aggregator's
+    fleet-metrics endpoint uses this; the node exporter has its own
+    handler because its power families bypass the registry entirely."""
+    from prometheus_client.openmetrics import exposition as om_exposition
+
+    from kepler_tpu.exporter.prometheus.fastexpo import (
+        fast_generate_openmetrics,
+        wants_openmetrics,
+    )
+
+    def handler(request) -> tuple[int, dict[str, str], bytes]:
+        if wants_openmetrics(request):
+            return (200,
+                    {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
+                    fast_generate_openmetrics(registry))
+        return (200, {"Content-Type": CONTENT_TYPE_LATEST},
+                fast_generate_latest(registry))
+
+    return handler

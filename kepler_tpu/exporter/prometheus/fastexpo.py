@@ -30,6 +30,9 @@ from prometheus_client.utils import floatToGoString
 
 _LEGACY_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LEGACY_LABEL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# OpenMetrics sample/family names: NO colons — the stock OM renderer
+# escapes colons to underscores, so colon names must take the fallback
+_OM_NAME = _LEGACY_LABEL
 
 # OpenMetrics sample suffixes that the classic format renders as trailing
 # gauges (mirrors generate_latest's om_samples munging)
@@ -67,6 +70,69 @@ def _escape_value(v: str) -> str:
 
 def _escape_doc(doc: str) -> str:
     return doc.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def wants_openmetrics(request) -> bool:
+    """Content negotiation shared by every /metrics handler: does the
+    scraper's Accept header ask for the OpenMetrics exposition? (Default
+    Prometheus does.)"""
+    accept = ""
+    if request is not None and getattr(request, "headers", None):
+        accept = request.headers.get("Accept") or ""
+    return "application/openmetrics-text" in accept
+
+
+def fast_generate_openmetrics(registry: Collector) -> bytes:
+    """Byte-identical ``openmetrics.exposition.generate_latest`` with
+    per-family label-name validation (the OM twin of
+    :func:`fast_generate_latest`). OM keeps each family's BASE name in
+    HELP/TYPE (no classic ``_total``/``_info`` munging), renders sample
+    lines identically, and terminates with ``# EOF``. Falls back to the
+    stock renderer for anything beyond the simple counter/gauge/info
+    families the kepler registries hold (exemplars, created timestamps,
+    non-legacy names)."""
+    from prometheus_client.openmetrics import exposition as om
+
+    output: list[str] = []
+    for metric in registry.collect():
+        mname = metric.name
+        if metric.type not in ("counter", "gauge", "info", "unknown"):
+            return om.generate_latest(registry)  # histograms etc.: stock
+        if not _OM_NAME.match(mname) or metric.unit:
+            # colon names get underscore-escaped by the stock renderer;
+            # units grow a suffix — both take the wholesale fallback
+            return om.generate_latest(registry)
+        # OM escapes quotes in HELP text too (classic does not); one
+        # chain, same order as the stock renderer's _escape(ALLOWUTF8)
+        doc = (metric.documentation.replace("\\", "\\\\")
+               .replace("\n", "\\n").replace('"', '\\"'))
+        output.append(f"# HELP {mname} {doc}\n")
+        output.append(f"# TYPE {mname} {metric.type}\n")
+        key_cache: tuple[str, ...] | None = None
+        sorted_keys: list[str] = []
+        for s in metric.samples:
+            if (s.timestamp is not None or s.exemplar is not None
+                    or not _OM_NAME.match(s.name)):
+                return om.generate_latest(registry)
+            if metric.type == "counter" and s.name.endswith("_created"):
+                return om.generate_latest(registry)
+            keys = tuple(s.labels)
+            if keys != key_cache:
+                if not all(_LEGACY_LABEL.match(k) for k in keys):
+                    return om.generate_latest(registry)
+                sorted_keys = sorted(keys)
+                key_cache = keys
+            labels = s.labels
+            if labels:
+                labelstr = "{%s}" % ",".join(
+                    f'{k}="{_escape_value(labels[k])}"'
+                    for k in sorted_keys)
+            else:
+                labelstr = ""
+            output.append(
+                f"{s.name}{labelstr} {floatToGoString(s.value)}\n")
+    output.append("# EOF\n")
+    return "".join(output).encode("utf-8")
 
 
 def fast_generate_latest(registry: Collector) -> bytes:

@@ -318,6 +318,92 @@ def make_sample(ts=100.0):
         zone_valid=np.ones(2, bool), usage_ratio=0.5, batch=batch)
 
 
+class TestFleetMetricsHandler:
+    def test_both_formats_byte_identical_to_stock(self, server):
+        """The aggregator's /metrics handler (make_registry_handler)
+        serves BOTH negotiated formats through the fast renderers —
+        byte-identical to prometheus_client's stock/OM renderers over a
+        live fleet registry."""
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import generate_latest
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as om_latest,
+        )
+
+        from kepler_tpu.exporter.prometheus.exporter import (
+            make_registry_handler,
+        )
+
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        post_report(server, make_report("node-a"))
+        post_report(server, make_report("node-b", seed=1))
+        agg.aggregate_once()
+        registry = CollectorRegistry()
+        registry.register(agg)
+        handler = make_registry_handler(registry)
+
+        class Classic:
+            headers = {"Accept": "text/plain"}
+
+        class OM:
+            headers = {"Accept": ("application/openmetrics-text;"
+                                  "version=1.0.0;q=0.5,text/plain;q=0.3")}
+
+        status, hdrs, body = handler(Classic())
+        assert status == 200 and "text/plain" in hdrs["Content-Type"]
+        assert body == generate_latest(registry)
+        assert b"kepler_fleet_node_cpu_watts" in body
+
+        status, hdrs, body = handler(OM())
+        assert status == 200
+        assert "openmetrics-text" in hdrs["Content-Type"]
+        assert body == om_latest(registry)
+        assert body.endswith(b"# EOF\n")
+
+        # bare request objects (tests, curl without Accept) get classic
+        status, hdrs, body = handler(None)
+        assert status == 200 and body == generate_latest(registry)
+
+    def test_om_fast_renderer_edge_parity(self):
+        """fast_generate_openmetrics promises byte-identity-or-fallback;
+        pin the edges review found: colon names (stock underscore-escapes
+        them → must fall back) and quoted HELP docs (OM escapes quotes,
+        classic does not)."""
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as om_latest,
+        )
+
+        from kepler_tpu.exporter.prometheus.fastexpo import (
+            fast_generate_openmetrics,
+        )
+
+        class Fams:
+            def __init__(self, fams):
+                self.fams = fams
+
+            def collect(self):
+                yield from self.fams
+
+        counter = CounterMetricFamily("kepler_a", "plain", labels=["l"])
+        counter.add_metric(["v"], 3.5)
+        for fams in (
+            [GaugeMetricFamily("job:foo:rate", "recording-rule name")],
+            [GaugeMetricFamily("x", 'doc with "quote" and \\ and \nnl')],
+            [counter],
+        ):
+            registry = CollectorRegistry()
+            registry.register(Fams(fams))
+            assert (fast_generate_openmetrics(registry)
+                    == om_latest(registry)), fams[0].name
+
+
 class TestAgent:
     def test_agent_end_to_end(self, server):
         agg = Aggregator(server, model_mode=None, node_bucket=8,
