@@ -37,9 +37,18 @@ Prints ONE JSON line:
   {"metric": "attribution_program_p99_ms_10k_pods", "value": <ms>,
    "unit": "ms", "vs_baseline": <1 ms / measured — >1 beats target>, ...}
 
-If the accelerator runtime wedges during init (tunnel loss), falls back to
-CPU after a timeout so the driver always gets its JSON line (flagged via
-"platform" in the extra fields).
+Wedge-proof capture (round 5): the script supervises ITSELF. The
+top-level invocation is a thin parent that runs the real benchmark as a
+child process, relays its output live, and — if the child dies or hangs
+without printing its JSON line — retries once on a sanitized CPU
+environment. Inside the child, accelerator health is established by an
+out-of-process probe BEFORE any in-process JAX device touch, because a
+wedged tunnel hangs ``jax.devices()`` in native code where no in-process
+guard works (SIGALRM handlers never run while the interpreter is stuck
+in a C call — verified against a live wedged tunnel; that hang cost
+round 4 its entire capture). The CPU escape that actually sticks is
+``jax.config.update("jax_platforms", "cpu")`` — the JAX_PLATFORMS env
+var alone is overridden by the ambient accelerator sitecustomize.
 """
 
 from __future__ import annotations
@@ -47,7 +56,7 @@ from __future__ import annotations
 import json
 import math
 import os
-import signal
+import subprocess
 import sys
 import time
 
@@ -56,41 +65,43 @@ N_WORKLOADS = 16  # ~10 pods/node padded to bucket → ~10k pods
 N_WORKLOADS_LARGE = 128  # throughput shape: ~100 pods/node, ~102k pods
 N_ZONES = 4  # package/core/dram/uncore
 TARGET_MS = 1.0  # north-star p99
-INIT_TIMEOUT_S = 180
+# generous: the probe already converts a wedged-at-start tunnel to CPU in
+# ≤ _PROBE_TIMEOUT_S, so this only guards a mid-run wedge
+TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("KEPLER_BENCH_TPU_TIMEOUT_S",
+                                           "2700"))
+CPU_ATTEMPT_TIMEOUT_S = 2100
+
+# the wedge-defense toolkit is shared with the driver's other entry
+# point (both scripts live at the repo root and run from it)
+from __graft_entry__ import (  # noqa: E402
+    SANITIZE_ENV_VARS,
+    _probe_accelerator,
+)
 
 
-def _init_jax_with_timeout():
-    """Import jax + touch devices; fall back to CPU if init hangs."""
+def _init_jax():
+    """Child-side init, guaranteed not to hang.
 
-    def on_timeout(*_):
-        raise TimeoutError
+    Probe the accelerator out-of-process; on failure pin THIS process to
+    CPU via ``jax.config.update`` (the escape verified to work even with
+    the accelerator plugin already registered).
+    """
+    want_cpu = bool(os.environ.get("KEPLER_BENCH_CPU_FALLBACK")
+                    or os.environ.get("JAX_PLATFORMS") == "cpu")
+    import jax
 
-    old = signal.signal(signal.SIGALRM, on_timeout)
-    signal.alarm(INIT_TIMEOUT_S)
-    try:
-        import jax
-
-        if (os.environ.get("KEPLER_BENCH_CPU_FALLBACK")
-                or os.environ.get("JAX_PLATFORMS") == "cpu"):
-            # an ambient accelerator shim may force jax_platforms at
-            # registration time; env vars alone don't stick (see
-            # tests/conftest.py)
-            jax.config.update("jax_platforms", "cpu")
-        devs = jax.devices()
-        signal.alarm(0)
-        return jax, devs[0].platform
-    except (TimeoutError, RuntimeError) as err:
-        signal.alarm(0)
-        print(f"accelerator init failed ({err!r}); retrying on CPU",
+    if not want_cpu and not _probe_accelerator():
+        print("accelerator probe failed or timed out; running on CPU",
               file=sys.stderr)
-        os.execvpe(
-            sys.executable,
-            [sys.executable, os.path.abspath(__file__)],
-            {**os.environ, "JAX_PLATFORMS": "cpu",
-             "KEPLER_BENCH_CPU_FALLBACK": "1"},
-        )
-    finally:
-        signal.signal(signal.SIGALRM, old)
+        os.environ["KEPLER_BENCH_CPU_FALLBACK"] = "1"
+        want_cpu = True
+    if want_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as err:  # backend already up — report, proceed
+            print(f"could not pin CPU platform ({err!r})", file=sys.stderr)
+    devs = jax.devices()
+    return jax, devs[0].platform
 
 
 def make_batch(n_nodes, n_workloads, pods_lo, pods_hi, seed=0):
@@ -122,7 +133,7 @@ def make_batch(n_nodes, n_workloads, pods_lo, pods_hi, seed=0):
 
 
 def main() -> None:
-    jax, platform = _init_jax_with_timeout()
+    jax, platform = _init_jax()
 
     import jax.numpy as jnp
     import numpy as np
@@ -350,5 +361,84 @@ def main() -> None:
         sys.exit(1)
 
 
+def _relay_child(env: dict, timeout_s: float):
+    """Run this script as a child, relay output live, watch for the row.
+
+    Returns ``(rc, saw_json)`` where ``rc`` is None if the child was
+    killed on timeout and ``saw_json`` is True iff a line parsing as the
+    benchmark row (JSON object with a "metric" key) reached stdout.
+    """
+    import threading
+
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    saw_json = [False]
+
+    def _pump_out(src):
+        for line in src:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+            s = line.strip()
+            if s.startswith("{"):
+                try:
+                    if "metric" in json.loads(s):
+                        saw_json[0] = True
+                except ValueError:
+                    pass
+
+    def _pump_err(src):
+        for line in src:
+            sys.stderr.write(line)
+            sys.stderr.flush()
+
+    pumps = [threading.Thread(target=_pump_out, args=(proc.stdout,),
+                              daemon=True),
+             threading.Thread(target=_pump_err, args=(proc.stderr,),
+                              daemon=True)]
+    for t in pumps:
+        t.start()
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rc = None
+    for t in pumps:
+        t.join(timeout=10)
+    return rc, saw_json[0]
+
+
+def _supervise() -> None:
+    """Parent: TPU attempt, then sanitized-CPU retry, then honest row.
+
+    The driver must ALWAYS get a JSON line — round 4 got none (rc=1, a
+    mid-init UNAVAILABLE escaped the old in-process guard).
+    """
+    env = {**os.environ, "KEPLER_BENCH_CHILD": "1"}
+    rc, saw = _relay_child(env, TPU_ATTEMPT_TIMEOUT_S)
+    if saw:
+        sys.exit(1 if rc is None else rc)  # measurement done; respect gates
+    print(f"bench child produced no result row (rc={rc}); retrying on a "
+          "sanitized CPU environment", file=sys.stderr)
+    env_cpu = {**env, "JAX_PLATFORMS": "cpu", "KEPLER_BENCH_CPU_FALLBACK": "1"}
+    for var in SANITIZE_ENV_VARS:
+        env_cpu.pop(var, None)
+    rc, saw = _relay_child(env_cpu, CPU_ATTEMPT_TIMEOUT_S)
+    if saw:
+        sys.exit(1 if rc is None else rc)
+    # total failure — still print an honest row so the capture has data
+    print(json.dumps({
+        "metric": "attribution_program_p99_ms_10k_pods", "value": None,
+        "unit": "ms", "vs_baseline": None,
+        "error": f"both bench attempts failed (last rc={rc})",
+        "platform": "none"}))
+    sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("KEPLER_BENCH_CHILD"):
+        main()
+    else:
+        _supervise()

@@ -192,12 +192,13 @@ class MsrPowerMeter:
                             cpu, err)
                 continue
             for register, stem in _ENERGY_MSRS:
-                # accept the stem OR a suffixed spelling ("package-0") —
-                # the same filter config must select the same zones on
-                # either backend (sysfs matches via canonical_zone_key)
-                if self._filter and stem not in self._filter and not any(
-                        f == f"{stem}-{pkg}" or f.startswith(f"{stem}-")
-                        for f in self._filter):
+                # accept the bare stem OR the exact suffixed spelling for
+                # THIS package ("package-0") — matching any "stem-*"
+                # would let a 'package-1' filter enable the zone on every
+                # socket, diverging from the sysfs meter's
+                # canonical_zone_key semantics on multi-socket hosts
+                if (self._filter and stem not in self._filter
+                        and f"{stem}-{pkg}" not in self._filter):
                     continue
                 try:
                     read_msr(msr_path, register)

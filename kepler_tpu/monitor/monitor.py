@@ -113,7 +113,8 @@ class PowerMonitor:
 
         # cumulative f64 accumulators: kind → dense row store (id-keyed)
         self._cumulative: dict[str, _CumStore] = {}
-        # per-kind meta tuple cache keyed on (informer meta_gen, view id)
+        # per-kind meta tuple cache: (meta_gen, view-dict ref, rows);
+        # validated by gen equality + dict IDENTITY (see _meta_rows)
         self._meta_rows_cache: dict[str, tuple] = {}
         self._node_energy = np.zeros(0)
         self._node_active = np.zeros(0)
@@ -336,15 +337,26 @@ class PowerMonitor:
         # shutdown() joins it instead
         t = threading.Thread(target=warm, name="kepler-bucket-prewarm",
                              daemon=False)
-        self._prewarm_thread = t
+        # track EVERY live prewarm, not just the latest: two quick bucket
+        # crossings can overlap compiles, and join_prewarm/shutdown must
+        # wait for all of them or an orphan non-daemon thread outlives
+        # shutdown() and delays interpreter exit
+        self._prewarm_threads = [
+            p for p in getattr(self, "_prewarm_threads", [])
+            if p.is_alive()]
+        self._prewarm_threads.append(t)
         t.start()
 
     def join_prewarm(self, timeout: float | None = None) -> None:
-        """Wait for an in-flight bucket prewarm (benchmarks/tests: keep
-        the background compile out of timed windows)."""
-        t = getattr(self, "_prewarm_thread", None)
-        if t is not None:
-            t.join(timeout)
+        """Wait for ALL in-flight bucket prewarms (benchmarks/tests: keep
+        the background compiles out of timed windows)."""
+        deadline = (None if timeout is None
+                    else _time.perf_counter() + timeout)
+        for t in getattr(self, "_prewarm_threads", []):
+            if deadline is None:
+                t.join()
+            else:
+                t.join(max(0.0, deadline - _time.perf_counter()))
 
     def _zone_batch_plan(self):
         """(paths, per-zone slices) when EVERY zone supports batched raw
@@ -493,12 +505,20 @@ class PowerMonitor:
         gen = getattr(res, "meta_gen", None)
         if gen is None:
             return tuple(f(o) for o in running.values())
-        key = (gen, id(running), len(running))
+        # The cache entry holds a STRONG reference to the view dict and
+        # validates it with ``is``: identity then guarantees membership
+        # AND iteration order are unchanged (a dict is append-ordered and
+        # the informer never reorders in place), while ``meta_gen``
+        # covers in-place label mutations. An id()-based key would be
+        # unsound on the legacy informer path, which builds a fresh dict
+        # every tick — a recycled address plus an unchanged gen could
+        # serve another membership's meta rows.
         cached = self._meta_rows_cache.get(kind)
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        if (cached is not None and cached[0] == gen
+                and cached[1] is running):
+            return cached[2]
         rows = tuple(f(o) for o in running.values())
-        self._meta_rows_cache[kind] = (key, rows)
+        self._meta_rows_cache[kind] = (gen, running, rows)
         return rows
 
     def _accumulate_workloads(self, batch: FeatureBatch, result, w: int

@@ -62,11 +62,20 @@ class FastProcFSReader(ProcFSReader):
         """Cold-path reader for one PID (classification/comm/exe)."""
         return ProcFSInfo(self._procfs, pid)
 
-    def read_proc_files(self, relpaths: list[str], per_cap: int = 16384
-                        ) -> list[bytes | None]:
+    #: slot size for read_proc_files when the caller doesn't override it.
+    #: Consumers that need truncation detection (informer
+    #: _reread_if_truncated) read THIS attribute rather than duplicating
+    #: the number — a content of exactly cap-1 bytes means ReadSmallFile
+    #: hit the slot end.
+    batch_read_cap: int = 16384
+
+    def read_proc_files(self, relpaths: list[str],
+                        per_cap: int | None = None) -> list[bytes | None]:
         """Batch-read ``<procfs>/<relpath>`` files in one threaded C call
         (first-sight classification bursts stay native)."""
         paths = [f"{self._procfs}/{rel}" for rel in relpaths]
+        if per_cap is None:
+            per_cap = self.batch_read_cap
         return self._scanner.read_files(paths, per_cap=per_cap)
 
     def read_proc_links(self, relpaths: list[str]) -> list[str | None]:

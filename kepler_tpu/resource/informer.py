@@ -456,13 +456,16 @@ class ResourceInformer:
                     continue  # truncated/garbage content mid-exit
         return out
 
-    # slot size used by read_proc_files (fast_procfs default); a content
-    # of exactly cap-1 bytes means ReadSmallFile hit the slot end
+    # fallback slot size when the reader doesn't expose its own cap; a
+    # content of exactly cap-1 bytes means ReadSmallFile hit the slot end
     _BATCH_FILE_CAP = 16384
 
     def _reread_if_truncated(self, pid: int, name: str,
                              content: bytes | None) -> bytes | None:
-        if content is None or len(content) < self._BATCH_FILE_CAP - 1:
+        # derive the threshold from the READER's actual cap so a changed
+        # per_cap default can't silently disable truncation detection
+        cap = getattr(self._fs, "batch_read_cap", self._BATCH_FILE_CAP)
+        if content is None or len(content) < cap - 1:
             return content
         procfs = getattr(self._fs, "_procfs", "/proc")
         try:

@@ -15,16 +15,18 @@ reference's ``server_tls_test.go`` exercises):
       bob: $5$rounds=...      # or crypt(3) sha256/sha512 from stdlib
 
 Password hashes: exporter-toolkit mandates bcrypt; that module is optional
-here, so crypt(3) ``$5$``/``$6$`` hashes (``python -c "import crypt;
-print(crypt.crypt('pw', crypt.mksalt(crypt.METHOD_SHA512)))"``) are
-accepted as the always-available alternative.
+here, so SHA-crypt ``$5$``/``$6$`` hashes are accepted as the
+always-available alternative, verified by the pure-Python
+:mod:`kepler_tpu.server.shacrypt` (the stdlib ``crypt`` module this path
+once used was removed in Python 3.13). Generate one with
+``python -c "from kepler_tpu.server.shacrypt import mksha512crypt;
+print(mksha512crypt('pw'))"``.
 """
 
 from __future__ import annotations
 
 import base64
 import binascii
-import hmac
 import logging
 from dataclasses import dataclass, field
 from typing import Callable, Mapping
@@ -84,17 +86,10 @@ def _verify_hash_supported(user: str, h: str) -> None:
                 "instead") from None
         return
     if h.startswith(("$5$", "$6$")):
-        try:
-            import crypt  # noqa: F401
-        except ImportError:
-            raise ValueError(
-                f"basic_auth_users[{user!r}]: crypt(3) hash but the crypt "
-                "module is unavailable (removed in Python 3.13); install "
-                "bcrypt and use a $2*$ hash") from None
-        return
+        return  # SHA-crypt: verified by the bundled pure-Python shacrypt
     raise ValueError(
         f"basic_auth_users[{user!r}]: unsupported hash format "
-        f"{h[:4]!r}… (supported: bcrypt $2*$, crypt(3) $5$/$6$)")
+        f"{h[:4]!r}… (supported: bcrypt $2*$, SHA-crypt $5$/$6$)")
 
 
 def _check_password(password: str, hashed: str) -> bool:
@@ -102,9 +97,9 @@ def _check_password(password: str, hashed: str) -> bool:
         import bcrypt
 
         return bcrypt.checkpw(password.encode(), hashed.encode())
-    import crypt  # deprecated but present through 3.12; gated by load-time
+    from kepler_tpu.server import shacrypt
 
-    return hmac.compare_digest(crypt.crypt(password, hashed), hashed)
+    return shacrypt.verify(password, hashed)
 
 
 def make_authenticator(users: Mapping[str, str]

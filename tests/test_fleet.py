@@ -431,12 +431,12 @@ class TestAgent:
         # aggregator behind web-config basic auth: creds ride in the
         # endpoint URL userinfo (kepler_tpu/server/webconfig.py)
         import base64
-        import crypt
         import http.client
 
+        from kepler_tpu.server.shacrypt import sha_crypt
         from kepler_tpu.server.webconfig import make_authenticator
 
-        hashed = crypt.crypt("pw", crypt.mksalt(crypt.METHOD_SHA256))
+        hashed = sha_crypt("pw", "$5$rounds=1000$fleetauthsalt")
         s = APIServer(listen_addresses=["127.0.0.1:0"],
                       basic_auth_check=make_authenticator({"agent": hashed}))
         s.init()
