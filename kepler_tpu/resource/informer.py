@@ -264,6 +264,13 @@ class ResourceInformer:
             self._rebuild_rows(pids, cpus, comms)
             return
         deltas = cpus - st.cpu
+        # counter REGRESSION (pid reuse): the clamp hides the drop from
+        # the delta, but the object's total must still follow the
+        # kernel's current value — the exporter renders
+        # process_cpu_seconds_total from the object view, and the legacy
+        # path refreshes it every tick (parity pinned by the dual-path
+        # fuzz in tests/test_resource.py, which caught this diverging)
+        regressed = np.flatnonzero(deltas < 0.0)
         np.maximum(deltas, 0.0, out=deltas)
         active = deltas > _RECLASSIFY_EPSILON
         changed = np.flatnonzero(active)
@@ -271,6 +278,9 @@ class ResourceInformer:
         self._touch_changed(st.procs, changed.tolist(), deltas, cpus, comms,
                             pids)
         procs = st.procs
+        for i in regressed.tolist():
+            procs[i].cpu_time_delta = 0.0
+            procs[i].cpu_total_time = float(cpus[i])
         for i in went_idle.tolist():
             procs[i].cpu_time_delta = 0.0
         st.cpu = cpus
@@ -348,12 +358,20 @@ class ResourceInformer:
         # deltas: first sight counts its whole total as this window's
         # delta (legacy/reference semantics); known rows diff the cache
         deltas = cpus.copy()
+        regressed = np.zeros(n, bool)
         if st_old is not None:
             kr = prev_row_np[known]
-            deltas[known] = np.maximum(cpus[known] - st_old.cpu[kr], 0.0)
+            raw = cpus[known] - st_old.cpu[kr]
+            deltas[known] = np.maximum(raw, 0.0)
+            regressed[known] = raw < 0.0
         active = deltas > _RECLASSIFY_EPSILON
         self._touch_changed(procs, np.flatnonzero(known & active).tolist(),
                             deltas, cpus, comms, pids)
+        # counter regression (pid reuse): totals follow the kernel even
+        # though the clamped delta is 0 — see _refresh_from_arrays
+        for i in np.flatnonzero(regressed).tolist():
+            procs[i].cpu_time_delta = 0.0
+            procs[i].cpu_total_time = float(cpus[i])
         if st_old is not None:
             was_active = np.zeros(n, bool)
             was_active[known] = st_old.active[prev_row_np[known]]
