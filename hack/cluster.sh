@@ -55,12 +55,67 @@ deploy() {
     echo "deployed; scrape any agent at :28282/metrics"
 }
 
+e2e() {
+    # Scrape assertions against a deployed cluster (CI lane: the analog
+    # of the reference's k8s-equinix workflow checks). Port-forwards both
+    # services and asserts the core series exist.
+    need kubectl
+    local pf_pids=()
+    cleanup() { kill "${pf_pids[@]}" 2>/dev/null || true; }
+    trap cleanup RETURN
+
+    kubectl -n kepler-tpu wait --for=condition=ready pod \
+        -l app.kubernetes.io/name=kepler-tpu --timeout=180s
+
+    kubectl -n kepler-tpu port-forward svc/kepler-tpu 28282:28282 &
+    pf_pids+=($!)
+    kubectl -n kepler-tpu port-forward svc/kepler-tpu-aggregator \
+        28283:28283 &
+    pf_pids+=($!)
+    sleep 3
+
+    echo "--- agent /metrics"
+    # retry: the first scrape may race the first monitor window + jit
+    for i in $(seq 1 20); do
+        if curl -sf localhost:28282/metrics |
+            grep -q '^kepler_node_cpu_joules_total'; then
+            break
+        fi
+        [ "$i" = 20 ] && {
+            echo "error: kepler_node_cpu_joules_total never appeared" >&2
+            exit 1
+        }
+        sleep 3
+    done
+    curl -sf localhost:28282/metrics | grep -c '^kepler_' |
+        xargs echo "agent kepler_ series:"
+    curl -sf localhost:28282/metrics |
+        grep -q '^kepler_process_cpu_watts' ||
+        { echo "error: no process attribution series" >&2; exit 1; }
+
+    echo "--- aggregator /metrics"
+    for i in $(seq 1 20); do
+        if curl -sf localhost:28283/metrics | grep -q '^kepler_fleet_'; then
+            break
+        fi
+        [ "$i" = 20 ] && {
+            echo "error: kepler_fleet_* never appeared" >&2
+            exit 1
+        }
+        sleep 3
+    done
+    curl -sf localhost:28283/metrics | grep -c '^kepler_fleet_' |
+        xargs echo "aggregator kepler_fleet_ series:"
+    echo "e2e: OK"
+}
+
 case "${1:-}" in
 up) cluster_up ;;
 down) cluster_down ;;
 deploy) deploy ;;
+e2e) e2e ;;
 *)
-    echo "usage: $0 {up|down|deploy}" >&2
+    echo "usage: $0 {up|down|deploy|e2e}" >&2
     exit 1
     ;;
 esac
