@@ -1,0 +1,137 @@
+"""Driver entry-point wedge defenses (__graft_entry__.py, bench.py).
+
+Round 4 lost its entire performance capture to a wedged TPU tunnel:
+``jax.devices()`` hung in native code (where SIGALRM cannot fire) and a
+post-init UNAVAILABLE escaped the old guard. These tests pin the
+defenses that round 5 added — an out-of-process probe, the sanitized
+child environment, and the supervised retry — without needing a TPU or
+a wedge: the probe and supervisor are exercised against stub
+executables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as ge  # noqa: E402
+
+
+class TestSanitizedEnv:
+    def test_covers_the_known_plugin_hooks(self):
+        # the vars that re-bind a child to the accelerator; missing one
+        # silently reintroduces the round-4 wedge
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "PJRT_NAMES_AND_LIBRARY_PATHS", "JAX_PLATFORM_NAME"):
+            assert var in ge.SANITIZE_ENV_VARS
+
+    def test_bench_shares_the_single_list(self):
+        import bench
+
+        assert bench.SANITIZE_ENV_VARS is ge.SANITIZE_ENV_VARS
+        assert bench._probe_accelerator is ge._probe_accelerator
+
+
+class TestProbe:
+    def test_probe_false_on_failing_child(self, monkeypatch):
+        monkeypatch.setattr(sys, "executable", "/bin/false")
+        assert ge._probe_accelerator(timeout_s=10) is False
+
+    def test_probe_false_on_hang(self, monkeypatch):
+        # a child that never answers must be killed by the timeout —
+        # this is the wedge scenario itself
+        monkeypatch.setattr(sys, "executable", "/bin/sleep")
+        assert ge._probe_accelerator(timeout_s=1) is False
+
+    def test_probe_requires_the_compile_leg(self, tmp_path, monkeypatch):
+        # a fake python that "lists devices" but never prints probe-ok
+        # (the round-4 half-up tunnel) must fail the probe
+        stub = tmp_path / "fake-python"
+        stub.write_text("#!/bin/sh\necho devices-listed\n")
+        stub.chmod(0o755)
+        monkeypatch.setattr(sys, "executable", str(stub))
+        assert ge._probe_accelerator(timeout_s=10) is False
+
+    def test_backend_initialized_reflects_jax_state(self):
+        # conftest initializes the CPU backend for the test session
+        import jax
+
+        jax.devices()
+        assert ge._backend_initialized() is True
+
+
+class TestBenchSupervisor:
+    def _relay(self, tmp_path, monkeypatch, script, timeout_s=30):
+        import bench
+
+        stub = tmp_path / "child.py"
+        stub.write_text(script)
+        real_popen = __import__("subprocess").Popen
+
+        def popen(cmd, **kw):
+            return real_popen([sys.executable, "-u", str(stub)], **kw)
+
+        monkeypatch.setattr(bench.subprocess, "Popen", popen)
+        return bench._relay_child(dict(os.environ), timeout_s)
+
+    def test_row_detected_and_rc_respected(self, tmp_path, monkeypatch,
+                                           capfd):
+        rc, saw = self._relay(
+            tmp_path, monkeypatch,
+            "import json, sys\n"
+            "print(json.dumps({'metric': 'x', 'value': 1}))\n"
+            "sys.exit(1)\n")  # gate failure AFTER the row
+        assert (rc, saw) == (1, True)
+        assert '"metric"' in capfd.readouterr().out
+
+    def test_no_row_on_crash(self, tmp_path, monkeypatch):
+        rc, saw = self._relay(
+            tmp_path, monkeypatch,
+            "import sys\nprint('no json here')\nsys.exit(3)\n")
+        assert (rc, saw) == (3, False)
+
+    def test_hang_killed_and_reported(self, tmp_path, monkeypatch):
+        rc, saw = self._relay(
+            tmp_path, monkeypatch,
+            "import time\ntime.sleep(600)\n", timeout_s=2)
+        assert (rc, saw) == (None, False)
+
+    def test_malformed_json_is_not_a_row(self, tmp_path, monkeypatch):
+        rc, saw = self._relay(
+            tmp_path, monkeypatch,
+            "print('{not json')\nprint('{\"other\": 1}')\n")
+        assert (rc, saw) == (0, False)
+
+
+class TestDryrunSubprocessEnv:
+    def test_child_env_is_sanitized(self, monkeypatch):
+        """_dryrun_in_subprocess must strip every plugin hook and force
+        the virtual CPU mesh; intercept Popen to inspect the env."""
+        captured = {}
+
+        class FakeProc:
+            stdout = iter(())
+            stderr = iter(())
+
+            def wait(self, timeout=None):
+                return 0
+
+        def popen(cmd, env=None, **kw):
+            captured.update(env or {})
+            return FakeProc()
+
+        monkeypatch.setattr(ge.subprocess if hasattr(ge, "subprocess")
+                            else __import__("subprocess"), "Popen", popen)
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+        ge._dryrun_in_subprocess(4)
+        for var in ge.SANITIZE_ENV_VARS:
+            assert var not in captured, var
+        assert captured["JAX_PLATFORMS"] == "cpu"
+        assert ("--xla_force_host_platform_device_count=4"
+                in captured["XLA_FLAGS"])
