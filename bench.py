@@ -272,38 +272,46 @@ def main() -> None:
     acc_fields = run_all(packed_program=program, packed_batch=batch,
                          packed_params=params)
 
+    def host_leg(module, args, timeout, error_key):
+        """Run a CPU-side benchmark module, parse its JSON row. Errors
+        never sink the headline — they land in ``error_key`` instead
+        (with the child's stderr tail when it produced no row)."""
+        cp = None
+        try:
+            cp = subprocess.run(
+                [sys.executable, "-m", module, *args],
+                capture_output=True, timeout=timeout, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            return json.loads(cp.stdout.strip().splitlines()[-1])
+        except Exception as err:
+            detail = repr(err)[:200]
+            if cp is not None and not cp.stdout.strip():
+                detail += f" | stderr: {cp.stderr[-200:]}"
+            return {error_key: detail}
+
     # ---- on-node scrape-to-export (host path, the reference's whole hot
     # loop) — subprocess so attribution runs on host CPU, the node-agent
     # configuration (agents don't own chips; the aggregator does) --------
-    node_fields = {}
-    try:
-        import subprocess
+    node_fields = host_leg(
+        "benchmarks.node_path", ["--procs", "10000", "--iters", "9"],
+        900, "node_scrape_error")
 
-        cp = subprocess.run(
-            [sys.executable, "-m", "benchmarks.node_path",
-             "--procs", "10000", "--iters", "9"],
-            capture_output=True, timeout=900, text=True,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        node_fields = json.loads(cp.stdout.strip().splitlines()[-1])
-    except Exception as err:  # never sink the headline on a host hiccup
-        node_fields = {"node_scrape_error": repr(err)[:200]}
+    # ---- aggregator window host legs (assembly + scatter @1024×~100,
+    # gated p50 ≤ 10 ms and p99 ≤ AGG_HOST_P99_BUDGET_MS, default 20 ms
+    # — the ratchet VERDICT r4 item 9 asked for) -----------------------
+    row = host_leg("benchmarks.scenarios",
+                   ["--only", "aggregator-window", "--iters", "12"],
+                   900, "aggwin_error")
+    aggwin_fields = {(k if k.startswith("aggwin_") else f"aggwin_{k}"): v
+                     for k, v in row.items() if k != "scenario"}
 
     # ---- aggregator ingest soak (live service, 1000 agents, 60 s) ------
-    soak_fields = {}
-    try:
-        import subprocess
-
-        cp = subprocess.run(
-            [sys.executable, "-m", "benchmarks.soak",
-             "--agents", os.environ.get("KEPLER_BENCH_SOAK_AGENTS", "1000"),
-             "--seconds", os.environ.get("KEPLER_BENCH_SOAK_SECONDS", "60")],
-            capture_output=True, timeout=600, text=True,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        soak_fields = json.loads(cp.stdout.strip().splitlines()[-1])
-    except Exception as err:  # never sink the headline on a soak hiccup
-        soak_fields = {"soak_error": repr(err)[:200]}
+    soak_fields = host_leg(
+        "benchmarks.soak",
+        ["--agents", os.environ.get("KEPLER_BENCH_SOAK_AGENTS", "1000"),
+         "--seconds", os.environ.get("KEPLER_BENCH_SOAK_SECONDS", "60")],
+        600, "soak_error")
 
     pods = int(np.asarray(batch.workload_valid).sum())
     result = {
@@ -342,6 +350,7 @@ def main() -> None:
     result.update({k: (round(v, 8) if isinstance(v, float) else v)
                    for k, v in acc_fields.items()})
     result.update(node_fields)
+    result.update(aggwin_fields)
     result.update(soak_fields)
     print(json.dumps(result))
     # gates with teeth (after the JSON so the driver always gets the row):
@@ -356,6 +365,12 @@ def main() -> None:
         failed = True
     if soak_fields.get("soak_ok") is False:
         print("GATE: aggregator ingest soak failed its SLOs", file=sys.stderr)
+        failed = True
+    if aggwin_fields.get("aggwin_within_budget") is False:
+        print(f"GATE: aggregator window host legs over budget "
+              f"(p50 {aggwin_fields.get('aggwin_host_p50_ms')} ms, "
+              f"p99 {aggwin_fields.get('aggwin_host_p99_ms')} ms)",
+              file=sys.stderr)
         failed = True
     if failed:
         sys.exit(1)

@@ -193,6 +193,13 @@ def run_node_path_scenario(n_procs: int) -> dict:
 
 AGG_HOST_BUDGET_MS = 10.0  # assembly+scatter per window @1024×128 (the
 # VERDICT r3 item-1 gate: host-side cost must not dominate the window)
+# p99 ratchet (VERDICT r4 item 9): measured host p99 on the round-5
+# capture host was 11.6-15.5 ms across runs (shared-host noise); budget
+# = measured worst + ~30% margin so a real regression FAILS rather than
+# drifts while scheduler jitter doesn't flake the lane. Override to
+# re-ratchet from a new driver capture without a code change.
+AGG_HOST_P99_BUDGET_MS = float(os.environ.get(
+    "KEPLER_AGG_HOST_P99_BUDGET_MS", "20.0"))
 
 
 def run_aggregator_window_scenario(iters: int) -> dict:
@@ -255,7 +262,10 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "scatter_ms": round(s["last_scatter_ms"], 3),
         "window_p50_ms": round(window_ms[len(window_ms) // 2], 3),
         "budget_ms": AGG_HOST_BUDGET_MS,
-        "within_budget": host_ms[len(host_ms) // 2] <= AGG_HOST_BUDGET_MS,
+        "p99_budget_ms": AGG_HOST_P99_BUDGET_MS,
+        "within_budget": (
+            host_ms[len(host_ms) // 2] <= AGG_HOST_BUDGET_MS
+            and host_ms[-1] <= AGG_HOST_P99_BUDGET_MS),
     }
 
 
@@ -274,7 +284,28 @@ def main() -> None:
     p.add_argument("--node-procs", type=int, default=10_000,
                    help="process count for the on-node scrape-to-export "
                         "row (0 disables it; CI may shrink it)")
+    p.add_argument("--only", choices=["aggregator-window"],
+                   help="run just one scenario and print its row "
+                        "(bench.py uses this to fold the aggregator "
+                        "window legs into BENCH_r{N}.json)")
     args = p.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # an ambient accelerator shim may force the platform at
+        # registration; the env var alone doesn't stick (cf. bench.py)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    if args.only == "aggregator-window":
+        row = run_aggregator_window_scenario(max(5, args.iters // 2))
+        print(json.dumps(row))
+        if not row["within_budget"]:
+            print(f"BUDGET VIOLATION: aggregator-window host p50 "
+                  f"{row['host_p50_ms']} / p99 {row['host_p99_ms']} ms",
+                  file=sys.stderr)
+            sys.exit(1)
+        return
 
     import jax
     import jax.numpy as jnp
@@ -367,7 +398,9 @@ def main() -> None:
     if not agg_row["within_budget"]:
         failures.append(
             f"aggregator-window: host p50 {agg_row['host_p50_ms']} ms "
-            f"exceeds budget {AGG_HOST_BUDGET_MS} ms (assembly "
+            f"(budget {AGG_HOST_BUDGET_MS}) or p99 "
+            f"{agg_row['host_p99_ms']} ms (budget "
+            f"{AGG_HOST_P99_BUDGET_MS}) over budget (assembly "
             f"{agg_row['assembly_ms']} + scatter {agg_row['scatter_ms']})")
 
     row = run_temporal_scenario(mesh, args.backend, on_tpu, args.iters,

@@ -42,11 +42,19 @@ class TestProbe:
         monkeypatch.setattr(sys, "executable", "/bin/false")
         assert ge._probe_accelerator(timeout_s=10) is False
 
-    def test_probe_false_on_hang(self, monkeypatch):
+    def test_probe_false_on_hang(self, tmp_path, monkeypatch):
         # a child that never answers must be killed by the timeout —
-        # this is the wedge scenario itself
-        monkeypatch.setattr(sys, "executable", "/bin/sleep")
+        # this is the wedge scenario itself (the stub blocks regardless
+        # of the -c arguments the probe passes)
+        stub = tmp_path / "hang"
+        stub.write_text("#!/bin/sh\nexec sleep 600\n")
+        stub.chmod(0o755)
+        monkeypatch.setattr(sys, "executable", str(stub))
+        import time
+
+        t0 = time.monotonic()
         assert ge._probe_accelerator(timeout_s=1) is False
+        assert time.monotonic() - t0 >= 0.9, "timeout never engaged"
 
     def test_probe_requires_the_compile_leg(self, tmp_path, monkeypatch):
         # a fake python that "lists devices" but never prints probe-ok
@@ -125,8 +133,7 @@ class TestDryrunSubprocessEnv:
             captured.update(env or {})
             return FakeProc()
 
-        monkeypatch.setattr(ge.subprocess if hasattr(ge, "subprocess")
-                            else __import__("subprocess"), "Popen", popen)
+        monkeypatch.setattr("subprocess.Popen", popen)
         monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
         monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
         ge._dryrun_in_subprocess(4)
