@@ -107,6 +107,18 @@ compose-up: ## dev stack: agent + aggregator + prometheus + grafana
 compose-down:
 	cd compose/dev && docker compose down -v
 
+.PHONY: monitoring-up
+monitoring-up: ## standalone prometheus+grafana overlay (compose/monitoring)
+	cd compose/monitoring && docker compose up -d
+
+.PHONY: monitoring-down
+monitoring-down:
+	cd compose/monitoring && docker compose down -v
+
+.PHONY: cluster-e2e
+cluster-e2e: ## scrape assertions against the deployed kind cluster
+	hack/cluster.sh e2e
+
 .PHONY: cluster-up
 cluster-up: ## kind dev cluster (hack/cluster.sh)
 	CLUSTER_NAME=$(CLUSTER_NAME) hack/cluster.sh up
