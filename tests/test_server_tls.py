@@ -100,8 +100,11 @@ class TestShaCrypt:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore", DeprecationWarning)
             for _ in range(40):
+                # lengths cross the 32/64-byte digest boundaries where
+                # the spec's B/P/S block-stretching changes behavior
                 pw = "".join(rng.choice(string.printable[:94])
-                             for _ in range(rng.randint(0, 40)))
+                             for _ in range(rng.choice(
+                                 [0, 7, 31, 32, 33, 40, 63, 64, 65, 128])))
                 salt = "".join(rng.choice(chars)
                                for _ in range(rng.randint(0, 16)))
                 variant = rng.choice("56")
