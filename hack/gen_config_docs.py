@@ -56,6 +56,10 @@ DESCRIPTIONS = {
                               "tracking, negative is unbounded.",
     "monitor.min_terminated_energy_threshold":
         "Joules a terminated workload must have consumed to be tracked.",
+    "monitor.stall_after": "Watchdog threshold: a refresh loop silent "
+                           "longer than this is flagged stalled and the "
+                           "snapshot marked stale on `/healthz` "
+                           "(`0` = auto, 3 × `monitor.interval`).",
     "rapl.zones": "Zone-name filter (e.g. `[package, dram]`); empty "
                   "means every discovered zone.",
     "msr.enabled": "Opt-in MSR fallback: read RAPL counters from "
@@ -139,6 +143,38 @@ DESCRIPTIONS = {
                                           "pruned.",
     "aggregator.node_mode": "Agent: report as a `ratio` (RAPL ground "
                             "truth) or `model` (estimator-served) node.",
+    "aggregator.backoff_initial": "Agent: initial send-retry backoff "
+                                  "(exponential, jittered).",
+    "aggregator.backoff_max": "Agent: send-retry backoff ceiling.",
+    "aggregator.breaker_threshold": "Agent: consecutive send failures "
+                                    "that open the circuit breaker "
+                                    "(sends are shed while open).",
+    "aggregator.breaker_cooldown": "Agent: breaker cooldown before a "
+                                   "half-open probe (doubles per failed "
+                                   "probe, capped).",
+    "aggregator.flush_timeout": "Agent: bound on the best-effort flush "
+                                "of queued reports during shutdown "
+                                "(a clean drain delivers its final "
+                                "window).",
+    "aggregator.skew_tolerance": "Aggregator: quarantine reports whose "
+                                 "sender clock is skewed beyond this "
+                                 "(`0` disables the check).",
+    "aggregator.degraded_ttl": "Aggregator: how long a node stays marked "
+                               "degraded on `/healthz` after its last "
+                               "quarantined report.",
+    "service.restart_max": "Supervised restarts per crashing service "
+                           "before the group fails (`0` = reference "
+                           "semantics: first crash ends the group).",
+    "service.restart_backoff_initial": "Initial supervised-restart "
+                                       "backoff (exponential, jittered).",
+    "service.restart_backoff_max": "Supervised-restart backoff ceiling.",
+    "fault.enabled": "Arm the fault-injection plan at startup (YAML-only "
+                     "chaos harness; see docs/developer/resilience.md).",
+    "fault.seed": "Fault-plan RNG seed: the same seed replays the same "
+                  "fault sequence.",
+    "fault.specs": "Fault specs: mappings with a `site` "
+                   "(e.g. `net.refuse`, `device.read_error`) plus "
+                   "optional probability/count/skip/start/duration/arg.",
     "dev.fake_cpu_meter.enabled": "Dev-only synthetic meter (YAML-only, "
                                   "never a flag — reference "
                                   "config.go:104,189).",
@@ -184,7 +220,13 @@ FLAG_OF = {
 _SNAKE_TO_CAMEL = {v: k for k, v in _CANONICAL_YAML_KEYS.items()}
 
 _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
-                   "aggregator.interval", "aggregator.stale_after"}
+                   "monitor.stall_after",
+                   "aggregator.interval", "aggregator.stale_after",
+                   "aggregator.backoff_initial", "aggregator.backoff_max",
+                   "aggregator.breaker_cooldown", "aggregator.flush_timeout",
+                   "aggregator.skew_tolerance", "aggregator.degraded_ttl",
+                   "service.restart_backoff_initial",
+                   "service.restart_backoff_max"}
 
 
 def yaml_path(path: str) -> str:

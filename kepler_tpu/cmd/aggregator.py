@@ -17,6 +17,7 @@ from kepler_tpu.config import parse_args_and_config
 from kepler_tpu.fleet import Aggregator
 from kepler_tpu.service.lifecycle import (
     CancelContext,
+    RestartPolicy,
     SignalHandler,
     init_services,
     run_services,
@@ -33,6 +34,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         print(f"error: {err}", file=sys.stderr)
         return 1
     new_logger(cfg.log.level, cfg.log.format)
+    from kepler_tpu import fault
+    fault.install_from_config(cfg.fault)
     # multi-host DCN: if JAX_COORDINATOR_ADDRESS is set, join the cluster
     # BEFORE any jax API initialises the backend (no-op single-host)
     from kepler_tpu.parallel import initialize_multihost
@@ -65,6 +68,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         history_window=cfg.aggregator.history_window,
         training_dump_dir=cfg.aggregator.training_dump_dir,
         training_dump_max_files=cfg.aggregator.training_dump_max_files,
+        skew_tolerance=cfg.aggregator.skew_tolerance,
+        degraded_ttl=cfg.aggregator.degraded_ttl,
     )
     services: list = [server, aggregator]
 
@@ -76,6 +81,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         )
         registry = CollectorRegistry()
         registry.register(aggregator)
+        from kepler_tpu.exporter.prometheus import HealthCollector
+        registry.register(HealthCollector(server.health))
         # ~2× the stock renderer at 1k-node fleets in BOTH negotiated
         # formats (byte-identical; fastexpo falls back wholesale on
         # anything beyond the simple kepler families)
@@ -91,7 +98,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         return 1
     ctx = CancelContext()
     try:
-        run_services(ctx, services)
+        run_services(ctx, services,
+                     restart=RestartPolicy.from_config(cfg.service))
     except Exception as err:
         log.error("run failed: %s", err)
         return 1

@@ -15,7 +15,7 @@ import os
 import sys
 from typing import Sequence
 
-from kepler_tpu import version
+from kepler_tpu import fault, version
 from kepler_tpu.config import Config, parse_args_and_config
 from kepler_tpu.device.fake import FakeCPUMeter
 from kepler_tpu.device.rapl import RaplPowerMeter
@@ -25,11 +25,13 @@ from kepler_tpu.exporter.prometheus import (
 )
 from kepler_tpu.exporter.stdout import StdoutExporter
 from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.monitor.watchdog import MonitorWatchdog
 from kepler_tpu.resource import ResourceInformer, make_proc_reader
 from kepler_tpu.server.debug import DebugService
 from kepler_tpu.server.webconfig import make_api_server
 from kepler_tpu.service.lifecycle import (
     CancelContext,
+    RestartPolicy,
     SignalHandler,
     init_services,
     run_services,
@@ -105,6 +107,19 @@ def create_services(cfg: Config) -> list:
     if pod_lookup is not None:
         services.append(pod_lookup)
     services += [resources, monitor, server]
+    if cfg.monitor.interval > 0:
+        watchdog = MonitorWatchdog(
+            monitor, interval=cfg.monitor.interval,
+            stall_after=cfg.monitor.stall_after or None)
+        services.append(watchdog)
+        # ONE monitor probe: the watchdog's (stall flag + age + stall
+        # count) supersedes monitor.health, which reads the same flag
+        server.health.register_probe("monitor-watchdog", watchdog.health)
+    else:
+        server.health.register_probe("monitor", monitor.health)
+    # ready once the first snapshot exists (collector readiness gate)
+    server.health.register_readiness(
+        "monitor", lambda: {"ok": monitor.data_channel().is_set()})
     if cfg.exporter.prometheus.enabled:
         source = {"rapl": "rapl-powercap", "rapl-msr": "rapl-msr",
                   "fake-cpu-meter": "fake"}.get(meter.name(), meter.name())
@@ -115,6 +130,8 @@ def create_services(cfg: Config) -> list:
             procfs=cfg.host.procfs,
             meter_source=source,
         )
+        from kepler_tpu.exporter.prometheus import HealthCollector
+        collectors.append(HealthCollector(server.health))
         services.append(PrometheusExporter(
             server, collectors,
             debug_collectors=cfg.exporter.prometheus.debug_collectors))
@@ -125,14 +142,21 @@ def create_services(cfg: Config) -> list:
     if cfg.aggregator.endpoint:
         from kepler_tpu.fleet import FleetAgent
         from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO
-        services.append(FleetAgent(
+        agent = FleetAgent(
             monitor,
             endpoint=cfg.aggregator.endpoint,
             node_name=cfg.kube.node_name,
             mode=(MODE_MODEL if cfg.aggregator.node_mode == "model"
                   else MODE_RATIO),
             tls_skip_verify=cfg.aggregator.tls_skip_verify,
-        ))
+            backoff_initial=cfg.aggregator.backoff_initial,
+            backoff_max=cfg.aggregator.backoff_max,
+            breaker_threshold=cfg.aggregator.breaker_threshold,
+            breaker_cooldown=cfg.aggregator.breaker_cooldown,
+            flush_timeout_s=cfg.aggregator.flush_timeout,
+        )
+        services.append(agent)
+        server.health.register_probe("fleet-agent", agent.health)
     if cfg.aggregator.enabled:
         log.warning("aggregator.enabled is set — the aggregator role runs "
                     "as its own binary: python -m kepler_tpu.cmd.aggregator")
@@ -153,6 +177,7 @@ def main(argv: Sequence[str] | None = None) -> int:
              info.platform)
 
     try:
+        fault.install_from_config(cfg.fault)
         services = create_services(cfg)
     except Exception as err:
         log.error("failed to create services: %s", err)
@@ -166,7 +191,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         return 1
     ctx = CancelContext()
     try:
-        run_services(ctx, services)
+        run_services(ctx, services,
+                     restart=RestartPolicy.from_config(cfg.service))
     except Exception as err:
         log.error("run failed: %s", err)
         return 1

@@ -115,6 +115,8 @@ class MonitorConfig:
     max_terminated: int = 500
     # joules; only terminated workloads above this are tracked (config.go:58-63)
     min_terminated_energy_threshold: float = 10.0
+    # watchdog: refresh-loop stall threshold; 0 = auto (3 × interval)
+    stall_after: float = 0.0
 
 
 @dataclass
@@ -189,6 +191,33 @@ class TPUConfig:
 
 
 @dataclass
+class ServiceConfig:
+    """Supervised service-group restarts (service.lifecycle.RestartPolicy).
+
+    ``restart_max: 0`` (default) keeps the reference semantics: the first
+    Runner crash ends the group. > 0 enables bounded restart-with-backoff
+    per service.
+    """
+
+    restart_max: int = 0
+    restart_backoff_initial: float = 0.5
+    restart_backoff_max: float = 30.0
+
+
+@dataclass
+class FaultConfig:
+    """Fault injection (``kepler_tpu.fault``) — YAML-only, like ``dev.*``:
+    a chaos plan must be a deliberate config-file choice, never a stray
+    CLI argument. ``specs`` is a list of mappings with a required ``site``
+    plus optional probability/count/skip/start/duration/arg (see
+    fault.plan.FaultSpec)."""
+
+    enabled: bool = False
+    seed: int = 0
+    specs: list = field(default_factory=list)
+
+
+@dataclass
 class DevConfig:
     fake_cpu_meter: FakeCpuMeterConfig = field(default_factory=FakeCpuMeterConfig)
 
@@ -233,6 +262,21 @@ class AggregatorConfig:
     # node-agent side: report as a model-estimated node (no trustworthy
     # RAPL — e.g. a VM guest); the aggregator then uses the estimator
     node_mode: str = "ratio"  # ratio | model
+    # -- resilience (docs/developer/resilience.md) --
+    # agent send retries: exponential backoff with jitter between attempts
+    backoff_initial: float = 0.1
+    backoff_max: float = 5.0
+    # agent circuit breaker: consecutive failures that open it, and the
+    # base cooldown before a half-open probe (doubles per failed probe)
+    breaker_threshold: int = 5
+    breaker_cooldown: float = 10.0
+    # agent shutdown: bound on the best-effort final queue flush
+    flush_timeout: float = 2.0
+    # aggregator: quarantine reports whose sender clock is skewed beyond
+    # this (0 disables the check), and how long a node stays marked
+    # degraded after its last quarantined report
+    skew_tolerance: float = 120.0
+    degraded_ttl: float = 60.0
 
 
 @dataclass
@@ -248,6 +292,8 @@ class Config:
     kube: KubeConfig = field(default_factory=KubeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
     aggregator: AggregatorConfig = field(default_factory=AggregatorConfig)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
     dev: DevConfig = field(default_factory=DevConfig)
 
     # ---- validation (reference config.go:418-509) ----
@@ -298,6 +344,38 @@ class Config:
         if self.aggregator.node_mode not in ("ratio", "model"):
             errs.append(
                 f"invalid aggregator.nodeMode: {self.aggregator.node_mode!r}")
+        if self.monitor.stall_after < 0:
+            errs.append("monitor.stallAfter must be >= 0")
+        elif 0 < self.monitor.stall_after <= self.monitor.interval:
+            # a threshold at or under one refresh interval would flap the
+            # watchdog stalled/recovered on a perfectly healthy node
+            errs.append("monitor.stallAfter must exceed monitor.interval "
+                        "(or be 0 for auto = 3 × interval)")
+        for name, val in (
+                ("aggregator.backoffInitial", self.aggregator.backoff_initial),
+                ("aggregator.backoffMax", self.aggregator.backoff_max),
+                ("aggregator.breakerCooldown",
+                 self.aggregator.breaker_cooldown),
+                ("aggregator.flushTimeout", self.aggregator.flush_timeout),
+                ("aggregator.skewTolerance", self.aggregator.skew_tolerance),
+                ("aggregator.degradedTtl", self.aggregator.degraded_ttl),
+                ("service.restartBackoffInitial",
+                 self.service.restart_backoff_initial),
+                ("service.restartBackoffMax",
+                 self.service.restart_backoff_max)):
+            if val < 0:
+                errs.append(f"{name} must be >= 0")
+        if self.aggregator.breaker_threshold < 1:
+            errs.append("aggregator.breakerThreshold must be >= 1")
+        if self.service.restart_max < 0:
+            errs.append("service.restartMax must be >= 0")
+        if self.fault.enabled:
+            # a typo'd chaos plan must fail at startup, not inject nothing
+            try:
+                from kepler_tpu.fault import FaultPlan
+                FaultPlan.from_config(self.fault)
+            except ValueError as err:
+                errs.append(str(err))
         if errs:
             raise ValueError("invalid configuration: " + "; ".join(errs))
 
@@ -336,6 +414,17 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "fakeCpuMeter": "fake_cpu_meter",
     "devicePath": "device_path",
     "compilationCacheDir": "compilation_cache_dir",
+    "stallAfter": "stall_after",
+    "backoffInitial": "backoff_initial",
+    "backoffMax": "backoff_max",
+    "breakerThreshold": "breaker_threshold",
+    "breakerCooldown": "breaker_cooldown",
+    "flushTimeout": "flush_timeout",
+    "skewTolerance": "skew_tolerance",
+    "degradedTtl": "degraded_ttl",
+    "restartMax": "restart_max",
+    "restartBackoffInitial": "restart_backoff_initial",
+    "restartBackoffMax": "restart_backoff_max",
 }
 
 
@@ -348,7 +437,10 @@ _YAML_KEYS: dict[str, str] = {
     **{_kebab(k): v for k, v in _CANONICAL_YAML_KEYS.items()},
 }
 
-_DURATION_FIELDS = {"interval", "staleness", "stale_after"}
+_DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
+                    "backoff_initial", "backoff_max", "breaker_cooldown",
+                    "flush_timeout", "skew_tolerance", "degraded_ttl",
+                    "restart_backoff_initial", "restart_backoff_max"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:

@@ -8,11 +8,13 @@ from kepler_tpu.exporter.prometheus.exporter import (
 from kepler_tpu.exporter.prometheus.info_collectors import (
     BuildInfoCollector,
     CPUInfoCollector,
+    HealthCollector,
 )
 
 __all__ = [
     "BuildInfoCollector",
     "CPUInfoCollector",
+    "HealthCollector",
     "PowerCollector",
     "PrometheusExporter",
     "create_collectors",

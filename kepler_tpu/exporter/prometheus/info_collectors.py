@@ -49,6 +49,27 @@ class PowerMeterInfoCollector:
         yield family
 
 
+class HealthCollector:
+    """``kepler_component_healthy{component=...}`` gauges from the API
+    server's health registry — the same probes behind ``/healthz``
+    (agent circuit breaker, monitor watchdog, aggregator quarantine)
+    exposed on the scrape plane so degradation is alertable without a
+    separate probe poller."""
+
+    def __init__(self, health) -> None:
+        self._health = health
+
+    def collect(self):
+        family = GaugeMetricFamily(
+            "kepler_component_healthy",
+            "1 while the component's health probe reports ok, else 0",
+            labels=["component"])
+        _, components = self._health.check_health()
+        for name, result in sorted(components.items()):
+            family.add_metric([name], 1.0 if result.get("ok") else 0.0)
+        yield family
+
+
 class CPUInfoCollector:
     def __init__(self, procfs: str = "/proc") -> None:
         self._path = os.path.join(procfs, "cpuinfo")

@@ -1,0 +1,26 @@
+"""Fault injection: seedable, config-wired chaos harness for resilience
+tests and soak runs (see docs/developer/resilience.md)."""
+
+from kepler_tpu.fault.plan import (
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    active,
+    fire,
+    install,
+    install_from_config,
+    installed,
+    uninstall,
+)
+
+__all__ = [
+    "KNOWN_SITES",
+    "FaultPlan",
+    "FaultSpec",
+    "active",
+    "fire",
+    "install",
+    "install_from_config",
+    "installed",
+    "uninstall",
+]

@@ -6,10 +6,15 @@ backend"): subscribes to the monitor's raw window samples, serializes them
 own Prometheus exporter is untouched — the aggregator is an *additional*
 consumer, exactly as Prometheus scrape is in the reference.
 
-Failure model mirrors the reference's degrade-gracefully stance: an
+Failure model (reference degrade-gracefully stance, hardened): an
 unreachable aggregator never blocks or kills the node monitor. Samples
-queue in a small ring (newest wins) and drop with a rate-limited warning —
-the aggregator pads/masks missing nodes out of the batch anyway.
+queue in a small ring (newest wins); the send path reuses one persistent
+connection, retries with exponential backoff + jitter, and a circuit
+breaker sheds sends entirely while open so a dead aggregator costs the
+node one failed probe per cooldown instead of a connect timeout per
+window. Breaker state is surfaced through :meth:`health` for the API
+server's ``/healthz``. Fault-injection points (``kepler_tpu.fault``) cover
+the whole path: connect refusal, slow sends, body corruption, clock skew.
 """
 
 from __future__ import annotations
@@ -18,18 +23,39 @@ import base64
 import collections
 import http.client
 import logging
+import random
 import socket
 import ssl
 import threading
+import time as _time
 import urllib.parse
 import uuid
+from typing import Callable
 
+from kepler_tpu import fault
 from kepler_tpu.fleet.wire import encode_report
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
-from kepler_tpu.service.lifecycle import CancelContext
+from kepler_tpu.service.lifecycle import CancelContext, backoff_with_jitter
 
 log = logging.getLogger("kepler.fleet.agent")
+
+# circuit-breaker states (health()["breaker"])
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half-open"
+
+
+class AggregatorRejectedError(http.client.HTTPException):
+    """4xx from the aggregator: the delivery path is HEALTHY, this payload
+    is permanently rejected (skew, auth, size, malformed). Retrying would
+    fail forever and tripping the breaker would shed GOOD reports from an
+    aggregator that is demonstrably up — so the drain loop drops the
+    sample instead."""
+
+    def __init__(self, status: int) -> None:
+        super().__init__(f"aggregator rejected report: {status}")
+        self.status = status
 
 
 class FleetAgent:
@@ -42,6 +68,14 @@ class FleetAgent:
         timeout_s: float = 2.0,
         queue_max: int = 8,
         tls_skip_verify: bool = False,
+        backoff_initial: float = 0.1,
+        backoff_max: float = 5.0,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 10.0,
+        flush_timeout_s: float = 2.0,
+        clock: Callable[[], float] | None = None,
+        monotonic: Callable[[], float] | None = None,
+        jitter_seed: int | None = None,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
@@ -53,7 +87,27 @@ class FleetAgent:
         self._wake = threading.Event()
         self._seq = 0
         self._run_nonce = uuid.uuid4().hex[:16]  # identifies this agent run
-        self._drop_logged = 0.0
+        self._clock = clock or _time.time
+        self._monotonic = monotonic or _time.monotonic
+        self._drop_logged: float | None = None  # monotonic of last warning
+        # retry/backoff + circuit breaker (jitter is seeded so resilience
+        # tests replay the exact same schedule)
+        self._backoff_initial = max(backoff_initial, 1e-3)
+        self._backoff_max = max(backoff_max, self._backoff_initial)
+        self._breaker_threshold = max(1, breaker_threshold)
+        self._breaker_cooldown = max(breaker_cooldown, 1e-3)
+        self._flush_timeout = max(0.0, flush_timeout_s)
+        self._rng = random.Random(jitter_seed)
+        self._breaker_state = BREAKER_CLOSED
+        self._breaker_open_until = 0.0
+        self._breaker_backoff = self._breaker_cooldown  # escalates per reopen
+        self._consecutive_failures = 0
+        self._inflight: WindowSample | None = None
+        self._conn: http.client.HTTPConnection | None = None
+        self._stats = {"sent_total": 0, "send_failures": 0,
+                       "dropped_total": 0, "server_rejections": 0,
+                       "connects_total": 0,
+                       "breaker_opens": 0, "flushed_on_shutdown": 0}
         u = urllib.parse.urlsplit(endpoint if "//" in endpoint
                                   else f"http://{endpoint}")
         if not u.hostname or not u.port:
@@ -95,7 +149,11 @@ class FleetAgent:
                  " (basic auth)" if self._auth_header else "")
 
     def _on_window(self, sample: WindowSample) -> None:
-        # runs inside the monitor's refresh lock: enqueue only
+        # runs inside the monitor's refresh lock: enqueue only. A full
+        # ring drops its oldest sample (newest wins) — account for it so
+        # prolonged outages are visible in health()/metrics.
+        if len(self._queue) == self._queue.maxlen:
+            self._stats["dropped_total"] += 1
         self._queue.append(sample)
         self._wake.set()
 
@@ -103,21 +161,173 @@ class FleetAgent:
         while not ctx.cancelled():
             self._wake.wait(timeout=0.5)
             self._wake.clear()
-            while self._queue:
-                sample = self._queue.popleft()
-                try:
-                    self._send(sample)
-                except (OSError, http.client.HTTPException) as err:
-                    self._log_drop(sample, err)
+            self._drain(ctx)
             if ctx.wait(0.0):
                 return
 
     def shutdown(self) -> None:
         self._wake.set()
+        # best-effort final flush: a clean node drain delivers its queued
+        # window(s) instead of abandoning them. Bounded by flush_timeout_s
+        # and skipped while the breaker is open (aggregator presumed down).
+        if self._breaker_state != BREAKER_OPEN:
+            deadline = self._monotonic() + self._flush_timeout
+            while ((self._inflight is not None or self._queue)
+                   and self._monotonic() < deadline):
+                sample = self._inflight
+                if sample is None:
+                    sample = self._queue.popleft()
+                self._inflight = sample
+                try:
+                    self._send(sample)
+                except AggregatorRejectedError as err:
+                    # this one sample is unacceptable; the rest may flush
+                    self._inflight = None
+                    self._stats["dropped_total"] += 1
+                    self._stats["server_rejections"] += 1
+                    log.info("shutdown flush: report rejected (%s)", err)
+                    continue
+                except (OSError, http.client.HTTPException) as err:
+                    log.info("shutdown flush stopped (%d left): %s",
+                             len(self._queue) + 1, err)
+                    break
+                self._inflight = None
+                self._stats["sent_total"] += 1
+                self._stats["flushed_on_shutdown"] += 1
+        self._close_conn()
+
+    def health(self) -> dict:
+        """Probe for the API server's /healthz (server.health registry)."""
+        return {
+            "ok": self._breaker_state != BREAKER_OPEN,
+            "breaker": self._breaker_state,
+            "consecutive_failures": self._consecutive_failures,
+            "queued": len(self._queue),
+            **self._stats,
+        }
 
     # -- internals ---------------------------------------------------------
 
+    def _drain(self, ctx: CancelContext | None) -> None:
+        """Send queued samples, honoring breaker state and backoff.
+
+        Closed: send with exponential-backoff retries; `breaker_threshold`
+        consecutive failures open the breaker. Open: shed (no connection
+        attempts) until the cooldown elapses, then half-open. Half-open:
+        one probe send — success closes the breaker, failure re-opens it
+        with a doubled (capped) cooldown.
+        """
+        while not (ctx is not None and ctx.cancelled()):
+            now = self._monotonic()
+            if (self._breaker_state == BREAKER_OPEN
+                    and now < self._breaker_open_until):
+                return  # shedding: samples stay in the newest-wins ring
+            sample = self._inflight
+            if sample is None:
+                # an elapsed-cooldown breaker stays OPEN until a sample
+                # exists to probe with: health must not report recovery
+                # that nothing demonstrated
+                if not self._queue:
+                    return
+                sample = self._queue.popleft()
+                self._inflight = sample
+            if self._breaker_state == BREAKER_OPEN:
+                self._breaker_state = BREAKER_HALF_OPEN
+                log.info("circuit breaker half-open: probing aggregator")
+            try:
+                self._send(sample)
+            except AggregatorRejectedError as err:
+                # the aggregator ANSWERED: delivery is healthy, this
+                # payload will never be accepted — drop it and count the
+                # response as breaker-closing evidence (retrying a 4xx
+                # forever would shed good reports from a live aggregator)
+                self._inflight = None
+                self._stats["dropped_total"] += 1
+                self._stats["server_rejections"] += 1
+                self._log_drop(err)
+                self._note_send_success()
+                continue
+            except (OSError, http.client.HTTPException) as err:
+                self._on_send_failure(err)
+                if self._breaker_state == BREAKER_OPEN:
+                    return
+                # closed, below threshold: retry after backoff with jitter
+                delay = self._backoff_delay()
+                if ctx is None or ctx.wait(delay):
+                    return
+                continue
+            self._inflight = None
+            self._stats["sent_total"] += 1
+            self._note_send_success()
+
+    def _note_send_success(self) -> None:
+        """The aggregator responded — close the breaker, reset schedules."""
+        if self._breaker_state != BREAKER_CLOSED:
+            log.info("circuit breaker closed: aggregator recovered")
+        self._breaker_state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._breaker_backoff = self._breaker_cooldown
+
+    def _on_send_failure(self, err: Exception) -> None:
+        self._stats["send_failures"] += 1
+        self._consecutive_failures += 1
+        self._log_drop(err)
+        half_open = self._breaker_state == BREAKER_HALF_OPEN
+        if (half_open
+                or self._consecutive_failures >= self._breaker_threshold):
+            if half_open:
+                # failed probe: double the cooldown, capped — but never
+                # below the operator-configured base cooldown
+                self._breaker_backoff = min(
+                    self._breaker_backoff * 2,
+                    max(60.0, self._breaker_cooldown))
+            self._breaker_state = BREAKER_OPEN
+            self._breaker_open_until = (self._monotonic()
+                                        + self._breaker_backoff)
+            self._stats["breaker_opens"] += 1
+            # shed the in-flight sample too — by reopen time it is stale
+            if self._inflight is not None:
+                self._inflight = None
+                self._stats["dropped_total"] += 1
+            log.warning("circuit breaker open for %.1fs after %d "
+                        "consecutive send failures: %s",
+                        self._breaker_backoff,
+                        self._consecutive_failures, err)
+
+    def _backoff_delay(self) -> float:
+        return backoff_with_jitter(self._backoff_initial, self._backoff_max,
+                                   self._consecutive_failures, self._rng)
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is not None:
+            return self._conn
+        if self._tls:
+            conn: http.client.HTTPConnection = http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout,
+                context=self._tls_ctx)
+        else:
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=self._timeout)
+        self._conn = conn
+        self._stats["connects_total"] += 1
+        return conn
+
+    def _close_conn(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     def _send(self, sample: WindowSample) -> None:
+        spec = fault.fire("net.refuse")
+        if spec is not None:
+            self._close_conn()
+            raise ConnectionRefusedError("fault-injected connect refusal")
+        spec = fault.fire("net.slow")
+        if spec is not None:
+            _time.sleep(min(spec.arg or 0.05, self._timeout))
         batch = sample.batch
         report = NodeReport(
             node_name=self._node_name,
@@ -132,32 +342,44 @@ class FleetAgent:
             workload_kinds=batch.kinds,
         )
         self._seq += 1
+        sent_at = self._clock()
+        spec = fault.fire("report.clock_skew")
+        if spec is not None:
+            sent_at += spec.arg if spec.arg is not None else 300.0
         body = encode_report(report, list(sample.zone_names), seq=self._seq,
-                             run=self._run_nonce)
-        if self._tls:
-            conn = http.client.HTTPSConnection(
-                self._host, self._port, timeout=self._timeout,
-                context=self._tls_ctx)
-        else:
-            conn = http.client.HTTPConnection(self._host, self._port,
-                                              timeout=self._timeout)
+                             run=self._run_nonce, sent_at=sent_at)
+        spec = fault.fire("net.corrupt_body")
+        if spec is not None:
+            # drop the tail: header (and node name) stay parseable, the
+            # array manifest overruns → deterministic WireError server-side
+            body = body[:-4]
         headers = {"Content-Type": "application/octet-stream"}
         if self._auth_header:
             headers["Authorization"] = self._auth_header
+        conn = self._connection()
         try:
             conn.request("POST", self._path, body=body, headers=headers)
             resp = conn.getresponse()
             resp.read()
-            if resp.status >= 300:
-                raise http.client.HTTPException(
-                    f"aggregator returned {resp.status}")
-        finally:
-            conn.close()
+        except Exception:
+            # a dead persistent connection is not reusable — reconnect on
+            # the next attempt
+            self._close_conn()
+            raise
+        if resp.status >= 300 or resp.will_close:
+            self._close_conn()
+        if 400 <= resp.status < 500:
+            raise AggregatorRejectedError(resp.status)
+        if resp.status >= 300:
+            raise http.client.HTTPException(
+                f"aggregator returned {resp.status}")
 
-    def _log_drop(self, sample: WindowSample, err: Exception) -> None:
-        # rate-limit to one warning per 30 s of sample time so a down
-        # aggregator doesn't flood the node's logs every interval
-        if sample.timestamp - self._drop_logged >= 30.0:
-            self._drop_logged = sample.timestamp
-            log.warning("dropping fleet report (aggregator unreachable): %s",
-                        err)
+    def _log_drop(self, err: Exception) -> None:
+        # rate-limit to one warning per 30 s of MONOTONIC time (not sample
+        # time: a stalled or skewed monitor clock must not suppress the
+        # operator's only signal that reports are failing)
+        now = self._monotonic()
+        if self._drop_logged is None or now - self._drop_logged >= 30.0:
+            self._drop_logged = now
+            log.warning("fleet report send failed (aggregator unreachable "
+                        "or rejecting): %s", err)

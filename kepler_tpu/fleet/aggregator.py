@@ -29,7 +29,7 @@ from typing import Mapping
 
 import numpy as np
 
-from kepler_tpu.fleet.wire import WireError, decode_report
+from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
 from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.parallel.aggregator_core import (
     FleetResult,
@@ -156,6 +156,8 @@ class Aggregator:
         history_window: int = 16,
         training_dump_dir: str = "",
         training_dump_max_files: int = 1000,
+        skew_tolerance: float = 120.0,
+        degraded_ttl: float = 60.0,
         clock=None,
         mesh=None,
     ) -> None:
@@ -188,6 +190,20 @@ class Aggregator:
         self._dump_seq = 0
         self._dump_files: list[str] | None = None  # seeded on first dump
 
+        # report quarantine: a malformed or clock-skewed report is rejected
+        # BEFORE it can poison the batch, and the offense is charged to the
+        # sending node so operators see WHICH node degrades (the reference
+        # only ages bad nodes out silently). Entries decay after
+        # ``degraded_ttl`` of good behavior.
+        self._skew_tolerance = skew_tolerance
+        self._degraded_ttl = degraded_ttl
+        self._degraded: dict[str, dict] = {}
+        # names come from (possibly hostile) malformed payloads: bound the
+        # table (oldest offender evicted) and the per-name length so a
+        # garbage flood can't grow memory or log volume without limit
+        self._degraded_cap = 64
+        self._degraded_name_cap = 128
+
         self._lock = threading.Lock()
         self._reports: dict[str, _Stored] = {}
         # per-node run nonces superseded by restarts: a network-delayed
@@ -200,7 +216,10 @@ class Aggregator:
         self._superseded_cap = 16
         self._results_lock = threading.Lock()
         self._results: FleetResults | None = None
+        self._last_window_at: float | None = None
         self._stats = {"reports_total": 0, "rejected_total": 0,
+                       "quarantined_total": 0, "malformed_total": 0,
+                       "clock_skew_total": 0,
                        "attributions_total": 0, "last_batch_nodes": 0,
                        "last_batch_workloads": 0,
                        # whole-window latency (assembly + device + scatter)
@@ -251,6 +270,13 @@ class Aggregator:
                               max_body=MAX_REPORT_BYTES)
         self._server.register("/v1/results", "Fleet results",
                               "attributed watts per node", self._handle_results)
+        health = getattr(self._server, "health", None)
+        if health is not None:
+            health.register_probe("fleet-aggregator", self.health)
+            # ready once init completed: endpoints registered, mesh built,
+            # params validated — an empty fleet is still a ready aggregator
+            health.register_readiness("fleet-aggregator",
+                                      lambda: {"ok": True})
         log.info("aggregator: mesh=%s devices=%d model=%s interval=%.1fs",
                  dict(self._mesh.shape), n_dev, self._model_mode,
                  self._interval)
@@ -275,12 +301,39 @@ class Aggregator:
         try:
             report, header = decode_report(request.body)
         except (WireError, ValueError) as err:
+            # quarantine, charged to the sender when the header survives.
+            # The header re-parse runs OFF the store lock — a burst of
+            # large malformed bodies must not stall ingest/aggregation.
+            node = peek_node_name(request.body)
             with self._lock:
                 self._stats["rejected_total"] += 1
+                self._stats["quarantined_total"] += 1
+                self._stats["malformed_total"] += 1
+                if node:
+                    self._record_degraded_locked(node, "malformed", str(err))
             return 400, {"Content-Type": "text/plain"}, f"{err}\n".encode()
+        received = self._clock()
+        sent_at = header.get("sent_at")
+        if (self._skew_tolerance > 0
+                and isinstance(sent_at, (int, float))
+                and not isinstance(sent_at, bool)
+                and abs(received - float(sent_at)) > self._skew_tolerance):
+            # a skewed sender's reports would corrupt staleness aging and
+            # cumulative-energy timestamps — quarantine instead of ingest
+            skew = float(sent_at) - received
+            with self._lock:
+                self._stats["rejected_total"] += 1
+                self._stats["quarantined_total"] += 1
+                self._stats["clock_skew_total"] += 1
+                self._record_degraded_locked(
+                    report.node_name, "clock_skew",
+                    f"sender clock skewed {skew:+.1f}s")
+            return (422, {"Content-Type": "text/plain"},
+                    f"report clock skewed {skew:+.1f}s beyond tolerance "
+                    f"{self._skew_tolerance:g}s\n".encode())
         stored = _Stored(report=report,
                          zone_names=tuple(header["zone_names"]),
-                         received=self._clock(),
+                         received=received,
                          seq=int(header.get("seq", 0)),
                          run=str(header.get("run", "")))
         with self._lock:
@@ -355,6 +408,49 @@ class Aggregator:
         with lock:
             buf.push(batch, dt_s=float(report.dt_s))
 
+    # -- degradation accounting --------------------------------------------
+
+    def _record_degraded_locked(self, node: str, reason: str,
+                                detail: str) -> None:
+        """Charge one quarantined report to ``node``. Caller holds _lock."""
+        node = node[:self._degraded_name_cap]
+        entry = self._degraded.get(node)
+        if entry is None:
+            if len(self._degraded) >= self._degraded_cap:
+                oldest = min(self._degraded,
+                             key=lambda n: self._degraded[n]["last_at"])
+                del self._degraded[oldest]
+            entry = {"malformed": 0, "clock_skew": 0,
+                     "last_error": "", "last_at": 0.0}
+            self._degraded[node] = entry
+        entry[reason] += 1
+        entry["last_error"] = detail
+        entry["last_at"] = self._clock()
+        log.warning("quarantined %s report from node %s: %s",
+                    reason, node, detail)
+
+    def degraded_nodes(self) -> dict[str, dict]:
+        """Nodes with quarantined reports inside the decay window."""
+        now = self._clock()
+        with self._lock:
+            return {n: dict(e) for n, e in self._degraded.items()
+                    if now - e["last_at"] <= self._degraded_ttl}
+
+    def health(self) -> dict:
+        """Probe for /healthz: degraded while any node's reports are being
+        quarantined (decays after degraded_ttl of clean ingest)."""
+        degraded = self.degraded_nodes()
+        with self._results_lock:
+            last = self._last_window_at
+        out = {
+            "ok": not degraded,
+            "degraded_nodes": sorted(degraded),
+            "quarantined_total": self._stats["quarantined_total"],
+        }
+        if last is not None:
+            out["last_window_age_s"] = round(self._clock() - last, 3)
+        return out
+
     # -- aggregation -------------------------------------------------------
 
     def aggregate_once(self) -> FleetResult | None:
@@ -379,6 +475,9 @@ class Aggregator:
                 del self._history[name]
             for name in [n for n in self._superseded_runs if n not in live]:
                 del self._superseded_runs[name]
+            for name in [n for n, e in self._degraded.items()
+                         if now - e["last_at"] > self._degraded_ttl]:
+                del self._degraded[name]
         if not live:
             return None
         # canonical zone axis = sorted union of reported zone names; nodes
@@ -496,6 +595,7 @@ class Aggregator:
         t_done = _time.perf_counter()
         with self._results_lock:
             self._results = results
+            self._last_window_at = now
             self._stats["attributions_total"] += 1
             self._stats["last_batch_nodes"] = n_real
             self._stats["last_batch_workloads"] = int(
@@ -768,6 +868,18 @@ class Aggregator:
             "kepler_fleet_reports_rejected", "Malformed reports rejected")
         rejected.add_metric([], stats["rejected_total"])
         yield rejected
+        quarantined = CounterMetricFamily(
+            "kepler_fleet_reports_quarantined",
+            "Reports quarantined before ingest, by reason",
+            labels=["reason"])
+        quarantined.add_metric(["malformed"], stats["malformed_total"])
+        quarantined.add_metric(["clock_skew"], stats["clock_skew_total"])
+        yield quarantined
+        degraded = GaugeMetricFamily(
+            "kepler_fleet_degraded_nodes",
+            "Nodes whose reports were quarantined within the decay window")
+        degraded.add_metric([], len(self.degraded_nodes()))
+        yield degraded
         node_watts = GaugeMetricFamily(
             "kepler_fleet_node_cpu_watts",
             "Per-node power attributed by the fleet aggregator",

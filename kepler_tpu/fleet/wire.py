@@ -32,8 +32,12 @@ _DTYPES = {"float32": np.float32, "float64": np.float64,
 
 
 def encode_report(report: NodeReport, zone_names: list[str],
-                  seq: int = 0, run: str = "") -> bytes:
-    """Serialize one node's window for the POST /v1/report body."""
+                  seq: int = 0, run: str = "",
+                  sent_at: float | None = None) -> bytes:
+    """Serialize one node's window for the POST /v1/report body.
+
+    ``sent_at`` (agent wall clock, seconds) lets the aggregator detect
+    clock-skewed senders; omitted for pre-skew-check agents."""
     arrays: list[tuple[str, np.ndarray]] = [
         ("zone_deltas_uj", np.ascontiguousarray(
             report.zone_deltas_uj, np.float32)),
@@ -62,6 +66,8 @@ def encode_report(report: NodeReport, zone_names: list[str],
             for n, a in arrays
         ],
     }
+    if sent_at is not None:
+        header["sent_at"] = float(sent_at)
     header_bytes = json.dumps(header, separators=(",", ":")).encode()
     parts = [MAGIC, _HEADER_LEN.pack(len(header_bytes)), header_bytes]
     parts += [a.tobytes() for _, a in arrays]
@@ -70,6 +76,28 @@ def encode_report(report: NodeReport, zone_names: list[str],
 
 class WireError(ValueError):
     pass
+
+
+def peek_node_name(data: bytes) -> str | None:
+    """Best-effort node name from a (possibly malformed) payload.
+
+    Used by the aggregator's per-node degradation accounting: when
+    ``decode_report`` rejects a body, a salvageable header still tells us
+    WHICH node is sending garbage. Never raises; returns None when even
+    the header is unreadable."""
+    try:
+        if data[: len(MAGIC)] != MAGIC:
+            return None
+        off = len(MAGIC)
+        (hlen,) = _HEADER_LEN.unpack_from(data, off)
+        off += _HEADER_LEN.size
+        if hlen > MAX_HEADER_BYTES or off + hlen > len(data):
+            return None
+        header = json.loads(data[off: off + hlen])
+        name = header.get("node_name") if isinstance(header, dict) else None
+        return name if isinstance(name, str) and name else None
+    except Exception:
+        return None
 
 
 def decode_report(data: bytes) -> tuple[NodeReport, dict]:

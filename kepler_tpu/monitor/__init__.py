@@ -9,8 +9,10 @@ from kepler_tpu.monitor.snapshot import (
     WorkloadTable,
 )
 from kepler_tpu.monitor.terminated import TerminatedTracker
+from kepler_tpu.monitor.watchdog import MonitorWatchdog
 
 __all__ = [
+    "MonitorWatchdog",
     "NodeUsage",
     "PowerMonitor",
     "Snapshot",

@@ -29,6 +29,7 @@ from typing import Callable, Mapping, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from kepler_tpu import fault
 from kepler_tpu.device.meter import CPUPowerMeter, EnergyZone
 from kepler_tpu.monitor.snapshot import NodeUsage, Snapshot, WorkloadTable
 from kepler_tpu.monitor.terminated import TerminatedTracker
@@ -131,6 +132,12 @@ class PowerMonitor:
         self._snapshot_lock = threading.Lock()  # singleflight for refresh
         self._exported = False
         self._data_event = threading.Event()  # reference dataCh signal
+        # watchdog plane: when the refresh loop stalls (wedged meter,
+        # deadlocked informer), MonitorWatchdog flips _stalled so /healthz
+        # reports the published snapshot as stale; a completed refresh
+        # clears it
+        self._last_refresh_done: float | None = None  # monotonic
+        self._stalled = False
 
     # -- service lifecycle -------------------------------------------------
 
@@ -196,6 +203,32 @@ class PowerMonitor:
         run inside the refresh lock — they must be fast and non-blocking
         (the agent just enqueues)."""
         self._window_listeners.append(listener)
+
+    def last_refresh_age(self) -> float | None:
+        """Monotonic seconds since the last COMPLETED refresh (None before
+        the first). The watchdog's stall signal."""
+        done = self._last_refresh_done
+        if done is None:
+            return None
+        return self._monotonic() - done
+
+    def mark_stalled(self, stalled: bool) -> None:
+        """Watchdog hook: flag the published snapshot as stale because the
+        refresh loop stopped making progress."""
+        self._stalled = stalled
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled
+
+    def health(self) -> dict:
+        """Probe for /healthz: not-ok while the watchdog flags a stall."""
+        out: dict = {"ok": not self._stalled, "stalled": self._stalled,
+                     "snapshot": self._snapshot is not None}
+        age = self.last_refresh_age()
+        if age is not None:
+            out["last_refresh_age_s"] = round(age, 3)
+        return out
 
     def snapshot(self, clone: bool = True) -> Snapshot:
         """Return a deep-cloned, fresh snapshot.
@@ -302,6 +335,10 @@ class PowerMonitor:
                 except Exception:
                     log.exception("window listener failed")
         self._maybe_prewarm_next_bucket(w, padded_w)
+        self._last_refresh_done = self._monotonic()
+        if self._stalled:
+            log.info("refresh loop recovered; clearing stall flag")
+            self._stalled = False
         log.debug("refresh done in %.2f ms", (_time.perf_counter() - start) * 1e3)
 
     def _maybe_prewarm_next_bucket(self, w: int, padded_w: int) -> None:
@@ -416,6 +453,19 @@ class PowerMonitor:
         valid = np.zeros(z, bool)
         for i, (zone, current) in enumerate(
                 zip(self._zones, self._read_zone_energies())):
+            if current is not None:
+                # chaos-harness injection points: a read error masks the
+                # zone this window (exactly like a real failed read); a
+                # counter wrap forces the wraparound-delta path
+                if fault.fire("device.read_error") is not None:
+                    log.warning("fault: injected read error on zone %s",
+                                zone.name())
+                    current = None
+                else:
+                    spec = fault.fire("device.counter_wrap")
+                    if spec is not None:
+                        current = int(spec.arg or 0) % max(
+                            1, int(zone.max_energy()))
             if current is None:
                 continue  # stays masked this window
             prev = self._prev_counters[i]

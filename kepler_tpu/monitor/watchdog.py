@@ -1,0 +1,102 @@
+"""Monitor watchdog: detects a stalled refresh loop.
+
+The reference's failure model covers *failing* refreshes (skip-on-error
+zone reads, serve-stale-on-error snapshots) but not a refresh loop that
+stops running at all — a meter blocked in a driver read, an informer
+deadlock, a wedged device call. This Runner closes that gap: it
+periodically compares the monitor's last-completed-refresh age against a
+stall threshold (default: 3 refresh intervals) and, when exceeded, marks
+the published snapshot stale (``PowerMonitor.mark_stalled``) and flips
+its own /healthz probe to degraded. A completed refresh clears the flag,
+so recovery is automatic and the degraded window is exactly the stall.
+
+The watchdog never restarts anything itself — pairing it with
+``run_services(..., restart=RestartPolicy(...))`` is the supervised
+variant (docs/developer/resilience.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+from typing import Callable
+
+from kepler_tpu.monitor.monitor import PowerMonitor
+from kepler_tpu.service.lifecycle import CancelContext
+
+log = logging.getLogger("kepler.monitor.watchdog")
+
+
+class MonitorWatchdog:
+    def __init__(
+        self,
+        monitor: PowerMonitor,
+        interval: float,
+        stall_after: float | None = None,
+        check_every: float | None = None,
+        monotonic: Callable[[], float] | None = None,
+    ) -> None:
+        """``interval`` is the monitor's refresh interval; ``stall_after``
+        defaults to 3 intervals (the ISSUE's convergence budget),
+        ``check_every`` to one interval."""
+        self._monitor = monitor
+        self._interval = max(interval, 1e-3)
+        self._stall_after = (stall_after if stall_after is not None
+                             else 3.0 * self._interval)
+        self._check_every = (check_every if check_every is not None
+                             else self._interval)
+        self._monotonic = monotonic or _time.monotonic
+        self._started_at: float | None = None
+        self._stall_count = 0
+
+    def name(self) -> str:
+        return "monitor-watchdog"
+
+    def run(self, ctx: CancelContext) -> None:
+        self._started_at = self._monotonic()
+        while not ctx.cancelled():
+            if ctx.wait(self._check_every):
+                return
+            self.check_once()
+
+    def _age(self) -> float:
+        """Seconds since the last completed refresh — or, before any
+        refresh EVER completed, since watchdog start (the first refresh
+        may be slow — XLA compile — so the same threshold applies)."""
+        age = self._monitor.last_refresh_age()
+        if age is None:
+            started = self._started_at
+            if started is None:
+                self._started_at = started = self._monotonic()
+            age = self._monotonic() - started
+        return age
+
+    def check_once(self) -> bool:
+        """One stall check (tests call this directly). True = stalled.
+
+        Only ever SETS the stall flag — a completed refresh is what
+        clears it (monitor._refresh_locked), so recovery is owned by the
+        thing that actually recovered. The age is re-read right before
+        flagging so a refresh completing mid-check can't get a
+        just-recovered monitor re-marked stale."""
+        stalled = self._age() > self._stall_after
+        if stalled:
+            stalled = self._age() > self._stall_after  # double-check
+        if stalled:
+            if not self._monitor.stalled:
+                self._stall_count += 1
+                log.error("monitor refresh loop stalled: last refresh "
+                          "%.1fs ago (threshold %.1fs); marking snapshot "
+                          "stale", self._age(), self._stall_after)
+            self._monitor.mark_stalled(True)
+        return stalled
+
+    def health(self) -> dict:
+        """Probe for /healthz (degraded while the loop is stalled)."""
+        out: dict = {"ok": not self._monitor.stalled,
+                     "stalled": self._monitor.stalled,
+                     "stalls_total": self._stall_count}
+        age = self._monitor.last_refresh_age()
+        if age is not None:
+            out["last_refresh_age_s"] = round(age, 3)
+        return out

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
+from kepler_tpu.server.health import HealthRegistry
 from kepler_tpu.service.lifecycle import CancelContext
 
 log = logging.getLogger("kepler.server")
@@ -55,6 +56,9 @@ class APIServer:
         self._endpoints: dict[str, Endpoint] = {}
         self._servers: list[ThreadingHTTPServer] = []
         self._threads: list[threading.Thread] = []
+        # probe plane: services register health/readiness callables here
+        # (fleet agent breaker, monitor watchdog, aggregator quarantine)
+        self.health = HealthRegistry()
 
     def name(self) -> str:
         return "api-server"
@@ -143,6 +147,13 @@ class APIServer:
 
         self._handler_cls = RequestHandler
         self.register("/", "Home", "Landing page", self._landing_page)
+        self.register("/healthz", "Health",
+                      "degradation probe (503 while degraded; includes "
+                      "external dependencies — not a kubelet livenessProbe)",
+                      self.health.handle_healthz)
+        self.register("/readyz", "Readiness",
+                      "readiness probe (503 until components are ready)",
+                      self.health.handle_readyz)
         for addr in self._addresses:
             host, _, port = addr.rpartition(":")
             server = ThreadingHTTPServer(

@@ -2,6 +2,7 @@
 
 from kepler_tpu.service.lifecycle import (
     CancelContext,
+    RestartPolicy,
     Service,
     ServiceError,
     SignalHandler,
@@ -11,6 +12,7 @@ from kepler_tpu.service.lifecycle import (
 
 __all__ = [
     "CancelContext",
+    "RestartPolicy",
     "Service",
     "ServiceError",
     "SignalHandler",

@@ -21,8 +21,10 @@ context cancellation.
 from __future__ import annotations
 
 import logging
+import random
 import signal
 import threading
+from dataclasses import dataclass
 from typing import Protocol, Sequence, runtime_checkable
 
 log = logging.getLogger("kepler.service")
@@ -54,6 +56,58 @@ class Service(Protocol):
 
 class ServiceError(Exception):
     pass
+
+
+def backoff_with_jitter(initial: float, cap: float, attempt: int,
+                        rng: random.Random) -> float:
+    """Equal-jitter exponential backoff: ``min(cap, initial·2^(n-1))``,
+    half deterministic + half random. The ONE schedule shared by the
+    restart policy and the fleet agent's send retries — the jitter keeps
+    a fleet of restarting/retrying nodes from synchronizing against a
+    recovering dependency."""
+    base = min(cap, initial * (2 ** max(0, attempt - 1)))
+    return base / 2 + rng.uniform(0, base / 2)
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    """Supervised restart-with-backoff for ``run_services`` Runners.
+
+    A Runner that RAISES is restarted after an exponential backoff with
+    jitter, up to ``max_restarts`` times per service; only when a service
+    exhausts its budget does the group fail. A Runner that RETURNS cleanly
+    still cancels the whole group (the oklog/run semantics are unchanged —
+    a deliberate exit, e.g. the SignalHandler, must keep meaning
+    "shut everything down").
+
+    The restart counter is per service and never resets: a service that
+    crashes ``max_restarts + 1`` times over any span ends the group. That
+    keeps the policy a bounded self-heal for transient faults (meter
+    hiccup, aggregator hiccup), not a crash-loop hider.
+    """
+
+    max_restarts: int = 3
+    backoff_initial: float = 0.5
+    backoff_max: float = 30.0
+    seed: int | None = None
+
+    def backoff(self, attempt: int, rng: random.Random) -> float:
+        """Delay before restart ``attempt`` (1-based), equal-jittered."""
+        return backoff_with_jitter(self.backoff_initial, self.backoff_max,
+                                   attempt, rng)
+
+    @staticmethod
+    def from_config(service_cfg) -> "RestartPolicy | None":
+        """Policy from a ``ServiceConfig`` (None = reference semantics).
+        Shared by both binaries; duck-typed so lifecycle stays decoupled
+        from the config package."""
+        if service_cfg.restart_max <= 0:
+            return None
+        return RestartPolicy(
+            max_restarts=service_cfg.restart_max,
+            backoff_initial=service_cfg.restart_backoff_initial,
+            backoff_max=service_cfg.restart_backoff_max,
+        )
 
 
 def init_services(services: Sequence[Service]) -> None:
@@ -88,28 +142,52 @@ def init_services(services: Sequence[Service]) -> None:
             ) from err
 
 
-def run_services(ctx: CancelContext, services: Sequence[Service]) -> None:
+def run_services(ctx: CancelContext, services: Sequence[Service],
+                 restart: RestartPolicy | None = None) -> None:
     """Run all Runner services concurrently until the first one returns.
 
     Semantics (reference ``internal/service/run.go:16-65`` / oklog/run):
     each Runner gets a thread running ``svc.run(ctx)``; when any returns or
     raises, the shared ctx is cancelled so all others unwind; finally every
     service's ``shutdown()`` runs (reverse order). The first error is raised.
+
+    With a ``restart`` policy, a Runner that raises is instead restarted
+    after a jittered exponential backoff, up to ``restart.max_restarts``
+    times per service — the supervised mode (ISSUE: restart-with-backoff).
+    Clean returns and exhausted budgets end the group as before.
     """
     runners = [s for s in services if hasattr(s, "run")]
     first_error: list[BaseException] = []
     done = threading.Event()
     threads: list[threading.Thread] = []
+    rng = random.Random(restart.seed) if restart is not None else None
 
     def actor(svc: Service) -> None:
+        attempts = 0
         try:
-            svc.run(ctx)  # type: ignore[attr-defined]
-        except Exception as err:
-            if not first_error:
-                first_error.append(err)
-            log.error("service %s exited with error: %s", svc.name(), err)
+            while True:
+                try:
+                    svc.run(ctx)  # type: ignore[attr-defined]
+                    return  # clean return: deliberate group shutdown
+                except Exception as err:
+                    if restart is not None and not ctx.cancelled() \
+                            and attempts < restart.max_restarts:
+                        attempts += 1
+                        delay = restart.backoff(attempts, rng)
+                        log.warning(
+                            "service %s crashed (%s); restart %d/%d in "
+                            "%.2fs", svc.name(), err, attempts,
+                            restart.max_restarts, delay)
+                        if ctx.wait(delay):
+                            return
+                        continue
+                    if not first_error:
+                        first_error.append(err)
+                    log.error("service %s exited with error: %s",
+                              svc.name(), err)
+                    return
         finally:
-            done.set()  # first return interrupts the whole group
+            done.set()  # first (final) return interrupts the whole group
 
     try:
         for svc in runners:

@@ -342,6 +342,33 @@ FIELD_MATRIX = [
               ["--aggregator.training-dump-max-files", "6"], 6),
     FieldCase("aggregator.node_mode", "aggregator: {nodeMode: model}",
               "model", ["--aggregator.node-mode", "ratio"], "ratio"),
+    # resilience knobs (ISSUE 1): YAML-only — chaos/backoff tuning is a
+    # config-file decision, never a stray CLI argument
+    FieldCase("monitor.stall_after", "monitor: {stallAfter: 20s}", 20.0),
+    FieldCase("aggregator.backoff_initial",
+              "aggregator: {backoffInitial: 200ms}", 0.2),
+    FieldCase("aggregator.backoff_max",
+              "aggregator: {backoffMax: 8s}", 8.0),
+    FieldCase("aggregator.breaker_threshold",
+              "aggregator: {breakerThreshold: 3}", 3),
+    FieldCase("aggregator.breaker_cooldown",
+              "aggregator: {breakerCooldown: 4s}", 4.0),
+    FieldCase("aggregator.flush_timeout",
+              "aggregator: {flushTimeout: 1s}", 1.0),
+    FieldCase("aggregator.skew_tolerance",
+              "aggregator: {skewTolerance: 30s}", 30.0),
+    FieldCase("aggregator.degraded_ttl",
+              "aggregator: {degradedTtl: 90s}", 90.0),
+    FieldCase("service.restart_max", "service: {restartMax: 2}", 2),
+    FieldCase("service.restart_backoff_initial",
+              "service: {restartBackoffInitial: 250ms}", 0.25),
+    FieldCase("service.restart_backoff_max",
+              "service: {restartBackoffMax: 10s}", 10.0),
+    FieldCase("fault.enabled", "fault: {enabled: true}", True),
+    FieldCase("fault.seed", "fault: {seed: 42}", 42),
+    FieldCase("fault.specs",
+              "fault: {specs: [{site: net.refuse, count: 2}]}",
+              [{"site": "net.refuse", "count": 2}]),
     # dev settings deliberately have no flags (reference config.go:104,189)
     FieldCase("dev.fake_cpu_meter.enabled",
               "dev: {fakeCpuMeter: {enabled: true}}", True),
@@ -414,6 +441,17 @@ class TestYAMLSpellings:
         "fakeCpuMeter": "dev",
         "devicePath": "msr",
         "compilationCacheDir": "tpu",
+        "stallAfter": "monitor",
+        "backoffInitial": "aggregator",
+        "backoffMax": "aggregator",
+        "breakerThreshold": "aggregator",
+        "breakerCooldown": "aggregator",
+        "flushTimeout": "aggregator",
+        "skewTolerance": "aggregator",
+        "degradedTtl": "aggregator",
+        "restartMax": "service",
+        "restartBackoffInitial": "service",
+        "restartBackoffMax": "service",
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -440,6 +478,17 @@ class TestYAMLSpellings:
         "fakeCpuMeter": ("{enabled: true}", None),  # subsection
         "devicePath": ("/tmp/cpu", "/tmp/cpu"),
         "compilationCacheDir": ("/tmp/xla", "/tmp/xla"),
+        "stallAfter": ("20s", 20.0),
+        "backoffInitial": ("200ms", 0.2),
+        "backoffMax": ("8s", 8.0),
+        "breakerThreshold": ("3", 3),
+        "breakerCooldown": ("4s", 4.0),
+        "flushTimeout": ("1s", 1.0),
+        "skewTolerance": ("30s", 30.0),
+        "degradedTtl": ("90s", 90.0),
+        "restartMax": ("2", 2),
+        "restartBackoffInitial": ("250ms", 0.25),
+        "restartBackoffMax": ("10s", 10.0),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))
@@ -504,6 +553,29 @@ class TestValidationMatrix:
         ("aggregator.nodeMode",
          lambda c: setattr(c.aggregator, "node_mode", "auto"),
          "aggregator.nodeMode"),
+        ("monitor.stallAfter",
+         lambda c: setattr(c.monitor, "stall_after", -1), "stallAfter"),
+        ("monitor.stallAfter.flap",
+         lambda c: setattr(c.monitor, "stall_after", 2.0),  # < interval 5s
+         "must exceed monitor.interval"),
+        ("aggregator.backoffInitial",
+         lambda c: setattr(c.aggregator, "backoff_initial", -1),
+         "backoffInitial"),
+        ("aggregator.breakerThreshold",
+         lambda c: setattr(c.aggregator, "breaker_threshold", 0),
+         "breakerThreshold"),
+        ("aggregator.skewTolerance",
+         lambda c: setattr(c.aggregator, "skew_tolerance", -1),
+         "skewTolerance"),
+        ("service.restartMax",
+         lambda c: setattr(c.service, "restart_max", -1), "restartMax"),
+        ("service.restartBackoffInitial",
+         lambda c: setattr(c.service, "restart_backoff_initial", -1),
+         "restartBackoffInitial"),
+        ("fault.specs",
+         lambda c: (setattr(c.fault, "enabled", True),
+                    setattr(c.fault, "specs", [{"site": "bogus.site"}])),
+         "unknown site"),
     ]
 
     @pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])

@@ -1,0 +1,178 @@
+"""Fault-injection registry tests: determinism, scoping (probability /
+count / skip / window), the disarmed fast path, and config wiring."""
+
+import pytest
+
+from kepler_tpu import fault
+from kepler_tpu.fault import FaultPlan, FaultSpec
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class TestFaultSpec:
+    def test_rejects_bad_probability(self):
+        with pytest.raises(ValueError, match="probability"):
+            FaultSpec("net.refuse", probability=1.5)
+
+    def test_rejects_negative_count(self):
+        with pytest.raises(ValueError, match="count"):
+            FaultSpec("net.refuse", count=-1)
+
+    def test_rejects_empty_site(self):
+        with pytest.raises(ValueError, match="site"):
+            FaultSpec("")
+
+    @pytest.mark.parametrize("kw", [
+        {"arg": "fast"}, {"probability": "high"}, {"count": "many"},
+        {"skip": None}, {"duration": [1]}, {"start": True},
+    ])
+    def test_non_numeric_fields_are_value_errors(self, kw):
+        # a YAML typo must fail startup validation as ValueError — never
+        # escape as TypeError or crash an injection point at fire time
+        with pytest.raises(ValueError, match="must be a number"):
+            FaultSpec("net.slow", **kw)
+
+
+class TestFaultPlan:
+    def test_count_scoped_fires_exactly_n(self):
+        plan = FaultPlan([FaultSpec("net.refuse", count=3)])
+        results = [plan.fire("net.refuse") is not None for _ in range(10)]
+        assert results == [True] * 3 + [False] * 7
+        assert plan.fired("net.refuse") == 3
+        assert plan.checked("net.refuse") == 10
+
+    def test_skip_lets_first_checks_pass(self):
+        plan = FaultPlan([FaultSpec("device.read_error", skip=2, count=1)])
+        results = [plan.fire("device.read_error") is not None
+                   for _ in range(5)]
+        assert results == [False, False, True, False, False]
+
+    def test_probability_deterministic_per_seed(self):
+        def pattern(seed):
+            plan = FaultPlan([FaultSpec("net.refuse", probability=0.5)],
+                             seed=seed)
+            return [plan.fire("net.refuse") is not None for _ in range(64)]
+
+        assert pattern(7) == pattern(7)  # replayable
+        assert pattern(7) != pattern(8)  # actually random
+        fires = sum(pattern(7))
+        assert 10 < fires < 54  # plausibly ~50%
+
+    def test_window_scoped(self):
+        clock = FakeClock()
+        plan = FaultPlan(clock=clock)
+        plan.add(FaultSpec("net.slow", start=10.0, duration=5.0))
+        assert plan.fire("net.slow") is None  # before the window
+        clock.t = 12.0
+        assert plan.fire("net.slow") is not None  # inside
+        clock.t = 20.0
+        assert plan.fire("net.slow") is None  # after
+
+    def test_unknown_site_never_fires(self):
+        plan = FaultPlan([FaultSpec("net.refuse")])
+        assert plan.fire("device.read_error") is None
+
+    def test_first_matching_spec_wins_and_arg_passthrough(self):
+        plan = FaultPlan([FaultSpec("net.slow", count=1, arg=0.25),
+                          FaultSpec("net.slow", arg=1.0)])
+        assert plan.fire("net.slow").arg == 0.25
+        assert plan.fire("net.slow").arg == 1.0  # first spec exhausted
+
+    def test_stats_shape(self):
+        plan = FaultPlan([FaultSpec("net.refuse", count=1)])
+        plan.fire("net.refuse")
+        plan.fire("net.refuse")
+        assert plan.stats()["net.refuse"] == {"checks": 2, "fires": 1}
+
+
+class TestModuleSurface:
+    def test_disarmed_fire_is_none(self):
+        fault.uninstall()
+        assert fault.fire("net.refuse") is None
+        assert fault.active() is None
+
+    def test_install_uninstall(self):
+        plan = FaultPlan([FaultSpec("net.refuse", count=1)])
+        fault.install(plan)
+        try:
+            assert fault.active() is plan
+            assert fault.fire("net.refuse") is not None
+            assert fault.fire("net.refuse") is None
+        finally:
+            fault.uninstall()
+        assert fault.fire("net.refuse") is None
+
+    def test_installed_context_manager_restores(self):
+        outer = FaultPlan([FaultSpec("net.refuse")])
+        fault.install(outer)
+        try:
+            with fault.installed(FaultPlan([FaultSpec("net.slow")])) as p:
+                assert fault.active() is p
+            assert fault.active() is outer
+        finally:
+            fault.uninstall()
+        with fault.installed(FaultPlan()):
+            pass
+        assert fault.active() is None
+
+
+class TestFromConfig:
+    def test_builds_plan(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        cfg = FaultConfig(enabled=True, seed=3, specs=[
+            {"site": "net.refuse", "count": 2},
+            {"site": "report.clock_skew", "arg": 600.0},
+        ])
+        plan = FaultPlan.from_config(cfg)
+        assert set(plan.sites()) == {"net.refuse", "report.clock_skew"}
+
+    def test_rejects_unknown_site(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        with pytest.raises(ValueError, match="unknown site"):
+            FaultPlan.from_config(
+                FaultConfig(specs=[{"site": "disk.full"}]))
+
+    def test_rejects_unknown_keys(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        with pytest.raises(ValueError, match="unknown keys"):
+            FaultPlan.from_config(FaultConfig(specs=[
+                {"site": "net.refuse", "rate": 0.5}]))
+
+    def test_rejects_non_mapping(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        with pytest.raises(ValueError, match="mapping"):
+            FaultPlan.from_config(FaultConfig(specs=["net.refuse"]))
+
+    def test_bad_value_type_fails_whole_config_validation(self):
+        from kepler_tpu.config.config import load
+
+        cfg = load("fault:\n  enabled: true\n"
+                   "  specs:\n    - {site: net.slow, arg: fast}\n")
+        with pytest.raises(ValueError, match="must be a number"):
+            cfg.validate(skip=("host", "kube"))
+
+    def test_install_from_config_noop_when_disabled(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        assert fault.install_from_config(FaultConfig()) is None
+        assert fault.active() is None
+
+    def test_install_from_config_arms(self):
+        from kepler_tpu.config.config import FaultConfig
+
+        plan = fault.install_from_config(FaultConfig(
+            enabled=True, specs=[{"site": "net.refuse"}]))
+        try:
+            assert fault.active() is plan
+        finally:
+            fault.uninstall()

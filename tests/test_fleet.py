@@ -22,9 +22,14 @@ from kepler_tpu.server.http import APIServer
 from kepler_tpu.service.lifecycle import CancelContext
 
 
-def make_report(name="node-a", w=3, z=2, mode=MODE_RATIO, seed=0):
+def make_report(name="node-a", w=3, z=2, mode=MODE_RATIO, seed=0,
+                meta_pad=None):
     rng = np.random.default_rng(seed)
     cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+    meta = {"os": "linux"}
+    if meta_pad is not None:
+        # size-boundary tests: pad the wire body to an exact byte length
+        meta["pad"] = meta_pad
     return NodeReport(
         node_name=name,
         zone_deltas_uj=rng.uniform(1e6, 1e8, z).astype(np.float32),
@@ -36,7 +41,7 @@ def make_report(name="node-a", w=3, z=2, mode=MODE_RATIO, seed=0):
         dt_s=5.0,
         mode=mode,
         workload_kinds=np.ones(w, np.int8),
-        meta={"os": "linux"},
+        meta=meta,
     )
 
 

@@ -1,0 +1,690 @@
+"""Resilience tests: agent circuit breaker/backoff/connection-reuse,
+aggregator quarantine + per-node degradation accounting, monitor watchdog,
+/healthz + /readyz, and the chaos smoke (ISSUE 1 acceptance: a faulted
+single-node pipeline converges within 3 monitor intervals while the probe
+plane tracks degraded→ok).
+
+All fault sequences are seeded/count-scoped (``kepler_tpu.fault``); the
+only real sleeps are the agent's own backoff schedule (tens of ms)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kepler_tpu import fault
+from kepler_tpu.fault import FaultPlan, FaultSpec
+from kepler_tpu.fleet import Aggregator, FleetAgent, encode_report
+from kepler_tpu.fleet.agent import BREAKER_CLOSED, BREAKER_OPEN
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+from tests.test_fleet import (
+    FakeMeterMonitor,
+    make_report,
+    make_sample,
+    post_report,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    """Every test starts and ends disarmed."""
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+@pytest.fixture()
+def server():
+    s = APIServer(listen_addresses=["127.0.0.1:0"])
+    s.init()
+    ctx = CancelContext()
+    t = threading.Thread(target=s.run, args=(ctx,), daemon=True)
+    t.start()
+    time.sleep(0.05)
+    yield s
+    ctx.cancel()
+    s.shutdown()
+
+
+def http_get(server, path, timeout=5):
+    """GET returning (status, parsed-json-or-None) — 4xx/5xx included."""
+    host, port = server.addresses[0]
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=timeout) as r:
+            return r.status, json.loads(r.read() or b"null")
+    except urllib.error.HTTPError as err:
+        body = err.read()
+        try:
+            return err.code, json.loads(body)
+        except (ValueError, TypeError):
+            return err.code, None
+
+
+def make_agent(server, monitor=None, **kw):
+    host, port = server.addresses[0]
+    kw.setdefault("backoff_initial", 0.005)
+    kw.setdefault("backoff_max", 0.02)
+    kw.setdefault("jitter_seed", 0)
+    agent = FleetAgent(monitor or FakeMeterMonitor(),
+                       endpoint=f"http://{host}:{port}",
+                       node_name="res-node", **kw)
+    agent.init()
+    return agent
+
+
+class TestAgentResilience:
+    def test_persistent_connection_reuse(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor)
+        monitor.emit(make_sample())
+        monitor.emit(make_sample(ts=105.0))
+        agent._drain(CancelContext())
+        assert agent._stats["sent_total"] == 2
+        assert agent._stats["connects_total"] == 1  # one TCP conn, reused
+        assert agg._reports["res-node"].seq == 2
+        agent._close_conn()
+
+    def test_breaker_opens_then_sheds_without_attempts(self, server):
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, breaker_threshold=3,
+                           breaker_cooldown=30.0)
+        with fault.installed(FaultPlan([FaultSpec("net.refuse")])) as plan:
+            monitor.emit(make_sample())
+            agent._drain(CancelContext())
+            assert agent._breaker_state == BREAKER_OPEN
+            assert agent._stats["breaker_opens"] == 1
+            attempts = plan.checked("net.refuse")
+            assert attempts == 3  # exactly threshold sends were tried
+            assert not agent.health()["ok"]
+            # while open: new samples are shed — zero further attempts
+            monitor.emit(make_sample(ts=105.0))
+            agent._drain(CancelContext())
+            assert plan.checked("net.refuse") == attempts
+
+    def test_breaker_recovers_through_half_open_probe(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, breaker_threshold=2,
+                           breaker_cooldown=0.02)
+        with fault.installed(FaultPlan([FaultSpec("net.refuse", count=2)])):
+            monitor.emit(make_sample())
+            agent._drain(CancelContext())
+            assert agent._breaker_state == BREAKER_OPEN
+            monitor.emit(make_sample(ts=105.0))
+            time.sleep(0.03)  # cooldown elapses → next drain probes
+            agent._drain(CancelContext())
+        assert agent._breaker_state == BREAKER_CLOSED
+        assert agent.health()["ok"]
+        assert "res-node" in agg._reports
+        agent._close_conn()
+
+    def test_breaker_stays_open_without_probe_evidence(self, server):
+        # an elapsed cooldown alone must not flip health back to ok — the
+        # breaker stays open until a sample actually probes the aggregator
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, breaker_threshold=1,
+                           breaker_cooldown=0.01)
+        with fault.installed(FaultPlan([FaultSpec("net.refuse", count=1)])):
+            monitor.emit(make_sample())
+            agent._drain(CancelContext())
+        assert agent._breaker_state == BREAKER_OPEN
+        time.sleep(0.02)  # cooldown elapses, but the queue is empty
+        agent._drain(CancelContext())
+        assert agent._breaker_state == BREAKER_OPEN
+        assert not agent.health()["ok"]
+        monitor.emit(make_sample(ts=105.0))  # evidence arrives
+        agent._drain(CancelContext())
+        assert agent._breaker_state == BREAKER_CLOSED
+        assert agent.health()["ok"]
+        assert "res-node" in agg._reports
+        agent._close_conn()
+
+    def test_failed_probe_escalates_cooldown(self, server):
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, breaker_threshold=1,
+                           breaker_cooldown=0.01)
+        with fault.installed(FaultPlan([FaultSpec("net.refuse")])):
+            monitor.emit(make_sample())
+            agent._drain(CancelContext())
+            assert agent._breaker_state == BREAKER_OPEN
+            first = agent._breaker_backoff
+            monitor.emit(make_sample(ts=105.0))
+            time.sleep(0.02)
+            agent._drain(CancelContext())  # half-open probe fails
+            assert agent._breaker_state == BREAKER_OPEN
+            assert agent._breaker_backoff > first
+
+    def test_escalation_never_shrinks_a_long_configured_cooldown(
+            self, server):
+        # a breakerCooldown above the escalation cap must act as a floor:
+        # a failed probe can only lengthen the cooldown, never shorten it
+        agent = make_agent(server, breaker_threshold=1,
+                           breaker_cooldown=90.0)
+        agent._breaker_state = "half-open"
+        agent._on_send_failure(OSError("probe failed"))
+        assert agent._breaker_backoff >= 90.0
+
+    def test_shutdown_flushes_queued_reports(self, server):
+        # satellite: a clean node drain delivers its final window instead
+        # of abandoning the queue (no run() loop involved)
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor)
+        monitor.emit(make_sample())
+        monitor.emit(make_sample(ts=105.0))
+        agent.shutdown()
+        assert agent._stats["flushed_on_shutdown"] == 2
+        assert agg._reports["res-node"].seq == 2
+        assert agent._conn is None  # connection closed on the way out
+
+    def test_shutdown_flush_bounded_by_timeout(self):
+        agent = FleetAgent(FakeMeterMonitor(), endpoint="127.0.0.1:9",
+                           node_name="n", timeout_s=0.2, flush_timeout_s=0.3)
+        agent._on_window(make_sample())
+        start = time.monotonic()
+        agent.shutdown()
+        assert time.monotonic() - start < 2.0
+        assert agent._stats["flushed_on_shutdown"] == 0
+
+    def test_shutdown_flush_skipped_while_breaker_open(self, server):
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor)
+        agent._breaker_state = BREAKER_OPEN
+        monitor.emit(make_sample())
+        agent.shutdown()
+        assert agent._stats["connects_total"] == 0
+        assert agent._stats["flushed_on_shutdown"] == 0
+
+    def test_drop_warning_rate_limit_uses_monotonic(self, server, caplog):
+        # satellite: a stalled/skewed SAMPLE clock must not suppress drop
+        # warnings — rate limiting follows the host monotonic clock
+        mono = [1000.0]
+        agent = make_agent(server, monotonic=lambda: mono[0])
+        with caplog.at_level("WARNING", logger="kepler.fleet.agent"):
+            agent._log_drop(OSError("down"))
+            agent._log_drop(OSError("down"))  # same instant: suppressed
+            assert len([r for r in caplog.records
+                        if "send failed" in r.message]) == 1
+            mono[0] += 31.0  # sample clock never advanced, host clock did
+            agent._log_drop(OSError("down"))
+            assert len([r for r in caplog.records
+                        if "send failed" in r.message]) == 2
+
+    def test_client_rejection_drops_without_tripping_breaker(self, server):
+        # a payload the aggregator PERMANENTLY rejects (4xx) must not be
+        # retried forever nor open the breaker — the aggregator is up;
+        # shedding good reports behind it would be a self-inflicted outage
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, skew_tolerance=10.0,
+                         clock=lambda: now[0])
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, breaker_threshold=2,
+                           clock=lambda: now[0] + 500.0)  # skewed sender
+        for i in range(3):
+            monitor.emit(make_sample(ts=100.0 + i))
+        agent._drain(CancelContext())
+        assert agent._breaker_state == BREAKER_CLOSED  # never opened
+        assert agent.health()["ok"]
+        assert agent._stats["server_rejections"] == 3  # each tried ONCE
+        assert agent._stats["dropped_total"] == 3
+        assert agg._stats["clock_skew_total"] == 3
+        agent._close_conn()
+
+    def test_net_slow_fault_delays_but_delivers(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor)
+        monitor.emit(make_sample())
+        with fault.installed(FaultPlan([
+                FaultSpec("net.slow", count=1, arg=0.05)])):
+            start = time.monotonic()
+            agent._drain(CancelContext())
+            assert time.monotonic() - start >= 0.05
+        assert "res-node" in agg._reports  # slow, not lost
+        agent._close_conn()
+
+    def test_ring_overflow_counted_as_drop(self, server):
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, queue_max=2)
+        for i in range(5):
+            monitor.emit(make_sample(ts=100.0 + i))
+        assert len(agent._queue) == 2  # newest wins
+        assert agent._stats["dropped_total"] == 3
+
+
+class TestAggregatorQuarantine:
+    def post_with_sent_at(self, server, report, sent_at, seq=1):
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report",
+            data=encode_report(report, ["package", "dram"], seq=seq,
+                               sent_at=sent_at),
+            method="POST")
+        return urllib.request.urlopen(req, timeout=5)
+
+    def test_clock_skewed_report_quarantined(self, server):
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, skew_tolerance=60.0,
+                         clock=lambda: now[0])
+        agg.init()
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self.post_with_sent_at(server, make_report("skewed"),
+                                   sent_at=5000.0)
+        assert err.value.code == 422
+        assert "skew" in err.value.read().decode()
+        assert agg._stats["clock_skew_total"] == 1
+        assert agg._stats["quarantined_total"] == 1
+        assert "skewed" in agg.degraded_nodes()
+        assert not agg.health()["ok"]
+        # an in-tolerance report from another node still ingests
+        resp = self.post_with_sent_at(server, make_report("fine"),
+                                      sent_at=1010.0)
+        assert resp.status == 204
+        assert "fine" in agg._reports
+
+    def test_degradation_decays_after_ttl(self, server):
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, skew_tolerance=60.0,
+                         degraded_ttl=30.0, clock=lambda: now[0])
+        agg.init()
+        with pytest.raises(urllib.error.HTTPError):
+            self.post_with_sent_at(server, make_report("skewed"),
+                                   sent_at=0.0)
+        assert not agg.health()["ok"]
+        now[0] += 31.0  # clean for a full TTL
+        assert agg.health()["ok"]
+        assert agg.degraded_nodes() == {}
+
+    def test_malformed_charged_to_sending_node(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        host, port = server.addresses[0]
+        body = encode_report(make_report("corruptor"),
+                             ["package", "dram"])[:-4]  # truncated arrays
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=body, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 400
+        assert agg._stats["malformed_total"] == 1
+        assert "corruptor" in agg.degraded_nodes()
+        assert agg.degraded_nodes()["corruptor"]["malformed"] == 1
+
+    def test_degraded_table_bounded_against_name_floods(self, server):
+        # attacker-controlled names from malformed payloads must not grow
+        # the table without bound: oldest evicted at the cap, names capped
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, degraded_ttl=1e9,
+                         clock=lambda: now[0])
+        agg.init()
+        agg._degraded_cap = 8
+        with agg._lock:
+            for i in range(20):
+                now[0] += 1.0
+                agg._record_degraded_locked(f"junk-{i}" + "x" * 500,
+                                            "malformed", "flood")
+        assert len(agg._degraded) == 8
+        assert all(len(n) <= agg._degraded_name_cap for n in agg._degraded)
+        # newest offenders survive, oldest were evicted
+        assert any(n.startswith("junk-19") for n in agg._degraded)
+        assert not any(n.startswith("junk-0x") for n in agg._degraded)
+
+    def test_unattributable_garbage_stays_anonymous(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=b"not a report",
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=5)
+        assert agg._stats["malformed_total"] == 1
+        assert agg.degraded_nodes() == {}
+
+    def test_report_without_sent_at_accepted(self, server):
+        # pre-skew-check agents keep working (header field is optional)
+        now = [1000.0]
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, skew_tolerance=60.0,
+                         clock=lambda: now[0])
+        agg.init()
+        assert post_report(server, make_report("legacy")).status == 204
+
+    def test_skew_check_disabled_with_zero_tolerance(self, server):
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, skew_tolerance=0.0)
+        agg.init()
+        resp = self.post_with_sent_at(server, make_report("any"),
+                                      sent_at=0.0)
+        assert resp.status == 204
+
+    def test_quarantine_metrics_exported(self, server):
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import generate_latest
+
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        host, port = server.addresses[0]
+        body = encode_report(make_report("noisy"), ["package", "dram"])[:-4]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=body, method="POST")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=5)
+        registry = CollectorRegistry()
+        registry.register(agg)
+        text = generate_latest(registry).decode()
+        assert ('kepler_fleet_reports_quarantined_total'
+                '{reason="malformed"} 1.0') in text
+        assert "kepler_fleet_degraded_nodes 1.0" in text
+
+
+class TestReportSizeEnforcement:
+    """Satellite: MAX_REPORT_BYTES boundary — over rejected before
+    buffering, exactly-at-limit accepted."""
+
+    def _padded_report_body(self, target):
+        base = encode_report(make_report("sized"), ["package", "dram"])
+        pad = target - len(encode_report(
+            make_report("sized", meta_pad=""), ["package", "dram"]))
+        del base
+        body = encode_report(make_report("sized", meta_pad="x" * pad),
+                             ["package", "dram"])
+        assert len(body) == target, (len(body), target)
+        return body
+
+    def test_aggregator_registers_documented_cap(self, server):
+        from kepler_tpu.fleet.aggregator import MAX_REPORT_BYTES
+
+        agg = Aggregator(server, model_mode=None)
+        agg.init()
+        assert server._endpoints["/v1/report"].max_body == MAX_REPORT_BYTES
+
+    def test_boundary(self, server, monkeypatch):
+        import kepler_tpu.fleet.aggregator as aggmod
+
+        monkeypatch.setattr(aggmod, "MAX_REPORT_BYTES", 4096)
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        host, port = server.addresses[0]
+        at_limit = self._padded_report_body(4096)
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=at_limit, method="POST")
+        assert urllib.request.urlopen(req, timeout=5).status == 204
+        assert "sized" in agg._reports
+        over = self._padded_report_body(4097)
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=over, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 413
+        assert agg._stats["reports_total"] == 1  # never reached the handler
+
+
+class TestHealthEndpoints:
+    def test_default_healthz_ready_ok(self, server):
+        assert http_get(server, "/healthz")[0] == 200
+        assert http_get(server, "/readyz")[0] == 200
+
+    def test_failing_probe_degrades(self, server):
+        state = {"ok": True}
+        server.health.register_probe("thing", lambda: dict(state))
+        assert http_get(server, "/healthz")[0] == 200
+        state["ok"] = False
+        status, body = http_get(server, "/healthz")
+        assert status == 503
+        assert body["status"] == "degraded"
+        assert body["components"]["thing"]["ok"] is False
+        state["ok"] = True
+        assert http_get(server, "/healthz")[0] == 200
+
+    def test_raising_probe_is_failed_not_500(self, server):
+        def bad():
+            raise RuntimeError("probe exploded")
+
+        server.health.register_probe("bad", bad)
+        status, body = http_get(server, "/healthz")
+        assert status == 503
+        assert "probe exploded" in body["components"]["bad"]["error"]
+
+    def test_readiness_transitions(self, server):
+        ready = threading.Event()
+        server.health.register_readiness(
+            "monitor", lambda: {"ok": ready.is_set()})
+        status, body = http_get(server, "/readyz")
+        assert status == 503 and body["status"] == "unready"
+        ready.set()
+        assert http_get(server, "/readyz")[0] == 200
+
+    def test_healthz_independent_of_readiness(self, server):
+        server.health.register_readiness("never", lambda: {"ok": False})
+        assert http_get(server, "/healthz")[0] == 200
+        assert http_get(server, "/readyz")[0] == 503
+
+    def test_probe_detail_passthrough(self, server):
+        server.health.register_probe(
+            "agent", lambda: {"ok": True, "breaker": "closed"})
+        _, body = http_get(server, "/healthz")
+        assert body["components"]["agent"]["breaker"] == "closed"
+
+
+class TestMonitorWatchdog:
+    def _monitored(self, **kw):
+        from tests.test_monitor import make_monitor
+
+        return make_monitor(**kw)
+
+    def test_stall_detected_and_recovers(self):
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        mon, _, zones, clock = self._monitored()
+        wd = MonitorWatchdog(mon, interval=5.0, monotonic=clock)
+        mon.refresh()
+        assert wd.check_once() is False
+        assert wd.health()["ok"]
+        clock.step(16.0)  # > 3 × interval with no refresh
+        assert wd.check_once() is True
+        assert mon.stalled
+        assert not wd.health()["ok"]
+        assert not mon.health()["ok"]
+        mon.refresh()  # loop comes back → flag clears
+        assert not mon.stalled
+        assert wd.check_once() is False
+        assert wd.health()["ok"]
+
+    def test_no_first_refresh_counts_as_stall(self):
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        mon, _, zones, clock = self._monitored()
+        wd = MonitorWatchdog(mon, interval=5.0, monotonic=clock)
+        assert wd.check_once() is False  # inside the startup grace
+        clock.step(20.0)
+        assert wd.check_once() is True
+
+    def test_explicit_stall_threshold(self):
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        mon, _, zones, clock = self._monitored()
+        wd = MonitorWatchdog(mon, interval=5.0, stall_after=100.0,
+                             monotonic=clock)
+        mon.refresh()
+        clock.step(50.0)
+        assert wd.check_once() is False  # 3× interval would have fired
+
+    def test_device_read_error_fault_masks_zone(self):
+        mon, _, zones, clock = self._monitored()
+        samples = []
+        mon.add_window_listener(samples.append)
+        mon.refresh()  # seeds counters
+        zones[0].increment = 1_000_000
+        zones[1].increment = 1_000_000
+        clock.step(5.0)
+        with fault.installed(FaultPlan([
+                FaultSpec("device.read_error", count=1)])):
+            mon.refresh()  # first zone read fails this tick
+        assert samples[-1].zone_valid.tolist() == [False, True]
+        clock.step(5.0)
+        mon.refresh()  # fault exhausted: next window fully valid again
+        assert samples[-1].zone_valid.tolist() == [True, True]
+
+    def test_device_read_error_fault_on_real_meter_path(self):
+        # the injection point sits in _read_zone_deltas, so it also covers
+        # meters whose reads succeed (FakeCPUMeter in soak runs)
+        from kepler_tpu.device.fake import FakeCPUMeter
+
+        meter = FakeCPUMeter(zones=["package"], seed=0)
+        zone = meter.zones()[0]
+        with fault.installed(FaultPlan([
+                FaultSpec("device.read_error")])):
+            # direct zone reads still work; the masking is monitor-level
+            assert int(zone.energy()) >= 0
+
+    def test_device_counter_wrap_fault_flows_through(self):
+        mon, _, zones, clock = self._monitored()
+        samples = []
+        mon.add_window_listener(samples.append)
+        mon.refresh()
+        zones[0].counter = 1_000_000  # away from the wrap point
+        zones[0].increment = 1_000
+        zones[1].increment = 1_000
+        clock.step(5.0)
+        with fault.installed(FaultPlan([
+                FaultSpec("device.counter_wrap", count=1, arg=500.0)])):
+            mon.refresh()
+        s = samples[-1]
+        # wrapped counter → delta via max_energy, still valid and finite
+        assert s.zone_valid.tolist() == [True, True]
+        assert np.isfinite(s.zone_deltas_uj).all()
+        assert s.zone_deltas_uj[0] > 0
+
+
+@pytest.mark.chaos
+class TestChaosSmoke:
+    """Satellite 5 + acceptance criteria: one agent→aggregator pipeline
+    under `net.refuse`→recover, one corrupted body, and one device read
+    error — converges within 3 monitor intervals; /v1/results serveable
+    throughout; /healthz and /readyz track degraded→ok. Deterministic:
+    every fault is count/skip-scoped, every sleep is the agent's own
+    (tiny) backoff schedule."""
+
+    def test_faulted_pipeline_converges_and_health_recovers(self, server):
+        from tests.test_monitor import make_monitor
+        from tests.test_resource import MockProc
+
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        mon, _, zones, clock = make_monitor(procs=[MockProc(1, cpu=1.0)])
+        agg = Aggregator(server, model_mode=None, node_bucket=8,
+                         workload_bucket=16, stale_after=300.0,
+                         skew_tolerance=120.0, degraded_ttl=60.0,
+                         clock=clock)
+        agg.init()
+        watchdog = MonitorWatchdog(mon, interval=5.0, monotonic=clock)
+        server.health.register_probe("monitor-watchdog", watchdog.health)
+        server.health.register_readiness(
+            "monitor", lambda: {"ok": mon.data_channel().is_set()})
+        host, port = server.addresses[0]
+        agent = FleetAgent(mon, endpoint=f"http://{host}:{port}",
+                           node_name="chaos-node", breaker_threshold=2,
+                           breaker_cooldown=0.02, backoff_initial=0.005,
+                           backoff_max=0.02, jitter_seed=0, clock=clock)
+        agent.init()
+        server.health.register_probe("fleet-agent", agent.health)
+        ctx = CancelContext()
+
+        # not ready before the first snapshot; healthy (nothing degraded)
+        assert http_get(server, "/readyz")[0] == 503
+        assert http_get(server, "/healthz")[0] == 200
+
+        plan = FaultPlan([
+            FaultSpec("net.refuse", count=2),       # first 2 connects die
+            FaultSpec("net.corrupt_body", count=1),  # then 1 corrupt body
+            FaultSpec("device.read_error", skip=4, count=1),  # 3rd window
+        ])
+        with fault.installed(plan):
+            # interval 1: seed refresh → sample 1; both connects refused →
+            # breaker opens; /v1/results already serveable (empty)
+            mon.refresh()
+            assert http_get(server, "/readyz")[0] == 200
+            agent._drain(ctx)
+            assert agent._breaker_state == BREAKER_OPEN
+            status, body = http_get(server, "/healthz")
+            assert status == 503
+            assert body["components"]["fleet-agent"]["breaker"] == "open"
+            assert http_get(server, "/v1/results")[0] == 200
+
+            # interval 2: half-open probe sends a corrupted body → 400.
+            # The aggregator ANSWERED, so the breaker closes (delivery
+            # path healthy) while the aggregator quarantines the report
+            # and charges the node — /healthz stays degraded via the
+            # aggregator probe, not the agent's
+            for z in zones:
+                z.increment = 1_000_000
+            clock.step(5.0)
+            mon.refresh()
+            time.sleep(0.03)  # > breaker cooldown
+            agent._drain(ctx)
+            assert agent._breaker_state == BREAKER_CLOSED
+            assert agent._stats["server_rejections"] == 1
+            assert "chaos-node" in agg.degraded_nodes()
+            status, body = http_get(server, "/healthz")
+            assert status == 503
+            assert body["components"]["fleet-aggregator"]["ok"] is False
+            assert http_get(server, "/v1/results")[0] == 200
+
+            # interval 3: faults exhausted — the window (with its
+            # injected zone-read error masked) is delivered and attributed
+            clock.step(5.0)
+            mon.refresh()
+            agent._drain(ctx)
+        assert agent._breaker_state == BREAKER_CLOSED
+        assert "chaos-node" in agg._reports
+        stored = agg._reports["chaos-node"]
+        assert stored.report.zone_valid.tolist() == [False, True]  # masked
+        assert agg.aggregate_once() is not None  # within 3 intervals
+        status, body = http_get(server, "/v1/results?node=chaos-node")
+        assert status == 200
+        assert np.isfinite(
+            np.asarray(body["node_power_uw"], np.float64)).all()
+
+        # fault accounting: exactly the planned faults fired
+        assert plan.fired("net.refuse") == 2
+        assert plan.fired("net.corrupt_body") == 1
+        assert plan.fired("device.read_error") == 1
+
+        # recovery: degradation decays, the watchdog sees a live loop,
+        # and the probe plane returns to ok
+        clock.step(61.0)
+        mon.refresh()
+        watchdog.check_once()
+        status, body = http_get(server, "/healthz")
+        assert status == 200, body
+        assert body["status"] == "ok"
+        agent._close_conn()

@@ -7,6 +7,7 @@ import pytest
 
 from kepler_tpu.service import (
     CancelContext,
+    RestartPolicy,
     ServiceError,
     init_services,
     run_services,
@@ -107,3 +108,99 @@ class TestRun:
         passive = FakeService("passive", rec)
         run_services(CancelContext(), [passive, runner])
         assert "shutdown:passive" in rec.events
+
+
+class FlakyService:
+    """Crashes the first ``crashes`` runs, then behaves."""
+
+    def __init__(self, rec, crashes, then_returns=True):
+        self.rec = rec
+        self.crashes = crashes
+        self.then_returns = then_returns
+        self.runs = 0
+
+    def name(self):
+        return "flaky"
+
+    def run(self, ctx):
+        self.runs += 1
+        self.rec.add(f"run:{self.runs}")
+        if self.runs <= self.crashes:
+            raise RuntimeError(f"crash {self.runs}")
+        if not self.then_returns:
+            ctx.wait(5.0)
+
+    def shutdown(self):
+        self.rec.add("shutdown")
+
+
+FAST_RESTARTS = RestartPolicy(max_restarts=3, backoff_initial=0.005,
+                              backoff_max=0.02, seed=0)
+
+
+class TestRestartPolicy:
+    """Supervised restart-with-backoff (ISSUE 1 tentpole): crashes inside
+    the budget self-heal; exhausted budgets and clean returns keep the
+    oklog/run group semantics."""
+
+    def test_crash_within_budget_restarts_then_runs_clean(self):
+        rec = Recorder()
+        flaky = FlakyService(rec, crashes=2)
+        run_services(CancelContext(), [flaky], restart=FAST_RESTARTS)
+        assert flaky.runs == 3  # 2 crashes + 1 clean run
+        assert "shutdown" in rec.events
+
+    def test_budget_exhausted_fails_group(self):
+        rec = Recorder()
+        flaky = FlakyService(rec, crashes=99)
+        with pytest.raises(ServiceError):
+            run_services(CancelContext(), [flaky], restart=FAST_RESTARTS)
+        assert flaky.runs == 1 + FAST_RESTARTS.max_restarts
+
+    def test_clean_return_never_restarts(self):
+        rec = Recorder()
+        quick = FakeService("quick", rec, run_returns_immediately=True)
+        ctx = CancelContext()
+        run_services(ctx, [quick], restart=FAST_RESTARTS)
+        assert ctx.cancelled()
+        assert rec.events.count("run:quick") == 1
+
+    def test_restarting_service_does_not_cancel_group(self):
+        rec = Recorder()
+        flaky = FlakyService(rec, crashes=1, then_returns=False)
+        other = FakeService("other", rec, has_run=True)
+        stopper_ready = threading.Event()
+
+        class Stopper:
+            def name(self):
+                return "stopper"
+
+            def run(self, ctx):
+                # return (cancelling the group) only once flaky recovered
+                while flaky.runs < 2 and not ctx.cancelled():
+                    ctx.wait(0.005)
+                stopper_ready.set()
+
+        run_services(CancelContext(), [flaky, other, Stopper()],
+                     restart=FAST_RESTARTS)
+        assert stopper_ready.is_set()
+        assert flaky.runs == 2  # crashed once, restarted, survived
+
+    def test_no_policy_keeps_reference_semantics(self):
+        rec = Recorder()
+        flaky = FlakyService(rec, crashes=1)
+        with pytest.raises(ServiceError):
+            run_services(CancelContext(), [flaky])
+        assert flaky.runs == 1
+
+    def test_backoff_schedule_is_seeded_and_bounded(self):
+        import random
+
+        policy = RestartPolicy(max_restarts=5, backoff_initial=0.5,
+                               backoff_max=4.0, seed=7)
+        a = [policy.backoff(i, random.Random(7)) for i in range(1, 6)]
+        b = [policy.backoff(i, random.Random(7)) for i in range(1, 6)]
+        assert a == b  # replayable
+        for i, delay in enumerate(a, start=1):
+            base = min(4.0, 0.5 * 2 ** (i - 1))
+            assert base / 2 <= delay <= base
