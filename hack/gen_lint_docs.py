@@ -1,0 +1,148 @@
+#!/usr/bin/env python3
+"""Generate ``docs/developer/static-analysis.md`` from the keplint registry.
+
+Same pattern (and teeth) as ``hack/gen_config_docs.py`` /
+``gen_metric_docs.py``: the rule catalog is rendered from the live
+registry in ``kepler_tpu.analysis``, so the doc can never silently drift
+from the rules — adding a rule without regenerating fails ``--check``
+(and the freshness test), and every rule must carry a summary and a
+rationale or the generator refuses to render.
+
+Usage:  python hack/gen_lint_docs.py [--check]
+  --check   exit 1 if docs/developer/static-analysis.md is stale.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kepler_tpu.analysis import all_rules  # noqa: E402
+
+OUT_PATH = os.path.join(REPO, "docs", "developer", "static-analysis.md")
+
+PREAMBLE = """\
+# Static analysis: keplint + the typing ratchet
+
+Generated from the live rule registry by `hack/gen_lint_docs.py` — do
+not edit by hand; regenerate with `python hack/gen_lint_docs.py` (CI
+checks freshness with `--check`).
+
+The attribution formula is only correct while a handful of code-level
+invariants hold *everywhere*: counter deltas must be wrap-aware, timing
+logic must use monotonic clocks, published snapshots must stay
+immutable, jitted kernels must stay pure. Generic linters cannot see
+those — they are domain invariants — so `keplint`
+(`kepler_tpu/analysis/`) encodes each one as an AST check. `make lint`
+runs keplint, ruff (config committed in `pyproject.toml`), and mypy
+(per-module strictness ratchet, also in `pyproject.toml`).
+
+## Running
+
+```
+python -m kepler_tpu.analysis              # lint kepler_tpu/ (repo root)
+python -m kepler_tpu.analysis path/ file.py
+python -m kepler_tpu.analysis --list-rules
+```
+
+Exit codes: `0` clean (baselined findings tolerated), `1` new
+violations, `2` usage errors.
+
+## Suppressing
+
+Append `# keplint: disable=KTL1xx` to the offending line (or put it on
+a comment line directly above); several ids separate with commas, and a
+bare `disable` suppresses every rule on that line. `# keplint:
+disable-file=KTL1xx` anywhere in the file suppresses a rule file-wide.
+Every suppression should say *why* in the surrounding comment.
+
+## Scoping markers
+
+Rules that need to know which code is special read declarative markers
+instead of hardcoding module lists:
+
+| Marker | Meaning |
+| --- | --- |
+| `# keplint: monotonic-only` (file-level) | KTL101: this module's timing math must never call the wall clock directly |
+| `# keplint: hot-loop` (above a `def`) | KTL106: this function runs on the monitor refresh path; no sleeps/blocking I/O |
+| `# keplint: guarded-by=_lock` (on an attribute assignment in `__init__`) | KTL108: writes to this attribute require `with self._lock` |
+| `# keplint: requires-lock=_lock` (above a `def`) | KTL108: this function may only be called with the lock held; callers are checked too |
+
+## Baseline ratchet
+
+`.keplint.json` at the repo root freezes pre-existing violation counts
+per `path::rule`. New violations fail; baselined ones pass; *fixed*
+ones surface as stale entries — regenerate with
+`python -m kepler_tpu.analysis --write-baseline` to ratchet the ceiling
+down. The committed baseline is **empty**: every finding in the shipped
+tree was fixed, not grandfathered (`tests/test_keplint.py` pins this).
+
+The same ratchet stance applies to typing: `pyproject.toml` declares a
+strict mypy tier (`config/`, `monitor/snapshot`, `fleet/wire`,
+`fault/`, `analysis/` — fully typed, `disallow_untyped_defs`) and a
+checked tier (`monitor/`, `fleet/`, `service/` —
+`check_untyped_defs`); modules move *up* tiers, never down.
+
+## Extending
+
+Subclass `kepler_tpu.analysis.Rule`, set `id`/`name`/`severity`/
+`summary`/`rationale`, implement `check(ctx)` over `ctx.tree`
+(a parsed `ast.Module`), and decorate with `@register` in
+`kepler_tpu/analysis/rules.py`. Add a good/bad fixture pair to
+`tests/test_keplint.py` and regenerate this doc. Engine internals
+(directives, baselines, file walking) live in
+`kepler_tpu/analysis/engine.py`.
+
+## Rule catalog
+"""
+
+
+def render() -> str:
+    rules = all_rules()
+    missing = [r.id for r in rules if not (r.summary and r.rationale)]
+    if missing:
+        raise SystemExit(
+            f"gen_lint_docs: rules missing summary/rationale: {missing}")
+    lines = [PREAMBLE]
+    lines.append("| Rule | Name | Severity | Invariant |")
+    lines.append("| --- | --- | --- | --- |")
+    for r in rules:
+        lines.append(f"| `{r.id}` | {r.name} | {r.severity} | "
+                     f"{r.summary} |")
+    lines.append("")
+    for r in rules:
+        lines.append(f"### {r.id} — {r.name}")
+        lines.append("")
+        lines.append(f"**Invariant:** {r.summary}.")
+        lines.append("")
+        lines.append(r.rationale)
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main() -> int:
+    text = render()
+    if "--check" in sys.argv:
+        try:
+            with open(OUT_PATH, encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            current = ""
+        if current != text:
+            print(f"{OUT_PATH} is stale; run python hack/gen_lint_docs.py",
+                  file=sys.stderr)
+            return 1
+        print(f"{OUT_PATH} is up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT_PATH), exist_ok=True)
+    with open(OUT_PATH, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"wrote {OUT_PATH} ({len(text.splitlines())} lines)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""keplint: project-native static analysis for the attribution stack.
+
+Run as ``python -m kepler_tpu.analysis [paths]`` (wired into ``make
+lint``). The engine lives in :mod:`kepler_tpu.analysis.engine`, the
+domain rules in :mod:`kepler_tpu.analysis.rules`; the rule catalog is
+rendered to ``docs/developer/static-analysis.md`` by
+``hack/gen_lint_docs.py`` and checked fresh in CI.
+"""
+
+from kepler_tpu.analysis.engine import (
+    Baseline,
+    Diagnostic,
+    FileContext,
+    LintResult,
+    REGISTRY,
+    Rule,
+    all_rules,
+    find_repo_root,
+    lint_paths,
+    register,
+)
+from kepler_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+__all__ = [
+    "Baseline",
+    "Diagnostic",
+    "FileContext",
+    "LintResult",
+    "REGISTRY",
+    "Rule",
+    "all_rules",
+    "find_repo_root",
+    "lint_paths",
+    "register",
+]

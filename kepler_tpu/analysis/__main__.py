@@ -1,0 +1,104 @@
+"""keplint CLI: ``python -m kepler_tpu.analysis [paths]``.
+
+Exit codes: 0 clean (baselined violations and stale-baseline notices do
+not fail), 1 new error-severity findings, 2 usage errors. The default
+baseline is ``.keplint.json`` at the repo root (the directory holding
+pyproject.toml, walked up from the first path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Sequence
+
+from kepler_tpu.analysis.engine import (
+    Baseline,
+    LintResult,
+    all_rules,
+    find_repo_root,
+    lint_paths,
+)
+
+BASELINE_NAME = ".keplint.json"
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kepler_tpu.analysis",
+        description="keplint: AST invariant checks for the attribution "
+                    "stack (see docs/developer/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to lint "
+                             "(default: kepler_tpu under the repo root)")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: <root>/"
+                             f"{BASELINE_NAME} when present)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore any baseline file")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="freeze current violations into the "
+                             "baseline and exit 0")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name:<20} [{rule.severity}] "
+                  f"{rule.summary}")
+        return 0
+
+    root = find_repo_root(args.paths[0] if args.paths else os.getcwd())
+    paths = args.paths or [os.path.join(root, "kepler_tpu")]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"keplint: no such path: {path}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    baseline: Baseline | None = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            try:
+                baseline = Baseline.load(baseline_path)
+            except (OSError, ValueError) as err:
+                print(f"keplint: bad baseline {baseline_path}: {err}",
+                      file=sys.stderr)
+                return 2
+
+    if args.write_baseline:
+        full = lint_paths(paths, root=root)
+        Baseline.from_diagnostics(full.diagnostics).save(baseline_path)
+        print(f"keplint: wrote {baseline_path} "
+              f"({len(full.diagnostics)} frozen violation(s))")
+        return 0
+
+    result: LintResult = lint_paths(paths, root=root, baseline=baseline)
+    return report(result)
+
+
+def report(result: LintResult) -> int:
+    for diag in result.diagnostics:
+        print(diag.render())
+    if result.stale_entries:
+        print("keplint: stale baseline entries (violations fixed — "
+              "regenerate with --write-baseline to ratchet down):",
+              file=sys.stderr)
+        for key in result.stale_entries:
+            print(f"  {key}", file=sys.stderr)
+    if result.diagnostics:
+        n = len(result.diagnostics)
+        suffix = (f" ({result.baselined} more baselined)"
+                  if result.baselined else "")
+        print(f"keplint: {n} new violation(s){suffix}", file=sys.stderr)
+        return 1 if result.failed else 0
+    extra = (f" ({result.baselined} baselined violation(s) tolerated)"
+             if result.baselined else "")
+    print(f"keplint: clean{extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,826 @@
+"""keplint domain rules: the attribution-stack invariants, as AST checks.
+
+Each rule encodes one invariant the attribution formula depends on (see
+``docs/developer/static-analysis.md`` for the catalog — generated from
+this registry by ``hack/gen_lint_docs.py``). Scoping is declarative where
+it can be: files opt into clock discipline with ``# keplint:
+monotonic-only``, hot functions are marked ``# keplint: hot-loop``, and
+lock contracts are annotated at the attribute (``# keplint:
+guarded-by=_lock``) and function (``# keplint: requires-lock=_lock``)
+level — so the rules need no hardcoded knowledge of which module does
+what, and fixture tests exercise them hermetically.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from kepler_tpu.analysis.engine import (
+    Diagnostic,
+    FileContext,
+    Rule,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _qualname(node: ast.AST) -> str | None:
+    """Dotted name for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Imports:
+    """Per-file import alias map, so ``_time.time()`` and
+    ``from time import time as now; now()`` both canonicalize to
+    ``time.time``."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.alias: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.alias[head] = head
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.alias[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+    def canonical(self, qual: str | None) -> str | None:
+        if not qual:
+            return None
+        head, _, rest = qual.partition(".")
+        head = self.alias.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+
+def _imports_for(ctx: FileContext) -> _Imports:
+    """One alias map per file, shared by every rule that needs it."""
+    cached = getattr(ctx, "_keplint_imports", None)
+    if cached is None:
+        cached = _Imports(ctx.tree)
+        ctx._keplint_imports = cached  # type: ignore[attr-defined]
+    return cached
+
+
+def _call_canonical(node: ast.Call, imports: _Imports) -> str | None:
+    return imports.canonical(_qualname(node.func))
+
+
+def _terminal(name: str | None) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# ---------------------------------------------------------------------------
+# KTL101 — monotonic clocks in timing logic
+# ---------------------------------------------------------------------------
+
+_WALL_CLOCK_CALLS = {
+    "time.time",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.datetime.today",
+    "datetime.date.today",
+}
+
+
+@register
+class MonotonicClockRule(Rule):
+    id = "KTL101"
+    name = "monotonic-clock"
+    summary = ("no wall-clock calls in modules marked "
+               "`# keplint: monotonic-only`")
+    rationale = (
+        "Backoff, rate-limit, circuit-breaker, and watchdog arithmetic "
+        "breaks when NTP steps the wall clock (the exact bug class PR 1 "
+        "fixed by hand). Timing modules declare `# keplint: "
+        "monotonic-only` and may then only *call* `time.monotonic()` or "
+        "an injected clock seam; referencing `time.time` as an injectable "
+        "default stays legal because the seam is the point.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        if not ctx.has_file_marker("monotonic-only"):
+            return
+        imports = _imports_for(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = _call_canonical(node, imports)
+            if canon in _WALL_CLOCK_CALLS:
+                yield ctx.diag(
+                    self, node,
+                    f"wall-clock call {canon}() in a monotonic-only "
+                    "module; use time.monotonic() or the injected "
+                    "clock/monotonic seam")
+
+
+# ---------------------------------------------------------------------------
+# KTL102 — wrap-aware energy-counter deltas
+# ---------------------------------------------------------------------------
+
+_COUNTERISH = re.compile(r"(^|_)(energy|counter)(_|$)|(^|_)uj$",
+                         re.IGNORECASE)
+# time.perf_counter / counters of unrelated kinds are not energy counters
+_NOT_COUNTERISH = re.compile(r"perf_counter$", re.IGNORECASE)
+
+
+def _is_counterish(name: str) -> bool:
+    return bool(_COUNTERISH.search(name)
+                and not _NOT_COUNTERISH.search(name))
+
+# the canonical helper (and the docstring'd inline implementation it
+# wraps) are the two places allowed to do raw counter arithmetic
+_DELTA_HELPER_SUFFIXES = ("kepler_tpu/ops/deltas.py",)
+
+
+def _operand_name(node: ast.AST) -> str:
+    """Identifier a subtraction operand 'reads from': the terminal
+    attribute/name, looking through a call (``zone.energy() - prev``)."""
+    if isinstance(node, ast.Call):
+        return _terminal(_qualname(node.func))
+    return _terminal(_qualname(node))
+
+
+@register
+class WrapAwareDeltaRule(Rule):
+    id = "KTL102"
+    name = "wrap-aware-delta"
+    summary = ("energy-counter subtraction must go through "
+               "ops.deltas.energy_delta")
+    rationale = (
+        "RAPL counters wrap at max_energy_range_uj; a raw `current - "
+        "prev` turns every wrap into a huge negative delta that corrupts "
+        "cumulative joules and the attribution numerator. All counter "
+        "delta math goes through `kepler_tpu.ops.deltas.energy_delta` / "
+        "`energy_deltas` (exact wraparound semantics, reference "
+        "node.go:87-98).")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        if ctx.rel_path.endswith(_DELTA_HELPER_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            left = _operand_name(node.left)
+            right = _operand_name(node.right)
+            if not (left and right):
+                continue  # literals / nested expressions: not counter math
+            if _is_counterish(left) or _is_counterish(right):
+                yield ctx.diag(
+                    self, node,
+                    f"raw subtraction on energy-counter-like operands "
+                    f"({left!r} - {right!r}); use "
+                    "kepler_tpu.ops.deltas.energy_delta for wrap-aware "
+                    "math")
+
+
+# ---------------------------------------------------------------------------
+# KTL103 — published snapshots stay immutable
+# ---------------------------------------------------------------------------
+
+# distinctive Snapshot/NodeUsage/WorkloadTable field names; generic ones
+# (ids/meta/node/...) are omitted so unrelated objects don't false-positive
+_SNAPSHOT_FIELDS = frozenset({
+    "energy_uj", "active_uj", "idle_uj",
+    "power_uw", "active_power_uw", "idle_power_uw",
+    "window_active_uj", "zone_names",
+    "terminated_processes", "terminated_containers",
+    "terminated_virtual_machines", "terminated_pods",
+})
+
+# the monitor build path constructs snapshots before publication
+_SNAPSHOT_BUILDER_SUFFIXES = (
+    "kepler_tpu/monitor/monitor.py",
+    "kepler_tpu/monitor/snapshot.py",
+)
+
+
+@register
+class SnapshotImmutableRule(Rule):
+    id = "KTL103"
+    name = "snapshot-immutable"
+    summary = "no mutation of Snapshot fields outside the monitor build path"
+    rationale = (
+        "`PowerMonitor.snapshot(clone=False)` hands consumers the "
+        "published object itself; the exporter's zero-copy scrape render "
+        "is only race-free because a published Snapshot is never mutated "
+        "— each refresh builds new arrays and swaps the reference. The "
+        "dataclasses are frozen, but numpy array *contents* are not, so "
+        "`snap.node.energy_uj[0] = x` (or `object.__setattr__`) would "
+        "corrupt concurrent scrapes silently.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        if ctx.rel_path.endswith(_SNAPSHOT_BUILDER_SUFFIXES):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                canon = _qualname(node.func)
+                if canon == "object.__setattr__":
+                    yield ctx.diag(
+                        self, node,
+                        "object.__setattr__ defeats frozen-dataclass "
+                        "immutability; build a new Snapshot instead")
+                continue
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for target in targets:
+                # unwrap element writes: snap.node.energy_uj[...] = v
+                inner = target
+                while isinstance(inner, ast.Subscript):
+                    inner = inner.value
+                if not isinstance(inner, ast.Attribute):
+                    continue
+                if inner.attr not in _SNAPSHOT_FIELDS:
+                    continue
+                # only a DIRECT `self.<field>` write is own state (the
+                # monitor-style accumulator); a deeper chain rooted at
+                # self (`self._snap.node.energy_uj[...]`) is a held
+                # published snapshot and exactly the bug class
+                if (isinstance(inner.value, ast.Name)
+                        and inner.value.id == "self"):
+                    continue
+                yield ctx.diag(
+                    self, node,
+                    f"mutation of snapshot field {inner.attr!r} outside "
+                    "the monitor build path; published snapshots are "
+                    "immutable — build new arrays and swap the reference")
+
+
+# ---------------------------------------------------------------------------
+# KTL104 — config reads must be declared (and documented)
+# ---------------------------------------------------------------------------
+
+_CONFIG_PY = "kepler_tpu/config/config.py"
+_GEN_CONFIG_DOCS = "hack/gen_config_docs.py"
+
+_schema_cache: dict[str, dict | None] = {}
+
+
+def _dataclass_classes(tree: ast.Module) -> dict[str, ast.ClassDef]:
+    out: dict[str, ast.ClassDef] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for deco in node.decorator_list:
+            name = _qualname(deco if not isinstance(deco, ast.Call)
+                             else deco.func)
+            if name and name.split(".")[-1] == "dataclass":
+                out[node.name] = node
+                break
+    return out
+
+
+def _class_schema(cls: ast.ClassDef, classes: dict[str, ast.ClassDef],
+                  stack: tuple[str, ...] = ()) -> dict:
+    """{'fields': {name: sub-schema|None}, 'extras': {methods/classvars}}"""
+    fields: dict[str, dict | None] = {}
+    extras: set[str] = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name):
+            sub = None
+            ann = _qualname(stmt.annotation) or ""
+            target_cls = ann.split(".")[-1]
+            if target_cls not in classes and isinstance(
+                    stmt.value, ast.Call):
+                for kw in stmt.value.keywords:
+                    if kw.arg == "default_factory":
+                        target_cls = _terminal(_qualname(kw.value))
+            if (target_cls in classes and target_cls != cls.name
+                    and target_cls not in stack):
+                sub = _class_schema(classes[target_cls], classes,
+                                    stack + (cls.name,))
+            fields[stmt.target.id] = sub
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    extras.add(t.id)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            extras.add(stmt.name)
+    return {"fields": fields, "extras": extras}
+
+
+def _config_schema_for(ctx: FileContext) -> dict | None:
+    """Schema of the repo's Config tree, parsed statically from
+    kepler_tpu/config/config.py under the lint root (fixture-friendly:
+    a tmp tree with its own config.py gets its own schema)."""
+    import os
+
+    cache_key = ctx.root
+    if cache_key in _schema_cache:
+        return _schema_cache[cache_key]
+    schema: dict | None = None
+    cfg_path = os.path.join(ctx.root, *_CONFIG_PY.split("/"))
+    try:
+        with open(cfg_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        classes = _dataclass_classes(tree)
+        if "Config" in classes:
+            schema = _class_schema(classes["Config"], classes)
+    except (OSError, SyntaxError):
+        schema = None
+    _schema_cache[cache_key] = schema
+    return schema
+
+
+def _documented_config_keys(ctx: FileContext) -> set[str] | None:
+    """Keys of DESCRIPTIONS in hack/gen_config_docs.py, or None when the
+    generator is absent (fixtures without a hack/ tree)."""
+    import os
+
+    gen_path = os.path.join(ctx.root, *_GEN_CONFIG_DOCS.split("/"))
+    try:
+        with open(gen_path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "DESCRIPTIONS"
+                        for t in node.targets)
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _schema_leaves(schema: dict, prefix: str = "") -> Iterator[str]:
+    for name, sub in schema["fields"].items():
+        path = f"{prefix}{name}"
+        if sub is None:
+            yield path
+        else:
+            yield from _schema_leaves(sub, f"{path}.")
+
+
+@register
+class ConfigDeclaredRule(Rule):
+    id = "KTL104"
+    name = "config-declared"
+    summary = ("every `cfg.*` attribute read must exist in config.py and "
+               "be documented in hack/gen_config_docs.py")
+    rationale = (
+        "Config is a plain dataclass tree: reading `cfg.monitor.intervall` "
+        "raises AttributeError only on the code path that reaches it — in "
+        "production, at 3am. Statically resolving every `cfg.`-rooted "
+        "attribute chain against the declared schema turns that into a "
+        "lint failure; requiring a DESCRIPTIONS entry per leaf keeps "
+        "`docs/user/configuration.md` complete (the generator's teeth, "
+        "enforced at lint time too).")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        schema = _config_schema_for(ctx)
+        if schema is None:
+            return
+        # part 1: cfg.<...> reads anywhere resolve against the schema
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            qual = _qualname(node)
+            if not qual:
+                continue
+            parts = qual.split(".")
+            # depth >= 3 (`cfg.section.field`) so a local named `cfg`
+            # that is a *section* config (FaultConfig, a dict, …) with
+            # depth-1 reads never false-positives; depth-1 reads on the
+            # real Config resolve at import time anyway
+            if parts[0] != "cfg" or len(parts) < 3:
+                continue
+            # validate the LONGEST chain only (an Attribute node's value
+            # chain is itself an Attribute; skip inner nodes)
+            parent = getattr(node, "_keplint_parent_checked", False)
+            if parent:
+                continue
+            cur = schema
+            for i, attr in enumerate(parts[1:], start=1):
+                if attr in cur["fields"]:
+                    sub = cur["fields"][attr]
+                    if sub is None:
+                        break  # reached a leaf; trailing attrs are on
+                        # the leaf value (str/int/...), not config keys
+                    cur = sub
+                elif attr in cur["extras"]:
+                    break  # method / classvar on the section
+                else:
+                    yield ctx.diag(
+                        self, node,
+                        f"config attribute {'.'.join(parts[:i + 1])!r} is "
+                        "not declared in kepler_tpu/config/config.py")
+                    break
+            for sub_node in ast.walk(node):
+                if isinstance(sub_node, ast.Attribute):
+                    sub_node._keplint_parent_checked = True  # type: ignore
+        # part 2: on config.py itself, every leaf must be documented
+        if ctx.rel_path.endswith(_CONFIG_PY):
+            documented = _documented_config_keys(ctx)
+            if documented is not None:
+                for leaf in _schema_leaves(schema):
+                    if leaf not in documented:
+                        yield Diagnostic(
+                            path=ctx.rel_path, line=1, col=1,
+                            rule_id=self.id, severity=self.severity,
+                            message=(
+                                f"config leaf {leaf!r} has no DESCRIPTIONS "
+                                f"entry in {_GEN_CONFIG_DOCS} — document "
+                                "the knob"))
+
+
+# ---------------------------------------------------------------------------
+# KTL105 — Prometheus metric naming
+# ---------------------------------------------------------------------------
+
+_METRIC_CTORS = {
+    "CounterMetricFamily", "GaugeMetricFamily", "HistogramMetricFamily",
+    "SummaryMetricFamily", "InfoMetricFamily", "UntypedMetricFamily",
+    "Counter", "Gauge", "Histogram", "Summary", "Info", "Enum",
+}
+_METRIC_NAME = re.compile(r"^kepler_[a-z][a-z0-9_]*$")
+# approved final name tokens: units first, then semantic/count forms
+_UNIT_TOKENS = frozenset({
+    "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
+    "celsius", "info", "healthy",
+})
+_COUNT_TOKENS = frozenset({"nodes", "workloads"})
+# reference-parity names grandfathered in (match the upstream exporter)
+_EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
+
+
+def _metric_name_literal(arg: ast.expr) -> tuple[str | None, str | None]:
+    """(full_constant_name, trailing_literal) for the first ctor arg.
+
+    f-strings return (None, trailing-literal-if-any): the charset of the
+    dynamic part can't be checked, but the unit suffix usually can.
+    """
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        if not arg.value.startswith("kepler_"):
+            return None, None  # another namespace: out of scope
+        return arg.value, arg.value
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        first = arg.values[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("kepler_")):
+            return None, None
+        last = arg.values[-1]
+        if isinstance(last, ast.Constant) and isinstance(last.value, str):
+            return None, last.value
+        return None, ""  # dynamic tail: unverifiable
+    return None, None
+
+
+@register
+class MetricNameRule(Rule):
+    id = "KTL105"
+    name = "metric-name"
+    summary = ("metric names match `kepler_[a-z0-9_]+` and end with a "
+               "unit suffix; counters end `_total`")
+    rationale = (
+        "Dashboards and recording rules key on metric names; drift "
+        "(`kepler_fleet_reports` vs `..._total`) silently splits series "
+        "across versions. prometheus_client appends `_total` to counter "
+        "samples regardless of the declared family name, so a counter "
+        "declared without it exposes a name that exists nowhere in the "
+        "source — grep-proofing requires declaring the exposed name.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            ctor = _terminal(_qualname(node.func))
+            if ctor not in _METRIC_CTORS:
+                continue
+            full, tail = _metric_name_literal(node.args[0])
+            if full is None and tail is None:
+                continue  # not a kepler metric literal
+            shown = full if full is not None else f"…{tail}"
+            if full is not None:
+                if full in _EXACT_ALLOW:
+                    continue
+                if not _METRIC_NAME.match(full):
+                    yield ctx.diag(
+                        self, node,
+                        f"metric name {full!r} must match "
+                        "kepler_[a-z][a-z0-9_]*")
+                    continue
+            is_counter = ctor.startswith("Counter")
+            if is_counter:
+                if tail is not None and not tail.endswith("_total"):
+                    yield ctx.diag(
+                        self, node,
+                        f"counter {shown!r} must be declared with the "
+                        "exposed `_total` suffix")
+                continue
+            if tail is None or not tail:
+                continue  # dynamic tail: cannot verify the suffix
+            token = tail.rsplit("_", 1)[-1]
+            if token not in _UNIT_TOKENS and token not in _COUNT_TOKENS:
+                yield ctx.diag(
+                    self, node,
+                    f"metric {shown!r} lacks a recognized unit suffix "
+                    f"(one of {', '.join(sorted(_UNIT_TOKENS))} or a "
+                    "count noun); name the unit or extend the rule's "
+                    "token set deliberately")
+
+
+# ---------------------------------------------------------------------------
+# KTL106 — no blocking I/O in the refresh hot loop
+# ---------------------------------------------------------------------------
+
+_BLOCKING_ROOTS = {"subprocess", "socket", "urllib", "requests", "http"}
+_BLOCKING_CALLS = {"time.sleep"}
+_BLOCKING_BARE = {"open", "input", "print"}
+
+
+@register
+class HotLoopBlockingRule(Rule):
+    id = "KTL106"
+    name = "hot-loop-blocking"
+    summary = ("no sleep / blocking I/O inside functions marked "
+               "`# keplint: hot-loop`")
+    rationale = (
+        "The monitor's refresh loop runs under the snapshot lock on the "
+        "interval cadence; one stray sleep or network call inside it "
+        "stalls every scrape and window listener and eventually trips "
+        "the watchdog. Functions on the refresh path carry `# keplint: "
+        "hot-loop`; the check is lexical (direct calls only) — seams "
+        "like the meter keep their own contracts.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        imports = _imports_for(ctx)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if ctx.marker_on(node, "hot-loop") is None:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                canon = _call_canonical(call, imports) or ""
+                root = canon.split(".")[0]
+                terminal = _terminal(canon)
+                if (canon in _BLOCKING_CALLS
+                        or terminal == "sleep"
+                        or root in _BLOCKING_ROOTS
+                        or canon in _BLOCKING_BARE):
+                    yield ctx.diag(
+                        self, call,
+                        f"blocking call {canon}() inside hot-loop "
+                        f"function {node.name}(); the refresh path must "
+                        "not sleep or do I/O beyond the meter seam")
+
+
+# ---------------------------------------------------------------------------
+# KTL107 — jitted / Pallas code is side-effect-free
+# ---------------------------------------------------------------------------
+
+_IMPURE_ROOTS = {"random", "time", "datetime"}
+_IMPURE_BARE = {"print", "open", "input"}
+
+
+def _jitted_functions(tree: ast.Module,
+                      imports: _Imports) -> list[ast.FunctionDef]:
+    """Functions decorated with jax.jit (directly or via
+    functools.partial) plus kernels passed to pallas_call."""
+    out: list[ast.FunctionDef] = []
+    kernel_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            canon = _call_canonical(node, imports) or ""
+            if _terminal(canon) == "pallas_call" and node.args:
+                name = _qualname(node.args[0])
+                if name and "." not in name:
+                    kernel_names.add(name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name in kernel_names:
+            out.append(node)
+            continue
+        for deco in node.decorator_list:
+            target = deco.func if isinstance(deco, ast.Call) else deco
+            canon = imports.canonical(_qualname(target)) or ""
+            if canon in ("jax.jit", "jit") or canon.endswith(".jit"):
+                out.append(node)
+                break
+            if (isinstance(deco, ast.Call)
+                    and _terminal(canon) == "partial" and deco.args):
+                inner = imports.canonical(_qualname(deco.args[0])) or ""
+                if inner in ("jax.jit", "jit") or inner.endswith(".jit"):
+                    out.append(node)
+                    break
+    return out
+
+
+@register
+class JitPureRule(Rule):
+    id = "KTL107"
+    name = "jit-pure"
+    summary = ("no Python side effects (print, wall clock, host RNG, "
+               "global state) inside jitted/Pallas functions")
+    rationale = (
+        "`jax.jit` traces Python once per shape; side effects run at "
+        "trace time only (or not at all after a cache hit), so a print, "
+        "`time.time()`, `np.random`, or global mutation inside a kernel "
+        "is either dead code or a silent nondeterminism bug. Kernels in "
+        "kepler_tpu/ops/ must stay pure functions of their arrays with "
+        "static shapes.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        imports = _imports_for(ctx)
+        for fn in _jitted_functions(ctx.tree, imports):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    yield ctx.diag(
+                        self, node,
+                        f"{type(node).__name__.lower()} statement inside "
+                        f"jitted function {fn.name}(); jitted code must "
+                        "not mutate enclosing scopes")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = _call_canonical(node, imports) or ""
+                root = canon.split(".")[0]
+                impure = (
+                    canon in _IMPURE_BARE
+                    or root in _IMPURE_ROOTS
+                    or canon.startswith("numpy.random")
+                )
+                if impure:
+                    yield ctx.diag(
+                        self, node,
+                        f"impure call {canon}() inside jitted function "
+                        f"{fn.name}(); kernels must be side-effect-free "
+                        "(use jax.random / jax.debug.print if needed)")
+
+
+# ---------------------------------------------------------------------------
+# KTL108 — lock-guarded attributes
+# ---------------------------------------------------------------------------
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    out: set[str] = set()
+    for item in node.items:
+        qual = _qualname(item.context_expr)
+        if qual and qual.startswith("self."):
+            out.add(qual[len("self."):])
+    return out
+
+
+@register
+class LockGuardedRule(Rule):
+    id = "KTL108"
+    name = "lock-guarded"
+    summary = ("attributes annotated `# keplint: guarded-by=<lock>` are "
+               "only written under `with self.<lock>`")
+    rationale = (
+        "The monitor/aggregator publish data to scrape threads through "
+        "attributes whose write side is documented as lock-guarded "
+        "(reads are lock-free reference swaps). The contract is machine-"
+        "readable: annotate the attribute in __init__ with `# keplint: "
+        "guarded-by=_lock`; functions that may only be called with the "
+        "lock held carry `# keplint: requires-lock=_lock`, and every "
+        "call to them must itself hold the lock (a small lexical effect "
+        "system).")
+
+    _EXEMPT_METHODS = frozenset({"__init__", "init"})
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        for cls in ast.walk(ctx.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(ctx, cls)
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterator[Diagnostic]:
+        guarded: dict[str, str] = {}
+        requires: dict[str, str] = {}
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        for fn in methods:
+            lock = ctx.marker_on(fn, "requires-lock")
+            if lock:
+                requires[fn.name] = lock
+            if fn.name not in self._EXEMPT_METHODS:
+                continue
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                for kind, arg in ctx.directives.get(stmt.lineno, []):
+                    if kind != "guarded-by" or not arg:
+                        continue
+                    targets = (stmt.targets
+                               if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            guarded[t.attr] = arg
+        if not guarded and not requires:
+            return
+        for fn in methods:
+            if fn.name in self._EXEMPT_METHODS:
+                continue
+            held: set[str] = set()
+            if fn.name in requires:
+                held = {requires[fn.name]}
+            yield from self._walk(ctx, fn, list(fn.body), held,
+                                  guarded, requires)
+
+    def _walk(self, ctx: FileContext, fn: ast.AST, body: list,
+              held: set[str], guarded: dict[str, str],
+              requires: dict[str, str]) -> Iterator[Diagnostic]:
+        for node in body:
+            extra: set[str] = set()
+            if isinstance(node, ast.With):
+                extra = _with_locks(node)
+            yield from self._check_stmt(ctx, fn, node, held | extra,
+                                        guarded, requires)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a closure runs later, possibly without the lock held
+                yield from self._walk(ctx, fn, node.body, set(),
+                                      guarded, requires)
+                continue
+            for child_body in self._child_bodies(node):
+                yield from self._walk(ctx, fn, child_body, held | extra,
+                                      guarded, requires)
+
+    @staticmethod
+    def _child_bodies(node: ast.AST) -> list[list]:
+        out = []
+        for attr in ("body", "orelse", "finalbody"):
+            val = getattr(node, attr, None)
+            if val:
+                out.append(val)
+        for handler in getattr(node, "handlers", []) or []:
+            out.append(handler.body)
+        return out
+
+    def _check_stmt(self, ctx: FileContext, fn: ast.AST, node: ast.AST,
+                    held: set[str], guarded: dict[str, str],
+                    requires: dict[str, str]) -> Iterator[Diagnostic]:
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for target in targets:
+            inner = target
+            while isinstance(inner, ast.Subscript):
+                inner = inner.value
+            if (isinstance(inner, ast.Attribute)
+                    and isinstance(inner.value, ast.Name)
+                    and inner.value.id == "self"
+                    and inner.attr in guarded
+                    and guarded[inner.attr] not in held):
+                yield ctx.diag(
+                    self, node,
+                    f"write to self.{inner.attr} (guarded by "
+                    f"self.{guarded[inner.attr]}) outside `with "
+                    f"self.{guarded[inner.attr]}` in "
+                    f"{getattr(fn, 'name', '?')}()")
+        # calls into requires-lock functions need the lock too; examine
+        # only the expressions attached to THIS statement (nested
+        # statements are visited by _walk, so they are never double-
+        # counted)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, ast.expr):
+                continue
+            for expr in ast.walk(child):
+                if not isinstance(expr, ast.Call):
+                    continue
+                qual = _qualname(expr.func) or ""
+                if not qual.startswith("self."):
+                    continue
+                callee = qual[len("self."):]
+                if "." in callee or callee not in requires:
+                    continue
+                if requires[callee] not in held:
+                    yield ctx.diag(
+                        self, expr,
+                        f"call to self.{callee}() requires holding "
+                        f"self.{requires[callee]} (marked requires-lock)"
+                        " — wrap the call in `with self."
+                        f"{requires[callee]}:`")

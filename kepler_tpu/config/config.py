@@ -17,7 +17,7 @@ import dataclasses
 import io
 import os
 from dataclasses import dataclass, field
-from typing import Any, IO, Mapping, Sequence
+from typing import Any, Callable, IO, Mapping, Sequence
 
 import yaml
 
@@ -214,7 +214,7 @@ class FaultConfig:
 
     enabled: bool = False
     seed: int = 0
-    specs: list = field(default_factory=list)
+    specs: list[Mapping[str, Any]] = field(default_factory=list)
 
 
 @dataclass
@@ -505,8 +505,6 @@ def default_config() -> Config:
 # Flag registration + precedence (reference config.go:285-395)
 # ---------------------------------------------------------------------------
 
-_FLAG_SENTINEL = object()
-
 
 def register_flags(parser: argparse.ArgumentParser) -> None:
     """Register CLI flags. Defaults are sentinels so we can tell 'explicitly
@@ -566,7 +564,8 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
 
 def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     """Overlay explicitly-passed flags onto cfg (highest precedence)."""
-    def set_if(attr_path: tuple[str, str], value: Any, transform=None) -> None:
+    def set_if(attr_path: tuple[str, str], value: Any,
+               transform: Callable[[Any], Any] | None = None) -> None:
         if value is None:
             return
         section, attr = attr_path

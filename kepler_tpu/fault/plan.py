@@ -25,13 +25,15 @@ fails at startup instead of silently injecting nothing.
 
 from __future__ import annotations
 
+# keplint: monotonic-only — fault windows (start/duration) use elapsed time
+
 import contextlib
 import logging
 import random
 import threading
 import time
 from dataclasses import dataclass
-from typing import Iterable, Mapping
+from typing import Any, Callable, Iterable, Iterator, Mapping
 
 log = logging.getLogger("kepler.fault")
 
@@ -73,7 +75,7 @@ class FaultSpec:
         # type-check before range-check: a YAML typo like `arg: fast` must
         # be a startup ValueError, never a TypeError escaping validation or
         # a crash inside an injection point at fire time
-        def _num(name, value, allow_none=False):
+        def _num(name: str, value: Any, allow_none: bool = False) -> None:
             if value is None and allow_none:
                 return
             if isinstance(value, bool) or not isinstance(
@@ -118,7 +120,7 @@ class FaultPlan:
     """
 
     def __init__(self, specs: Iterable[FaultSpec] = (), seed: int = 0,
-                 clock=time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self._lock = threading.Lock()
         self._rng = random.Random(seed)
         self._clock = clock
@@ -183,12 +185,12 @@ class FaultPlan:
                         "fires": self.fires.get(s, 0)} for s in sites}
 
     @classmethod
-    def from_config(cls, cfg) -> "FaultPlan":
+    def from_config(cls, cfg: Any) -> "FaultPlan":
         """Build from a ``FaultConfig`` (config.py): ``specs`` is a list of
         mappings with a required ``site`` key plus any FaultSpec field.
         Unknown sites/keys fail loudly — a typo'd chaos plan must not
         silently inject nothing."""
-        specs = []
+        specs: list[FaultSpec] = []
         for i, raw in enumerate(cfg.specs):
             if not isinstance(raw, Mapping):
                 raise ValueError(f"fault.specs[{i}] must be a mapping")
@@ -240,7 +242,7 @@ def fire(site: str) -> FaultSpec | None:
     return plan.fire(site)
 
 
-def install_from_config(cfg) -> FaultPlan | None:
+def install_from_config(cfg: Any) -> FaultPlan | None:
     """Arm the config's chaos plan (``FaultConfig``) at startup; no-op
     when disabled. Shared by both binaries (cmd/main, cmd/aggregator)."""
     if not cfg.enabled:
@@ -253,7 +255,7 @@ def install_from_config(cfg) -> FaultPlan | None:
 
 
 @contextlib.contextmanager
-def installed(plan: FaultPlan):
+def installed(plan: FaultPlan) -> Iterator[FaultPlan]:
     """Test helper: arm ``plan`` for the duration of a with-block, always
     disarming on exit (a failed assert must not leak faults into the next
     test)."""

@@ -19,6 +19,9 @@ the whole path: connect refusal, slow sends, body corruption, clock skew.
 
 from __future__ import annotations
 
+# keplint: monotonic-only — backoff/breaker/rate-limit math must survive
+# NTP steps; wall time only via the injected clock seam (sent_at).
+
 import base64
 import collections
 import http.client

@@ -205,7 +205,7 @@ class Aggregator:
         self._degraded_name_cap = 128
 
         self._lock = threading.Lock()
-        self._reports: dict[str, _Stored] = {}
+        self._reports: dict[str, _Stored] = {}  # keplint: guarded-by=_lock
         # per-node run nonces superseded by restarts: a network-delayed
         # straggler from ANY previous agent run must not be re-classified
         # as yet another restart (that would overwrite the fresher run's
@@ -215,7 +215,7 @@ class Aggregator:
         self._superseded_runs: dict[str, list[str]] = {}
         self._superseded_cap = 16
         self._results_lock = threading.Lock()
-        self._results: FleetResults | None = None
+        self._results: FleetResults | None = None  # keplint: guarded-by=_results_lock
         self._last_window_at: float | None = None
         self._stats = {"reports_total": 0, "rejected_total": 0,
                        "quarantined_total": 0, "malformed_total": 0,
@@ -857,19 +857,19 @@ class Aggregator:
         legs.add_metric(["scatter"], stats["last_scatter_ms"])
         yield legs
         total = CounterMetricFamily(
-            "kepler_fleet_attributions", "Completed fleet attributions")
+            "kepler_fleet_attributions_total", "Completed fleet attributions")
         total.add_metric([], stats["attributions_total"])
         yield total
         reports = CounterMetricFamily(
-            "kepler_fleet_reports", "Node reports received")
+            "kepler_fleet_reports_total", "Node reports received")
         reports.add_metric([], stats["reports_total"])
         yield reports
         rejected = CounterMetricFamily(
-            "kepler_fleet_reports_rejected", "Malformed reports rejected")
+            "kepler_fleet_reports_rejected_total", "Malformed reports rejected")
         rejected.add_metric([], stats["rejected_total"])
         yield rejected
         quarantined = CounterMetricFamily(
-            "kepler_fleet_reports_quarantined",
+            "kepler_fleet_reports_quarantined_total",
             "Reports quarantined before ingest, by reason",
             labels=["reason"])
         quarantined.add_metric(["malformed"], stats["malformed_total"])
@@ -885,7 +885,7 @@ class Aggregator:
             "Per-node power attributed by the fleet aggregator",
             labels=["node_name", "zone", "mode"])
         node_joules = CounterMetricFamily(
-            "kepler_fleet_node_cpu_joules",
+            "kepler_fleet_node_cpu_joules_total",
             "Per-node cumulative energy seen by the fleet aggregator",
             labels=["node_name", "zone", "mode"])
         if results is not None:

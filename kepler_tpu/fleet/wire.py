@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import struct
+from typing import Any
 
 import numpy as np
 
@@ -47,7 +48,7 @@ def encode_report(report: NodeReport, zone_names: list[str],
     if report.workload_kinds is not None:
         arrays.append(("workload_kinds", np.ascontiguousarray(
             report.workload_kinds, np.int8)))
-    header = {
+    header: dict[str, Any] = {
         "v": 1,
         "seq": seq,
         # per-agent-run nonce: lets the aggregator tell a restarted agent
@@ -100,7 +101,7 @@ def peek_node_name(data: bytes) -> str | None:
         return None
 
 
-def decode_report(data: bytes) -> tuple[NodeReport, dict]:
+def decode_report(data: bytes) -> tuple[NodeReport, dict[str, Any]]:
     """Parse a report payload → (NodeReport, header). Raises WireError on
     any malformed/oversized input."""
     if len(data) < len(MAGIC) + _HEADER_LEN.size:

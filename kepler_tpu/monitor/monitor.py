@@ -108,7 +108,7 @@ class PowerMonitor:
 
         self._zones: list[EnergyZone] = []
         self._zone_names: tuple[str, ...] = ()
-        self._prev_counters: list[int | None] = []
+        self._prev_counters: list[int | None] = []  # keplint: guarded-by=_snapshot_lock
         self._batch_plan = _UNSET  # lazily-resolved native zone-read plan
         self._last_read_ts: float | None = None
 
@@ -128,7 +128,7 @@ class PowerMonitor:
         # compile inside its refresh
         self._warmed_buckets: set[int] = set()
         self._window_listeners: list[Callable[[WindowSample], None]] = []
-        self._snapshot: Snapshot | None = None
+        self._snapshot: Snapshot | None = None  # keplint: guarded-by=_snapshot_lock
         self._snapshot_lock = threading.Lock()  # singleflight for refresh
         self._exported = False
         self._data_event = threading.Event()  # reference dataCh signal
@@ -278,6 +278,8 @@ class PowerMonitor:
         with self._snapshot_lock:
             self._refresh_locked()
 
+    # keplint: hot-loop
+    # keplint: requires-lock=_snapshot_lock
     def _refresh_locked(self) -> None:
         start = _time.perf_counter()
         now = self._clock()
@@ -425,6 +427,7 @@ class PowerMonitor:
         self._batch_plan = plan
         return plan
 
+    # keplint: hot-loop
     def _read_zone_energies(self) -> list[int | None]:
         """Current raw counter per zone (None = failed read this tick)."""
         out: list[int | None] = []
@@ -447,6 +450,8 @@ class PowerMonitor:
                 out.append(None)
         return out
 
+    # keplint: hot-loop
+    # keplint: requires-lock=_snapshot_lock
     def _read_zone_deltas(self) -> tuple[np.ndarray, np.ndarray]:
         z = len(self._zones)
         deltas = np.zeros(z, np.float64)
@@ -571,6 +576,7 @@ class PowerMonitor:
         self._meta_rows_cache[kind] = (gen, running, rows)
         return rows
 
+    # keplint: hot-loop
     def _accumulate_workloads(self, batch: FeatureBatch, result, w: int
                               ) -> dict[str, WorkloadTable]:
         energy_delta_wz = np.asarray(result.workloads.energy_uj,
@@ -660,6 +666,7 @@ class PowerMonitor:
             )
         return views
 
+    # keplint: hot-loop
     def _handle_terminated(self, tables: dict[str, WorkloadTable]) -> None:
         """Clear-after-export then absorb this window's terminated workloads
         (reference refreshSnapshot: exported flag gates clearing)."""

@@ -17,6 +17,8 @@ variant (docs/developer/resilience.md).
 
 from __future__ import annotations
 
+# keplint: monotonic-only — stall ages must survive NTP clock steps
+
 import logging
 import time as _time
 from typing import Callable

@@ -18,6 +18,8 @@ serves:
 
 from __future__ import annotations
 
+# keplint: monotonic-only — profile/trace deadlines use elapsed time
+
 import collections
 import io
 import sys

@@ -20,6 +20,8 @@ context cancellation.
 
 from __future__ import annotations
 
+# keplint: monotonic-only — restart backoff schedules must survive NTP steps
+
 import logging
 import random
 import signal

@@ -1,0 +1,56 @@
+"""Lint-docs generator tests: docs/developer/static-analysis.md can
+never silently drift from the keplint rule registry (same stance as the
+metric/config docs)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_lint_docs", os.path.join(REPO, "hack", "gen_lint_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestGenLintDocs:
+    def test_doc_is_fresh(self):
+        gen = load_generator()
+        with open(gen.OUT_PATH, encoding="utf-8") as f:
+            current = f.read()
+        assert current == gen.render(), (
+            "docs/developer/static-analysis.md is stale; "
+            "run: python hack/gen_lint_docs.py")
+
+    def test_every_registered_rule_is_documented(self):
+        """The doc's catalog rows come from the live registry — every
+        rule id must appear; a rule the doc doesn't know is impossible
+        by construction, so pin the inverse: render covers REGISTRY."""
+        from kepler_tpu.analysis import all_rules
+
+        gen = load_generator()
+        text = gen.render()
+        for rule in all_rules():
+            assert f"`{rule.id}`" in text
+            assert f"{rule.id} — {rule.name}" in text
+
+    def test_undocumented_rule_fails_render(self):
+        """render() raises when a rule lacks summary/rationale — this
+        pins the tooth so a refactor can't remove it."""
+        from kepler_tpu.analysis import REGISTRY
+
+        gen = load_generator()
+        rule = next(iter(REGISTRY.values()))
+        saved = rule.rationale
+        type(rule).rationale = ""
+        try:
+            gen.render()
+        except SystemExit as err:
+            assert "missing summary/rationale" in str(err)
+        else:
+            raise AssertionError("missing rationale did not fail render")
+        finally:
+            type(rule).rationale = saved
