@@ -25,6 +25,13 @@ test:
 test-verbose:
 	$(PYTHON) -m pytest tests/ -v
 
+.PHONY: chaos
+chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines
+	$(PYTHON) -m pytest tests/ -q -m chaos
+
+.PHONY: verify
+verify: lint chaos ## the lint surface plus the chaos subset — the PR gate's sibling path
+
 .PHONY: bench
 bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
 	$(PYTHON) bench.py

@@ -60,6 +60,16 @@ DESCRIPTIONS = {
                            "longer than this is flagged stalled and the "
                            "snapshot marked stale on `/healthz` "
                            "(`0` = auto, 3 × `monitor.interval`).",
+    "monitor.state_path": "Counter-state file (atomic-rename JSON): the "
+                          "last raw RAPL/TPU readings survive a restart "
+                          "so the first window attributes the energy "
+                          "consumed across it instead of reseeding "
+                          "(empty disables).",
+    "monitor.state_max_age": "Freshness bound on the restored counter "
+                             "state: an older state file is ignored with "
+                             "a warning (a stale baseline would "
+                             "misattribute long-dead energy; `0` = no "
+                             "bound).",
     "rapl.zones": "Zone-name filter (e.g. `[package, dram]`); empty "
                   "means every discovered zone.",
     "msr.enabled": "Opt-in MSR fallback: read RAPL counters from "
@@ -162,6 +172,29 @@ DESCRIPTIONS = {
     "aggregator.degraded_ttl": "Aggregator: how long a node stays marked "
                                "degraded on `/healthz` after its last "
                                "quarantined report.",
+    "aggregator.dedup_window": "Aggregator: per-node `(run, seq)` dedup "
+                               "window — redelivered reports (spool "
+                               "replay, retries) are absorbed "
+                               "idempotently; seq jumps beyond it count "
+                               "as `kepler_fleet_windows_lost_total`.",
+    "agent.spool.dir": "Crash-safe report spool directory: windows are "
+                       "appended (CRC-framed) before any send and only "
+                       "acked on 2xx, so crashes/outages replay instead "
+                       "of losing data (empty = in-memory ring only).",
+    "agent.spool.max_bytes": "Spool byte cap; the oldest segment is "
+                             "evicted beyond it and every unacked record "
+                             "lost is counted "
+                             "(`kepler_fleet_spool_evicted_total`).",
+    "agent.spool.max_records": "Spool record cap (same eviction and "
+                               "accounting as the byte cap).",
+    "agent.spool.segment_bytes": "Spool segment rotation size — the "
+                                 "granularity of cap eviction and of "
+                                 "acked-data reclamation.",
+    "agent.spool.fsync": "Spool durability policy: `batch` (default — "
+                         "at most one fsync per `fsyncInterval`, none "
+                         "on the per-send path), `always`, or `none`.",
+    "agent.spool.fsync_interval": "Minimum spacing between batched spool "
+                                  "fsyncs.",
     "service.restart_max": "Supervised restarts per crashing service "
                            "before the group fails (`0` = reference "
                            "semantics: first crash ends the group).",
@@ -191,6 +224,7 @@ FLAG_OF = {
     "host.procfs": "--host.procfs",
     "monitor.interval": "--monitor.interval",
     "monitor.max_terminated": "--monitor.max-terminated",
+    "monitor.state_path": "--monitor.state-path",
     "debug.pprof.enabled": "--debug.pprof / --no-debug.pprof",
     "web.config_file": "--web.config-file",
     "web.listen_addresses": "--web.listen-address (repeatable)",
@@ -213,6 +247,8 @@ FLAG_OF = {
     "aggregator.training_dump_dir": "--aggregator.training-dump-dir",
     "aggregator.training_dump_max_files":
         "--aggregator.training-dump-max-files",
+    "aggregator.dedup_window": "--aggregator.dedup-window",
+    "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
 }
@@ -220,7 +256,8 @@ FLAG_OF = {
 _SNAKE_TO_CAMEL = {v: k for k, v in _CANONICAL_YAML_KEYS.items()}
 
 _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
-                   "monitor.stall_after",
+                   "monitor.stall_after", "monitor.state_max_age",
+                   "agent.spool.fsync_interval",
                    "aggregator.interval", "aggregator.stale_after",
                    "aggregator.backoff_initial", "aggregator.backoff_max",
                    "aggregator.breaker_cooldown", "aggregator.flush_timeout",

@@ -460,7 +460,7 @@ _UNIT_TOKENS = frozenset({
     "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
     "celsius", "info", "healthy",
 })
-_COUNT_TOKENS = frozenset({"nodes", "workloads"})
+_COUNT_TOKENS = frozenset({"nodes", "workloads", "records"})
 # reference-parity names grandfathered in (match the upstream exporter)
 _EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
 

@@ -70,6 +70,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         training_dump_max_files=cfg.aggregator.training_dump_max_files,
         skew_tolerance=cfg.aggregator.skew_tolerance,
         degraded_ttl=cfg.aggregator.degraded_ttl,
+        dedup_window=cfg.aggregator.dedup_window,
     )
     services: list = [server, aggregator]
 

@@ -101,6 +101,8 @@ def create_services(cfg: Config) -> list:
         min_terminated_energy_uj=(
             cfg.monitor.min_terminated_energy_threshold * 1e6),
         workload_bucket=cfg.tpu.workload_bucket,
+        state_path=cfg.monitor.state_path,
+        state_max_age=cfg.monitor.state_max_age,
     )
     server = make_api_server(cfg.web.listen_addresses, cfg.web.config_file)
     services: list = []
@@ -120,28 +122,32 @@ def create_services(cfg: Config) -> list:
     # ready once the first snapshot exists (collector readiness gate)
     server.health.register_readiness(
         "monitor", lambda: {"ok": monitor.data_channel().is_set()})
-    if cfg.exporter.prometheus.enabled:
-        source = {"rapl": "rapl-powercap", "rapl-msr": "rapl-msr",
-                  "fake-cpu-meter": "fake"}.get(meter.name(), meter.name())
-        collectors = create_collectors(
-            monitor,
-            node_name=cfg.kube.node_name,
-            metrics_level=cfg.exporter.prometheus.metrics_level,
-            procfs=cfg.host.procfs,
-            meter_source=source,
-        )
-        from kepler_tpu.exporter.prometheus import HealthCollector
-        collectors.append(HealthCollector(server.health))
-        services.append(PrometheusExporter(
-            server, collectors,
-            debug_collectors=cfg.exporter.prometheus.debug_collectors))
-    if cfg.debug.pprof.enabled:
-        services.append(DebugService(server))
-    if cfg.exporter.stdout.enabled:
-        services.append(StdoutExporter(monitor))
+    agent = None
+    spool_error = ""
     if cfg.aggregator.endpoint:
-        from kepler_tpu.fleet import FleetAgent
+        from kepler_tpu.fleet import FleetAgent, Spool
         from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO
+        spool = None
+        if cfg.agent.spool.dir:
+            # durable delivery: windows survive agent crashes/aggregator
+            # outages on disk and replay with their original identity.
+            # An unopenable spool (read-only disk after a crash, bad
+            # permissions) degrades to the in-memory ring — losing the
+            # durability upgrade must never cost the power metrics too.
+            try:
+                spool = Spool(
+                    cfg.agent.spool.dir,
+                    max_bytes=cfg.agent.spool.max_bytes,
+                    max_records=cfg.agent.spool.max_records,
+                    segment_bytes=cfg.agent.spool.segment_bytes,
+                    fsync=cfg.agent.spool.fsync,
+                    fsync_interval=cfg.agent.spool.fsync_interval,
+                )
+            except OSError as err:
+                spool_error = str(err)
+                log.error("report spool %s unusable (%s); continuing "
+                          "WITHOUT durable delivery (in-memory ring only)",
+                          cfg.agent.spool.dir, err)
         agent = FleetAgent(
             monitor,
             endpoint=cfg.aggregator.endpoint,
@@ -154,9 +160,42 @@ def create_services(cfg: Config) -> list:
             breaker_threshold=cfg.aggregator.breaker_threshold,
             breaker_cooldown=cfg.aggregator.breaker_cooldown,
             flush_timeout_s=cfg.aggregator.flush_timeout,
+            spool=spool,
         )
-        services.append(agent)
         server.health.register_probe("fleet-agent", agent.health)
+        if spool is not None:
+            server.health.register_probe("fleet-spool", agent.spool_health)
+        elif spool_error:
+            # the operator ASKED for durability and is not getting it —
+            # /healthz must say so, not stay silently green
+            server.health.register_probe(
+                "fleet-spool",
+                lambda: {"ok": False, "enabled": False,
+                         "error": f"configured spool unusable: "
+                                  f"{spool_error}"})
+    if cfg.exporter.prometheus.enabled:
+        source = {"rapl": "rapl-powercap", "rapl-msr": "rapl-msr",
+                  "fake-cpu-meter": "fake"}.get(meter.name(), meter.name())
+        collectors = create_collectors(
+            monitor,
+            node_name=cfg.kube.node_name,
+            metrics_level=cfg.exporter.prometheus.metrics_level,
+            procfs=cfg.host.procfs,
+            meter_source=source,
+        )
+        from kepler_tpu.exporter.prometheus import HealthCollector
+        collectors.append(HealthCollector(server.health))
+        if agent is not None and cfg.agent.spool.dir:
+            collectors.append(agent)  # kepler_fleet_spool_* durability plane
+        services.append(PrometheusExporter(
+            server, collectors,
+            debug_collectors=cfg.exporter.prometheus.debug_collectors))
+    if cfg.debug.pprof.enabled:
+        services.append(DebugService(server))
+    if cfg.exporter.stdout.enabled:
+        services.append(StdoutExporter(monitor))
+    if agent is not None:
+        services.append(agent)
     if cfg.aggregator.enabled:
         log.warning("aggregator.enabled is set — the aggregator role runs "
                     "as its own binary: python -m kepler_tpu.cmd.aggregator")

@@ -117,6 +117,13 @@ class MonitorConfig:
     min_terminated_energy_threshold: float = 10.0
     # watchdog: refresh-loop stall threshold; 0 = auto (3 × interval)
     stall_after: float = 0.0
+    # counter-state persistence: with a path, the last raw counter
+    # readings survive a restart so the first window attributes the
+    # energy consumed across it ("" = off); a state file older than
+    # state_max_age is ignored (a stale baseline would misattribute;
+    # 0 = no freshness bound)
+    state_path: str = ""
+    state_max_age: float = 60.0
 
 
 @dataclass
@@ -218,6 +225,32 @@ class FaultConfig:
 
 
 @dataclass
+class SpoolConfig:
+    """Crash-safe report spool (``fleet.spool``): the agent's durable
+    at-least-once delivery queue. Disabled unless ``dir`` is set."""
+
+    dir: str = ""  # spool directory ("" = in-memory ring only)
+    max_bytes: int = 64 << 20  # byte cap; oldest segment evicted beyond
+    max_records: int = 4096  # record cap (counted, never silent)
+    segment_bytes: int = 1 << 20  # rotation size (eviction granularity)
+    # fsync policy: "batch" (default; at most one fsync per
+    # fsync_interval — nothing per-send), "always", "none"
+    fsync: str = "batch"
+    fsync_interval: float = 1.0
+
+
+@dataclass
+class AgentConfig:
+    """Node-agent delivery plane (the sender half of the fleet leg).
+
+    Transport/retry knobs historically live under ``aggregator.*``; the
+    durability plane added by the spool starts the agent's own section.
+    """
+
+    spool: SpoolConfig = field(default_factory=SpoolConfig)
+
+
+@dataclass
 class DevConfig:
     fake_cpu_meter: FakeCpuMeterConfig = field(default_factory=FakeCpuMeterConfig)
 
@@ -277,6 +310,9 @@ class AggregatorConfig:
     # degraded after its last quarantined report
     skew_tolerance: float = 120.0
     degraded_ttl: float = 60.0
+    # aggregator: per-node (run, seq) dedup window — spool replays and
+    # retries are absorbed idempotently instead of double-ingesting
+    dedup_window: int = 1024
 
 
 @dataclass
@@ -292,6 +328,7 @@ class Config:
     kube: KubeConfig = field(default_factory=KubeConfig)
     tpu: TPUConfig = field(default_factory=TPUConfig)
     aggregator: AggregatorConfig = field(default_factory=AggregatorConfig)
+    agent: AgentConfig = field(default_factory=AgentConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
     dev: DevConfig = field(default_factory=DevConfig)
@@ -367,6 +404,21 @@ class Config:
                 errs.append(f"{name} must be >= 0")
         if self.aggregator.breaker_threshold < 1:
             errs.append("aggregator.breakerThreshold must be >= 1")
+        if self.aggregator.dedup_window < 1:
+            errs.append("aggregator.dedupWindow must be >= 1")
+        if self.monitor.state_max_age < 0:
+            errs.append("monitor.stateMaxAge must be >= 0")
+        spool = self.agent.spool
+        if spool.fsync not in ("batch", "always", "none"):
+            errs.append(f"invalid agent.spool.fsync: {spool.fsync!r} "
+                        "(batch | always | none)")
+        if spool.fsync_interval < 0:
+            errs.append("agent.spool.fsyncInterval must be >= 0")
+        for name, val in (("agent.spool.maxBytes", spool.max_bytes),
+                          ("agent.spool.maxRecords", spool.max_records),
+                          ("agent.spool.segmentBytes", spool.segment_bytes)):
+            if val < 1:
+                errs.append(f"{name} must be >= 1")
         if self.service.restart_max < 0:
             errs.append("service.restartMax must be >= 0")
         if self.fault.enabled:
@@ -425,6 +477,13 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "restartMax": "restart_max",
     "restartBackoffInitial": "restart_backoff_initial",
     "restartBackoffMax": "restart_backoff_max",
+    "statePath": "state_path",
+    "stateMaxAge": "state_max_age",
+    "dedupWindow": "dedup_window",
+    "maxBytes": "max_bytes",
+    "maxRecords": "max_records",
+    "segmentBytes": "segment_bytes",
+    "fsyncInterval": "fsync_interval",
 }
 
 
@@ -440,7 +499,8 @@ _YAML_KEYS: dict[str, str] = {
 _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "backoff_initial", "backoff_max", "breaker_cooldown",
                     "flush_timeout", "skew_tolerance", "degraded_ttl",
-                    "restart_backoff_initial", "restart_backoff_max"}
+                    "restart_backoff_initial", "restart_backoff_max",
+                    "state_max_age", "fsync_interval"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -521,6 +581,8 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         help="refresh interval, e.g. 5s")
     add("--monitor.max-terminated", dest="monitor_max_terminated", default=None,
         type=int)
+    add("--monitor.state-path", dest="monitor_state_path", default=None,
+        help="counter-state file for restart-surviving attribution")
     add("--debug.pprof", dest="debug_pprof", default=None,
         action=argparse.BooleanOptionalAction)
     add("--web.config-file", dest="web_config_file", default=None)
@@ -556,6 +618,10 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         default=None)
     add("--aggregator.training-dump-max-files",
         dest="aggregator_dump_max_files", default=None, type=int)
+    add("--aggregator.dedup-window", dest="aggregator_dedup_window",
+        default=None, type=int)
+    add("--agent.spool-dir", dest="agent_spool_dir", default=None,
+        help="crash-safe report spool directory (empty disables)")
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
@@ -578,6 +644,7 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("host", "procfs"), args.host_procfs)
     set_if(("monitor", "interval"), args.monitor_interval, _parse_duration)
     set_if(("monitor", "max_terminated"), args.monitor_max_terminated)
+    set_if(("monitor", "state_path"), args.monitor_state_path)
     if args.debug_pprof is not None:
         cfg.debug.pprof.enabled = args.debug_pprof
     set_if(("web", "config_file"), args.web_config_file)
@@ -604,6 +671,9 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "training_dump_dir"), args.aggregator_dump_dir)
     set_if(("aggregator", "training_dump_max_files"),
            args.aggregator_dump_max_files)
+    set_if(("aggregator", "dedup_window"), args.aggregator_dedup_window)
+    if args.agent_spool_dir is not None:
+        cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     return cfg

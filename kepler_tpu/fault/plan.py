@@ -45,6 +45,9 @@ KNOWN_SITES = (
     "net.slow",             # agent: send stalls for `arg` seconds
     "net.corrupt_body",     # agent: report body corrupted on the wire
     "report.clock_skew",    # agent: report stamped `arg` seconds off
+    "disk.write_error",     # spool: an append fails cleanly (no bytes land)
+    "disk.fsync_error",     # spool: fsync fails (record stays in page cache)
+    "disk.torn_tail",       # spool: partial frame written, append "dies"
 )
 
 

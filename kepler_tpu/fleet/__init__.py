@@ -9,6 +9,7 @@ back per node.
 
 from kepler_tpu.fleet.agent import FleetAgent
 from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.spool import Spool
 from kepler_tpu.fleet.wire import (
     WireError,
     decode_report,
@@ -18,6 +19,7 @@ from kepler_tpu.fleet.wire import (
 __all__ = [
     "Aggregator",
     "FleetAgent",
+    "Spool",
     "WireError",
     "decode_report",
     "encode_report",

@@ -15,6 +15,14 @@ node one failed probe per cooldown instead of a connect timeout per
 window. Breaker state is surfaced through :meth:`health` for the API
 server's ``/healthz``. Fault-injection points (``kepler_tpu.fault``) cover
 the whole path: connect refusal, slow sends, body corruption, clock skew.
+
+Durability (ISSUE 3): with a ``fleet.spool.Spool`` attached, every window
+is appended to the crash-safe on-disk spool before any send attempt and
+acknowledged only on a 2xx (or permanent 4xx), so agent crashes and
+outages longer than the ring replay the backlog instead of losing it —
+replayed records keep their original ``run``+``seq`` identity; only
+``sent_at`` is restamped at transmit time. The breaker/backoff machinery
+stays the sole send gate in both modes.
 """
 
 from __future__ import annotations
@@ -36,7 +44,8 @@ import uuid
 from typing import Callable
 
 from kepler_tpu import fault
-from kepler_tpu.fleet.wire import encode_report
+from kepler_tpu.fleet.spool import Spool
+from kepler_tpu.fleet.wire import WireError, encode_report, restamp_sent_at
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
 from kepler_tpu.service.lifecycle import CancelContext, backoff_with_jitter
@@ -61,6 +70,13 @@ class AggregatorRejectedError(http.client.HTTPException):
         self.status = status
 
 
+class UnsendableRecordError(Exception):
+    """A (spooled) record that cannot even be serialized for transmit
+    (restamp failed: format drift across an upgrade, CRC-missed
+    corruption). Dropped WITHOUT touching the circuit breaker — no
+    network contact happened, so it is evidence of nothing."""
+
+
 class FleetAgent:
     def __init__(
         self,
@@ -79,15 +95,25 @@ class FleetAgent:
         clock: Callable[[], float] | None = None,
         monotonic: Callable[[], float] | None = None,
         jitter_seed: int | None = None,
+        spool: Spool | None = None,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
         self._node_name = node_name or socket.gethostname()
         self._mode = mode
         self._timeout = timeout_s
-        self._queue: collections.deque[WindowSample] = collections.deque(
-            maxlen=queue_max)
+        # in-memory ring of (seq, sample): the delivery queue without a
+        # spool, the degraded fallback with one (disk write failures)
+        self._queue: collections.deque[tuple[int, WindowSample]] = \
+            collections.deque(maxlen=queue_max)
+        # durable delivery: when set, every window is appended to the
+        # crash-safe spool before any send attempt and only acked on 2xx
+        self._spool = spool
         self._wake = threading.Event()
+        # seq is assigned at WINDOW time (enqueue), not send time, so a
+        # dropped/evicted window leaves a visible seq gap the aggregator
+        # counts as kepler_fleet_windows_lost_total — loss accounting
+        # depends on dropped windows consuming sequence numbers
         self._seq = 0
         self._run_nonce = uuid.uuid4().hex[:16]  # identifies this agent run
         self._clock = clock or _time.time
@@ -105,7 +131,8 @@ class FleetAgent:
         self._breaker_open_until = 0.0
         self._breaker_backoff = self._breaker_cooldown  # escalates per reopen
         self._consecutive_failures = 0
-        self._inflight: WindowSample | None = None
+        # ("spool", SpoolRecord) | ("mem", seq, sample) | None
+        self._inflight: tuple | None = None
         self._conn: http.client.HTTPConnection | None = None
         self._stats = {"sent_total": 0, "send_failures": 0,
                        "dropped_total": 0, "server_rejections": 0,
@@ -146,18 +173,36 @@ class FleetAgent:
 
     def init(self) -> None:
         self._monitor.add_window_listener(self._on_window)
-        log.info("fleet agent: node=%s → %s://%s:%d%s%s",
+        if self._spool is not None and self._spool.pending_records():
+            self._wake.set()  # replay the crash backlog without waiting
+        log.info("fleet agent: node=%s → %s://%s:%d%s%s%s",
                  self._node_name, "https" if self._tls else "http",
                  self._host, self._port, self._path,
-                 " (basic auth)" if self._auth_header else "")
+                 " (basic auth)" if self._auth_header else "",
+                 " (durable spool)" if self._spool is not None else "")
 
     def _on_window(self, sample: WindowSample) -> None:
-        # runs inside the monitor's refresh lock: enqueue only. A full
-        # ring drops its oldest sample (newest wins) — account for it so
-        # prolonged outages are visible in health()/metrics.
+        # runs inside the monitor's refresh lock: must stay cheap. The
+        # window takes its seq HERE so a window lost anywhere downstream
+        # (ring overflow, spool eviction, disk failure) leaves a seq gap
+        # the aggregator counts as loss. With a spool, the window is made
+        # durable before any send attempt (one buffered write; fsync is
+        # batched, never per-window by default); a disk failure degrades
+        # to the in-memory ring instead of blocking the monitor.
+        self._seq += 1
+        seq = self._seq
+        if self._spool is not None:
+            try:
+                body = self._encode(sample, seq)
+                if self._spool.append(body):
+                    self._wake.set()
+                    return
+            except Exception:
+                log.exception("spool append failed; falling back to the "
+                              "in-memory ring for this window")
         if len(self._queue) == self._queue.maxlen:
             self._stats["dropped_total"] += 1
-        self._queue.append(sample)
+        self._queue.append((seq, sample))
         self._wake.set()
 
     def run(self, ctx: CancelContext) -> None:
@@ -165,6 +210,11 @@ class FleetAgent:
             self._wake.wait(timeout=0.5)
             self._wake.clear()
             self._drain(ctx)
+            if self._spool is not None:
+                # batched-durability tick on THIS thread — kept off the
+                # append path (monitor refresh lock) and independent of
+                # breaker state, so an outage backlog still hits disk
+                self._spool.sync()
             if ctx.wait(0.0):
                 return
 
@@ -173,41 +223,107 @@ class FleetAgent:
         # best-effort final flush: a clean node drain delivers its queued
         # window(s) instead of abandoning them. Bounded by flush_timeout_s
         # and skipped while the breaker is open (aggregator presumed down).
+        # With a spool, anything not flushed stays durable and replays on
+        # the next run — the flush is a latency nicety, not the safety net.
         if self._breaker_state != BREAKER_OPEN:
             deadline = self._monotonic() + self._flush_timeout
-            while ((self._inflight is not None or self._queue)
-                   and self._monotonic() < deadline):
-                sample = self._inflight
-                if sample is None:
-                    sample = self._queue.popleft()
-                self._inflight = sample
+            while self._monotonic() < deadline:
+                item = self._inflight or self._next_item()
+                if item is None:
+                    break
+                self._inflight = item
                 try:
-                    self._send(sample)
+                    self._send_item(item)
+                except UnsendableRecordError as err:
+                    self._finish_item(item)
+                    self._stats["dropped_total"] += 1
+                    log.info("shutdown flush: unsendable record (%s)", err)
+                    continue
                 except AggregatorRejectedError as err:
                     # this one sample is unacceptable; the rest may flush
-                    self._inflight = None
+                    self._finish_item(item)
                     self._stats["dropped_total"] += 1
                     self._stats["server_rejections"] += 1
                     log.info("shutdown flush: report rejected (%s)", err)
                     continue
                 except (OSError, http.client.HTTPException) as err:
                     log.info("shutdown flush stopped (%d left): %s",
-                             len(self._queue) + 1, err)
+                             self.backlog(), err)
                     break
-                self._inflight = None
+                self._finish_item(item)
                 self._stats["sent_total"] += 1
                 self._stats["flushed_on_shutdown"] += 1
         self._close_conn()
+        if self._spool is not None:
+            self._spool.close()
 
     def health(self) -> dict:
         """Probe for the API server's /healthz (server.health registry)."""
-        return {
+        out = {
             "ok": self._breaker_state != BREAKER_OPEN,
             "breaker": self._breaker_state,
             "consecutive_failures": self._consecutive_failures,
-            "queued": len(self._queue),
+            "queued": self.backlog(),
             **self._stats,
         }
+        if self._spool is not None:
+            out["spool_pending"] = self._spool.pending_records()
+        return out
+
+    def spool_health(self) -> dict:
+        """Spool probe for the HealthRegistry (utilization, oldest-record
+        age, eviction counters). Reports ok with no spool configured."""
+        if self._spool is None:
+            return {"ok": True, "enabled": False}
+        return {"enabled": True, **self._spool.health()}
+
+    def backlog(self) -> int:
+        """Windows awaiting delivery (spool backlog + in-memory ring).
+        An in-flight SPOOL record is still unacked and therefore already
+        inside pending_records() — only a mem item (popped off the ring)
+        needs counting separately."""
+        inflight = self._inflight
+        pending = len(self._queue) + (
+            1 if inflight is not None and inflight[0] == "mem" else 0)
+        if self._spool is not None:
+            pending += self._spool.pending_records()
+        return pending
+
+    def collect(self):
+        """prometheus_client custom-collector hook: spool durability
+        metrics (registered only when a spool is configured)."""
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+        )
+        if self._spool is None:
+            return
+        stats = self._spool.stats()
+        evicted = CounterMetricFamily(
+            "kepler_fleet_spool_evicted_total",
+            "Unacked windows discarded by spool cap eviction")
+        evicted.add_metric([], stats["evicted_total"])
+        yield evicted
+        pending = GaugeMetricFamily(
+            "kepler_fleet_spool_pending_records",
+            "Windows appended to the spool and not yet acknowledged")
+        pending.add_metric([], self._spool.pending_records())
+        yield pending
+        util = GaugeMetricFamily(
+            "kepler_fleet_spool_utilization_ratio",
+            "Spool bytes in use as a fraction of the configured cap")
+        util.add_metric([], self._spool.utilization())
+        yield util
+        age = GaugeMetricFamily(
+            "kepler_fleet_spool_oldest_record_age_seconds",
+            "Age of the oldest unacknowledged spooled window")
+        age.add_metric([], self._spool.oldest_age() or 0.0)
+        yield age
+        errors = CounterMetricFamily(
+            "kepler_fleet_spool_write_errors_total",
+            "Spool appends that failed at the disk layer")
+        errors.add_metric([], stats["write_errors_total"])
+        yield errors
 
     # -- internals ---------------------------------------------------------
 
@@ -224,27 +340,38 @@ class FleetAgent:
             now = self._monotonic()
             if (self._breaker_state == BREAKER_OPEN
                     and now < self._breaker_open_until):
-                return  # shedding: samples stay in the newest-wins ring
-            sample = self._inflight
-            if sample is None:
+                return  # shedding: backlog stays in the spool/ring
+            item = self._inflight
+            if item is None:
                 # an elapsed-cooldown breaker stays OPEN until a sample
                 # exists to probe with: health must not report recovery
                 # that nothing demonstrated
-                if not self._queue:
+                item = self._next_item()
+                if item is None:
                     return
-                sample = self._queue.popleft()
-                self._inflight = sample
+                self._inflight = item
             if self._breaker_state == BREAKER_OPEN:
                 self._breaker_state = BREAKER_HALF_OPEN
                 log.info("circuit breaker half-open: probing aggregator")
             try:
-                self._send(sample)
+                self._send_item(item)
+            except UnsendableRecordError as err:
+                # poisoned record: ack + drop so the backlog moves on,
+                # but leave the breaker exactly as it was — this proves
+                # nothing about the aggregator (a half-open probe simply
+                # passes to the next record)
+                self._finish_item(item)
+                self._stats["dropped_total"] += 1
+                log.warning("dropping unsendable spooled record: %s", err)
+                continue
             except AggregatorRejectedError as err:
                 # the aggregator ANSWERED: delivery is healthy, this
                 # payload will never be accepted — drop it and count the
                 # response as breaker-closing evidence (retrying a 4xx
-                # forever would shed good reports from a live aggregator)
-                self._inflight = None
+                # forever would shed good reports from a live aggregator).
+                # A spooled record is acked too: replaying a permanent
+                # reject forever would wedge the whole backlog behind it.
+                self._finish_item(item)
                 self._stats["dropped_total"] += 1
                 self._stats["server_rejections"] += 1
                 self._log_drop(err)
@@ -259,9 +386,31 @@ class FleetAgent:
                 if ctx is None or ctx.wait(delay):
                     return
                 continue
-            self._inflight = None
+            self._finish_item(item)
             self._stats["sent_total"] += 1
             self._note_send_success()
+
+    def _next_item(self) -> tuple | None:
+        """Next undelivered window: the durable spool backlog first (it
+        holds the OLDEST windows, including a previous run's replay),
+        then the in-memory ring."""
+        if self._spool is not None:
+            rec = self._spool.peek()
+            if rec is not None:
+                return ("spool", rec)
+        if self._queue:
+            seq, sample = self._queue.popleft()
+            return ("mem", seq, sample)
+        return None
+
+    def _finish_item(self, item: tuple) -> None:
+        """The item's delivery concluded (2xx or permanent 4xx): advance
+        the spool ack cursor so it is never re-sent."""
+        self._inflight = None
+        if item[0] == "spool":
+            assert self._spool is not None
+            self._spool.ack(item[1])  # validated: never acks a record
+            # other than the one whose delivery just concluded
 
     def _note_send_success(self) -> None:
         """The aggregator responded — close the breaker, reset schedules."""
@@ -288,10 +437,14 @@ class FleetAgent:
             self._breaker_open_until = (self._monotonic()
                                         + self._breaker_backoff)
             self._stats["breaker_opens"] += 1
-            # shed the in-flight sample too — by reopen time it is stale
+            # shed the in-flight IN-MEMORY sample — by reopen time it is
+            # stale. A spooled record is NOT shed: it stays durably
+            # unacked and replays after the cooldown (losing it would
+            # defeat the spool's whole reason to exist).
             if self._inflight is not None:
+                if self._inflight[0] == "mem":
+                    self._stats["dropped_total"] += 1
                 self._inflight = None
-                self._stats["dropped_total"] += 1
             log.warning("circuit breaker open for %.1fs after %d "
                         "consecutive send failures: %s",
                         self._breaker_backoff,
@@ -323,14 +476,10 @@ class FleetAgent:
             except OSError:
                 pass
 
-    def _send(self, sample: WindowSample) -> None:
-        spec = fault.fire("net.refuse")
-        if spec is not None:
-            self._close_conn()
-            raise ConnectionRefusedError("fault-injected connect refusal")
-        spec = fault.fire("net.slow")
-        if spec is not None:
-            _time.sleep(min(spec.arg or 0.05, self._timeout))
+    def _encode(self, sample: WindowSample, seq: int) -> bytes:
+        """Wire bytes for one window — WITHOUT ``sent_at``, which is a
+        transmit-time property stamped by :meth:`_post` (a spooled record
+        may be sent long after it was encoded)."""
         batch = sample.batch
         report = NodeReport(
             node_name=self._node_name,
@@ -344,13 +493,44 @@ class FleetAgent:
             mode=self._mode,
             workload_kinds=batch.kinds,
         )
-        self._seq += 1
+        return encode_report(report, list(sample.zone_names), seq=seq,
+                             run=self._run_nonce)
+
+    def _send_item(self, item: tuple) -> None:
+        if item[0] == "spool":
+            self._post(item[1].payload)
+        else:
+            self._post(self._encode(item[2], item[1]))
+
+    def _send(self, sample: WindowSample, seq: int | None = None) -> None:
+        """Encode + POST one sample (direct-send path used by tests and
+        the pre-spool call sites). ``seq=None`` takes the next number."""
+        if seq is None:
+            self._seq += 1
+            seq = self._seq
+        self._post(self._encode(sample, seq))
+
+    def _post(self, body: bytes) -> None:
+        spec = fault.fire("net.refuse")
+        if spec is not None:
+            self._close_conn()
+            raise ConnectionRefusedError("fault-injected connect refusal")
+        spec = fault.fire("net.slow")
+        if spec is not None:
+            _time.sleep(min(spec.arg or 0.05, self._timeout))
         sent_at = self._clock()
         spec = fault.fire("report.clock_skew")
         if spec is not None:
             sent_at += spec.arg if spec.arg is not None else 300.0
-        body = encode_report(report, list(sample.zone_names), seq=self._seq,
-                             run=self._run_nonce, sent_at=sent_at)
+        try:
+            body = restamp_sent_at(body, sent_at)
+        except WireError as err:
+            # a spooled record that no longer parses (disk corruption the
+            # CRC missed, or a format change across restart) can never be
+            # sent — drop it so the backlog doesn't wedge behind it, but
+            # through a path that does NOT masquerade as an aggregator
+            # response (no network contact happened)
+            raise UnsendableRecordError(str(err)) from err
         spec = fault.fire("net.corrupt_body")
         if spec is not None:
             # drop the tail: header (and node name) stay parseable, the

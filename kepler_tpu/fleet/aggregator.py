@@ -20,6 +20,7 @@ the batched analog of the reference's per-zone skip-on-error.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import threading
@@ -74,6 +75,56 @@ class _Stored:
     received: float
     seq: int
     run: str = ""  # agent-run nonce (empty for pre-nonce agents)
+
+
+class _SeqTracker:
+    """Per-(node, run) sequence accounting: a bounded window of recently
+    seen seqs (dedup — spool replays are idempotent) plus gap detection
+    (a seq jump is LOST windows, surfaced as a per-node counter instead
+    of silence). Caller holds the aggregator's store lock."""
+
+    __slots__ = ("run", "max_seen", "seen", "order", "window", "touched")
+
+    def __init__(self, run: str, window: int) -> None:
+        self.run = run
+        self.max_seen = 0
+        self.seen: set[int] = set()
+        self.order: collections.deque[int] = collections.deque()
+        self.window = max(1, window)
+        self.touched = 0.0  # aggregator clock; drives cap eviction
+
+    def observe(self, seq: int) -> tuple[bool, int]:
+        """→ (is_duplicate, windows_lost_by_this_arrival).
+
+        A seq inside the dedup window that was already seen — or one so
+        old it fell out of the window — is a duplicate (at-least-once
+        redelivery): ack-worthy but not ingestable. A seq jumping past
+        ``max_seen + 1`` reports the skipped windows as lost; a late
+        out-of-order FILL of a previously-counted gap is ingested but
+        cannot retroactively decrement the loss counter (counters only
+        go up; ordered spool replay makes real fills rare).
+
+        Accounting is CONSERVATIVE: loss = windows this tracker never
+        saw. A fresh aggregator meeting a mid-run stream (aggregator
+        restart) counts the pre-restart windows as a one-time spike —
+        indistinguishable, from seq alone, from an agent whose first
+        windows died before delivery, and the latter must be counted."""
+        if seq in self.seen:
+            return True, 0
+        if seq <= self.max_seen - self.window:
+            return True, 0  # beyond the window: can't tell — stay idempotent
+        self.seen.add(seq)
+        self.order.append(seq)
+        while len(self.order) > self.window:
+            self.seen.discard(self.order.popleft())
+        lost = 0
+        if seq > self.max_seen + 1:
+            # seq numbers start at 1 within a run: a first-seen seq of N
+            # means windows 1..N-1 died before delivery (ring overflow,
+            # spool eviction, disk failure)
+            lost = seq - self.max_seen - 1
+        self.max_seen = max(self.max_seen, seq)
+        return False, lost
 
 
 class FleetResults:
@@ -158,6 +209,7 @@ class Aggregator:
         training_dump_max_files: int = 1000,
         skew_tolerance: float = 120.0,
         degraded_ttl: float = 60.0,
+        dedup_window: int = 1024,
         clock=None,
         mesh=None,
     ) -> None:
@@ -214,12 +266,26 @@ class Aggregator:
         # A bounded per-node list (oldest dropped) keeps memory O(nodes).
         self._superseded_runs: dict[str, list[str]] = {}
         self._superseded_cap = 16
+        # idempotent ingest + loss accounting: per-node seq trackers for
+        # the CURRENT run (spool replays dedupe; seq jumps become
+        # kepler_fleet_windows_lost_total). Trackers deliberately OUTLIVE
+        # batch staleness: a partition longer than stale_after followed
+        # by a spool replay must resume from max_seen, not fabricate a
+        # loss spike and re-ingest delivered windows. Bounded by count
+        # instead (least-recently-observed evicted at the cap), like the
+        # cumulative loss table.
+        self._dedup_window = max(1, dedup_window)
+        self._seq_trackers: dict[str, _SeqTracker] = {}  # keplint: guarded-by=_lock
+        self._tracker_cap = 512
+        self._lost_by_node: dict[str, int] = {}  # keplint: guarded-by=_lock
+        self._lost_node_cap = 256
         self._results_lock = threading.Lock()
         self._results: FleetResults | None = None  # keplint: guarded-by=_results_lock
         self._last_window_at: float | None = None
         self._stats = {"reports_total": 0, "rejected_total": 0,
                        "quarantined_total": 0, "malformed_total": 0,
                        "clock_skew_total": 0,
+                       "duplicates_total": 0, "windows_lost_total": 0,
                        "attributions_total": 0, "last_batch_nodes": 0,
                        "last_batch_workloads": 0,
                        # whole-window latency (assembly + device + scatter)
@@ -331,21 +397,38 @@ class Aggregator:
             return (422, {"Content-Type": "text/plain"},
                     f"report clock skewed {skew:+.1f}s beyond tolerance "
                     f"{self._skew_tolerance:g}s\n".encode())
+        # header identity coercion is VALIDATING, not converting: a report
+        # whose seq/run carry the wrong JSON type (a string seq, a list
+        # run) is malformed input from an untrusted network — quarantine
+        # and charge the sender, never raise into a 500
+        seq_raw = header.get("seq", 0)
+        run_raw = header.get("run", "")
+        if (isinstance(seq_raw, bool) or not isinstance(seq_raw, int)
+                or seq_raw < 0 or not isinstance(run_raw, str)):
+            with self._lock:
+                self._stats["rejected_total"] += 1
+                self._stats["quarantined_total"] += 1
+                self._stats["malformed_total"] += 1
+                self._record_degraded_locked(
+                    report.node_name, "malformed",
+                    f"bad header identity: seq={seq_raw!r} run={run_raw!r}")
+            return (400, {"Content-Type": "text/plain"},
+                    b"seq must be a non-negative integer and run a string\n")
         stored = _Stored(report=report,
                          zone_names=tuple(header["zone_names"]),
                          received=received,
-                         seq=int(header.get("seq", 0)),
-                         run=str(header.get("run", "")))
+                         seq=seq_raw,
+                         run=run_raw)
         with self._lock:
             prev = self._reports.get(report.node_name)
             # When BOTH sides carry a run nonce the cases are unambiguous:
             # different nonce = fresh agent process (restart), same nonce +
-            # seq regression = network reorder (reject). Pre-nonce agents
-            # fall back to the seq==1 heuristic for restarts. A nonce that
-            # matches any run a previous restart superseded is a delayed
-            # straggler from a dead run — reject it outright rather than
-            # honoring it as another restart (which would also wrongly
-            # mark the live run as superseded).
+            # seq regression = network reorder or spool redelivery (the
+            # dedup window sorts those out). A nonce that matches any run
+            # a previous restart superseded is a delayed straggler from a
+            # dead run — reject it outright rather than honoring it as
+            # another restart (which would also wrongly mark the live run
+            # as superseded).
             superseded = self._superseded_runs.get(report.node_name, [])
             if stored.run and stored.run in superseded:
                 self._stats["rejected_total"] += 1
@@ -359,9 +442,66 @@ class Aggregator:
                     report.node_name, [])
                 runs.append(prev.run)
                 del runs[:-self._superseded_cap]
-            legacy = prev is not None and not has_nonces
-            if (prev is None or restarted or stored.seq >= prev.seq
-                    or (legacy and stored.seq == 1)):
+            # idempotent ingest + loss accounting (nonce-carrying agents
+            # only — a pre-nonce agent's seq space restarts unannounced,
+            # so gap math on it would fabricate loss). seq 0 means "no
+            # sequencing" (encode_report's default): real agents number
+            # from 1, and deduping a stream of constant zeros would
+            # freeze the node's data on its first window forever.
+            if stored.run and stored.seq > 0:
+                tracker = self._seq_trackers.get(report.node_name)
+                if tracker is None or tracker.run != stored.run:
+                    # the cap tracks the LIVE fleet (2× headroom, floor
+                    # for small fleets): a fixed cap below the fleet size
+                    # would thrash — every round-robin arrival evicting a
+                    # peer's tracker, disabling dedup and fabricating
+                    # lost-window counts on every report. Memory is
+                    # operator-bounded via aggregator.dedupWindow.
+                    cap = max(self._tracker_cap, 2 * len(self._reports))
+                    if (report.node_name not in self._seq_trackers
+                            and len(self._seq_trackers) >= cap):
+                        self._seq_trackers.pop(min(
+                            self._seq_trackers,
+                            key=lambda n: self._seq_trackers[n].touched))
+                    tracker = _SeqTracker(stored.run, self._dedup_window)
+                    self._seq_trackers[report.node_name] = tracker
+                tracker.touched = received
+                dup, lost = tracker.observe(stored.seq)
+                if dup:
+                    # at-least-once redelivery (spool replay, LB retry):
+                    # acknowledge so the sender's cursor advances, ingest
+                    # nothing — the earlier copy already counted. The
+                    # duplicate still PROVES the sender is alive: refresh
+                    # liveness, or a replay longer than stale_after would
+                    # prune this tracker mid-stream and the rest of the
+                    # backlog would re-ingest as fresh windows
+                    if prev is not None and prev.run == stored.run:
+                        prev.received = received
+                    self._stats["duplicates_total"] += 1
+                    self._stats["reports_total"] += 1
+                    return 204, {}, b""
+                if lost:
+                    self._stats["windows_lost_total"] += lost
+                    # pop-and-reinsert keeps dict order = recency of last
+                    # loss, so cap eviction drops the node that stopped
+                    # losing longest ago — never an actively-firing
+                    # series (a mid-series counter reset breaks rate()
+                    # alerting on exactly this signal)
+                    total = self._lost_by_node.pop(report.node_name,
+                                                   0) + lost
+                    if len(self._lost_by_node) >= self._lost_node_cap:
+                        self._lost_by_node.pop(
+                            next(iter(self._lost_by_node)))
+                    self._lost_by_node[report.node_name] = total
+                    log.warning("node %s: %d window(s) lost before seq %d "
+                                "(never delivered)", report.node_name,
+                                lost, stored.seq)
+            # NOTE: the legacy `seq == 1` restart heuristic is gone — a
+            # spool replay legitimately starts at seq 1 of an OLD run and
+            # must not double-ingest as a "restart"; nonce-carrying agents
+            # signal restarts explicitly, and pre-nonce agents simply age
+            # out via stale_after before their fresh reports land again.
+            if prev is None or restarted or stored.seq >= prev.seq:
                 self._reports[report.node_name] = stored
                 # history push is NOT idempotent (a dup would shift the
                 # window) → require a seq change OR a run change (an agent
@@ -446,6 +586,8 @@ class Aggregator:
             "ok": not degraded,
             "degraded_nodes": sorted(degraded),
             "quarantined_total": self._stats["quarantined_total"],
+            "windows_lost_total": self._stats["windows_lost_total"],
+            "duplicates_total": self._stats["duplicates_total"],
         }
         if last is not None:
             out["last_window_age_s"] = round(self._clock() - last, 3)
@@ -475,6 +617,8 @@ class Aggregator:
                 del self._history[name]
             for name in [n for n in self._superseded_runs if n not in live]:
                 del self._superseded_runs[name]
+            # _seq_trackers are NOT pruned here: they must survive
+            # partitions longer than stale_after (see __init__ comment)
             for name in [n for n, e in self._degraded.items()
                          if now - e["last_at"] > self._degraded_ttl]:
                 del self._degraded[name]
@@ -875,6 +1019,20 @@ class Aggregator:
         quarantined.add_metric(["malformed"], stats["malformed_total"])
         quarantined.add_metric(["clock_skew"], stats["clock_skew_total"])
         yield quarantined
+        duplicates = CounterMetricFamily(
+            "kepler_fleet_reports_duplicate_total",
+            "Redelivered (run, seq) reports absorbed by the dedup window")
+        duplicates.add_metric([], stats["duplicates_total"])
+        yield duplicates
+        with self._lock:
+            lost_by_node = dict(self._lost_by_node)
+        lost = CounterMetricFamily(
+            "kepler_fleet_windows_lost_total",
+            "Windows that never arrived (seq gaps), by reporting node",
+            labels=["node_name"])
+        for node, count in lost_by_node.items():
+            lost.add_metric([node], count)
+        yield lost
         degraded = GaugeMetricFamily(
             "kepler_fleet_degraded_nodes",
             "Nodes whose reports were quarantined within the decay window")

@@ -79,6 +79,36 @@ class WireError(ValueError):
     pass
 
 
+def restamp_sent_at(data: bytes, sent_at: float) -> bytes:
+    """Rewrite a report payload's ``sent_at`` header field in place.
+
+    Spooled records (``fleet.spool``) keep their original ``run``/``seq``
+    identity but must carry a TRANSMIT-time ``sent_at``: the aggregator's
+    clock-skew quarantine compares ``sent_at`` against its receive time,
+    so a backlog replayed hours after the window was measured would look
+    like a skewed sender if the append-time stamp rode along. Only the
+    JSON header is re-serialized — array bytes pass through untouched.
+    Raises :class:`WireError` on a payload it cannot parse."""
+    if len(data) < len(MAGIC) + _HEADER_LEN.size or \
+            data[: len(MAGIC)] != MAGIC:
+        raise WireError("bad magic")
+    off = len(MAGIC)
+    (hlen,) = _HEADER_LEN.unpack_from(data, off)
+    off += _HEADER_LEN.size
+    if hlen > MAX_HEADER_BYTES or off + hlen > len(data):
+        raise WireError("bad header length")
+    try:
+        header = json.loads(data[off: off + hlen])
+    except (json.JSONDecodeError, UnicodeDecodeError) as err:
+        raise WireError(f"bad header json: {err}") from err
+    if not isinstance(header, dict):
+        raise WireError("header is not a mapping")
+    header["sent_at"] = float(sent_at)
+    header_bytes = json.dumps(header, separators=(",", ":")).encode()
+    return b"".join([MAGIC, _HEADER_LEN.pack(len(header_bytes)),
+                     header_bytes, data[off + hlen:]])
+
+
 def peek_node_name(data: bytes) -> str | None:
     """Best-effort node name from a (possibly malformed) payload.
 

@@ -93,6 +93,8 @@ class PowerMonitor:
         workload_bucket: int = 256,
         clock: Callable[[], float] | None = None,
         monotonic: Callable[[], float] | None = None,
+        state_path: str = "",
+        state_max_age: float = 60.0,
     ) -> None:
         self._meter = meter
         self._resources = resources
@@ -105,6 +107,13 @@ class PowerMonitor:
         # dt for power uses a monotonic source so NTP steps can't inflate
         # watts; tests inject the same fake for both
         self._monotonic = monotonic or (clock if clock else _time.monotonic)
+
+        # counter-state persistence: with a state_path, the last raw
+        # counter readings + a wall-clock anchor survive restarts, so the
+        # first post-restart window attributes the energy consumed ACROSS
+        # the restart instead of discarding it as a fresh seeding read
+        self._state_path = state_path
+        self._state_max_age = max(0.0, state_max_age)
 
         self._zones: list[EnergyZone] = []
         self._zone_names: tuple[str, ...] = ()
@@ -169,6 +178,7 @@ class PowerMonitor:
                 max_size=self._max_terminated,
                 min_energy_uj=self._min_terminated_energy_uj,
             )
+        self._restore_state()
         log.info("monitor initialized: zones=%s primary=%s",
                  self._zone_names, primary)
 
@@ -337,6 +347,8 @@ class PowerMonitor:
                 except Exception:
                     log.exception("window listener failed")
         self._maybe_prewarm_next_bucket(w, padded_w)
+        if self._state_path:
+            self._persist_state(now)
         self._last_refresh_done = self._monotonic()
         if self._stalled:
             log.info("refresh loop recovered; clearing stall flag")
@@ -480,6 +492,120 @@ class PowerMonitor:
             deltas[i] = energy_delta(current, prev, int(zone.max_energy()))
             valid[i] = True
         return deltas, valid
+
+    # -- counter-state persistence (restart without losing a window) -------
+
+    @staticmethod
+    def _boot_id() -> str:
+        """Kernel boot identity: RAPL counters reset on reboot, so a
+        baseline from a previous boot must never be adopted — the wrap
+        math would read the reset as a wrap and fabricate up to a full
+        counter range of energy. Empty when unreadable (non-Linux): the
+        check then degrades to the staleness bound alone."""
+        try:
+            with open("/proc/sys/kernel/random/boot_id",
+                      encoding="ascii") as fh:
+                return fh.read().strip()
+        except OSError:
+            return ""
+
+    def _persist_state(self, now: float) -> None:
+        """Write the raw counter baseline + wall anchor, atomically.
+
+        No fsync: losing the newest state file on a power cut only means
+        the next start seeds counters like a cold boot — correct, just
+        one window poorer. Failures are logged and never break refresh."""
+        from kepler_tpu.utils.atomicio import atomic_write_json
+
+        state = {"v": 1, "saved_at": now,
+                 "boot_id": self._boot_id(),
+                 "zone_names": list(self._zone_names),
+                 "counters": list(self._prev_counters)}
+        try:
+            atomic_write_json(self._state_path, state)
+        except OSError as err:
+            log.warning("monitor state persist failed: %s", err)
+
+    # called from init() before any other thread exists; the annotation
+    # records that it writes the lock-guarded counter baseline
+    # keplint: requires-lock=_snapshot_lock
+    def _restore_state(self) -> None:
+        """Adopt a fresh state file's counter baseline at startup.
+
+        The restored counters make the FIRST refresh a real window (delta
+        since the previous process's last reading — wrap-aware, because
+        ``_read_zone_deltas`` already routes through ``energy_delta``),
+        and the wall anchor back-dates the monotonic read timestamp so
+        dt covers the restart gap. Anything suspicious — missing file,
+        unparseable JSON, zone-set change, stale or future ``saved_at`` —
+        is IGNORED with a warning: a state file must never be able to
+        prevent startup, and a stale baseline would attribute energy from
+        a long-dead window to the first post-restart one."""
+        import json
+
+        if not self._state_path:
+            return
+        try:
+            with open(self._state_path, encoding="utf-8") as fh:
+                state = json.load(fh)
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError) as err:
+            log.warning("monitor state file unreadable (%s); seeding "
+                        "counters from scratch", err)
+            return
+        try:
+            if not isinstance(state, dict) or state.get("v") != 1:
+                raise ValueError(f"unsupported version {state.get('v')!r}")
+            saved_at = state["saved_at"]
+            if isinstance(saved_at, bool) or not isinstance(
+                    saved_at, (int, float)):
+                raise ValueError("saved_at must be a number")
+            zone_names = state["zone_names"]
+            counters = state["counters"]
+            if not (isinstance(zone_names, list)
+                    and isinstance(counters, list)
+                    and len(zone_names) == len(counters)):
+                raise ValueError("zone_names/counters malformed")
+            for c in counters:
+                if c is not None and (isinstance(c, bool)
+                                      or not isinstance(c, int) or c < 0):
+                    raise ValueError(f"bad counter value {c!r}")
+        except (ValueError, KeyError, TypeError) as err:
+            log.warning("monitor state file invalid (%s); seeding "
+                        "counters from scratch", err)
+            return
+        now = self._clock()
+        age = now - float(saved_at)
+        # state_max_age == 0 means unbounded (this codebase's 0-disables
+        # convention, like aggregator.skewTolerance); negative age means
+        # the wall clock stepped backwards — never trust that baseline
+        if age < 0 or (self._state_max_age > 0
+                       and age > self._state_max_age):
+            log.warning("monitor state is %.1fs old (bound %.1fs); "
+                        "seeding counters from scratch", age,
+                        self._state_max_age)
+            return
+        if tuple(zone_names) != self._zone_names:
+            log.warning("monitor state zone set %s != current %s; "
+                        "seeding counters from scratch",
+                        zone_names, list(self._zone_names))
+            return
+        saved_boot = state.get("boot_id", "")
+        if saved_boot != self._boot_id():
+            # a reboot inside stateMaxAge: the counters RESET, they did
+            # not wrap — adopting the old baseline would fabricate up to
+            # a full counter range of energy in the first window
+            log.warning("monitor state is from a previous boot; "
+                        "seeding counters from scratch")
+            return
+        self._prev_counters = [None if c is None else int(c)
+                               for c in counters]
+        # back-date the monotonic read anchor so the first window's dt
+        # spans the restart (power = energy / dt must use the real gap)
+        self._last_read_ts = self._monotonic() - age
+        log.info("monitor state restored (age %.1fs): first window "
+                 "attributes across the restart", age)
 
     def _accumulate_node(self, result, usage_ratio: float) -> NodeUsage:
         n = result.node

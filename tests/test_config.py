@@ -359,6 +359,30 @@ FIELD_MATRIX = [
               "aggregator: {skewTolerance: 30s}", 30.0),
     FieldCase("aggregator.degraded_ttl",
               "aggregator: {degradedTtl: 90s}", 90.0),
+    # durable delivery plane (ISSUE 3)
+    FieldCase("aggregator.dedup_window",
+              "aggregator: {dedupWindow: 64}", 64,
+              ["--aggregator.dedup-window", "32"], 32),
+    FieldCase("monitor.state_path",
+              "monitor: {statePath: /var/lib/kepler/state.json}",
+              "/var/lib/kepler/state.json",
+              ["--monitor.state-path", "/tmp/s.json"], "/tmp/s.json"),
+    FieldCase("monitor.state_max_age",
+              "monitor: {stateMaxAge: 2m}", 120.0),
+    FieldCase("agent.spool.dir",
+              "agent: {spool: {dir: /var/lib/kepler/spool}}",
+              "/var/lib/kepler/spool",
+              ["--agent.spool-dir", "/tmp/spool"], "/tmp/spool"),
+    FieldCase("agent.spool.max_bytes",
+              "agent: {spool: {maxBytes: 1048576}}", 1048576),
+    FieldCase("agent.spool.max_records",
+              "agent: {spool: {maxRecords: 128}}", 128),
+    FieldCase("agent.spool.segment_bytes",
+              "agent: {spool: {segmentBytes: 65536}}", 65536),
+    FieldCase("agent.spool.fsync",
+              "agent: {spool: {fsync: always}}", "always"),
+    FieldCase("agent.spool.fsync_interval",
+              "agent: {spool: {fsyncInterval: 500ms}}", 0.5),
     FieldCase("service.restart_max", "service: {restartMax: 2}", 2),
     FieldCase("service.restart_backoff_initial",
               "service: {restartBackoffInitial: 250ms}", 0.25),
@@ -452,6 +476,13 @@ class TestYAMLSpellings:
         "restartMax": "service",
         "restartBackoffInitial": "service",
         "restartBackoffMax": "service",
+        "statePath": "monitor",
+        "stateMaxAge": "monitor",
+        "dedupWindow": "aggregator",
+        "maxBytes": ("agent", "spool"),
+        "maxRecords": ("agent", "spool"),
+        "segmentBytes": ("agent", "spool"),
+        "fsyncInterval": ("agent", "spool"),
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -489,6 +520,13 @@ class TestYAMLSpellings:
         "restartMax": ("2", 2),
         "restartBackoffInitial": ("250ms", 0.25),
         "restartBackoffMax": ("10s", 10.0),
+        "statePath": ("/tmp/s.json", "/tmp/s.json"),
+        "stateMaxAge": ("2m", 120.0),
+        "dedupWindow": ("64", 64),
+        "maxBytes": ("1048576", 1048576),
+        "maxRecords": ("128", 128),
+        "segmentBytes": ("65536", 65536),
+        "fsyncInterval": ("500ms", 0.5),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))

@@ -422,8 +422,8 @@ class TestAgent:
         assert monitor.listeners  # subscribed
         monitor.emit(make_sample())
         # drain the queue synchronously (run() would do this in a thread)
-        sample = agent._queue.popleft()
-        agent._send(sample)
+        seq, sample = agent._queue.popleft()
+        agent._send(sample, seq)
         result = agg.aggregate_once()
         assert result is not None
         with agg._results_lock:
@@ -461,7 +461,8 @@ class TestAgent:
             bare.init()
             monitor.emit(make_sample())
             with pytest.raises(http.client.HTTPException, match="401"):
-                bare._send(bare._queue.popleft())
+                seq, sample = bare._queue.popleft()
+                bare._send(sample, seq)
             # with credentials in the URL: accepted
             authed = FleetAgent(monitor,
                                 endpoint=f"http://agent:pw@{host}:{port}",
@@ -470,7 +471,8 @@ class TestAgent:
                 b"agent:pw").decode()
             authed.init()
             monitor.emit(make_sample())
-            authed._send(authed._queue.popleft())
+            seq, sample = authed._queue.popleft()
+            authed._send(sample, seq)
             assert agg.aggregate_once() is not None
         finally:
             ctx.cancel()
@@ -482,9 +484,9 @@ class TestAgent:
                            node_name="test-node", timeout_s=0.2)
         agent.init()
         monitor.emit(make_sample())
-        sample = agent._queue.popleft()
+        seq, sample = agent._queue.popleft()
         with pytest.raises(OSError):
-            agent._send(sample)  # run() catches this and logs
+            agent._send(sample, seq)  # run() catches this and logs
 
     def test_agent_run_loop_drains(self, server):
         agg = Aggregator(server, model_mode=None, node_bucket=8,

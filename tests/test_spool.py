@@ -1,0 +1,432 @@
+"""Spool unit tests: framing roundtrip, restart resume, torn-tail
+recovery (exhaustive truncation sweep), cap eviction accounting, fsync
+policies, disk fault injection, and the wire restamp helper the replay
+path depends on."""
+
+import json
+import os
+
+import pytest
+
+from kepler_tpu import fault
+from kepler_tpu.fault import FaultPlan, FaultSpec
+from kepler_tpu.fleet.spool import _FRAME, Spool
+from kepler_tpu.fleet.wire import (
+    WireError,
+    decode_report,
+    encode_report,
+    restamp_sent_at,
+)
+
+from tests.test_fleet import make_report
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def payloads(n, start=0):
+    return [f"window-{i:04d}".encode() * 3 for i in range(start, start + n)]
+
+
+def drain(spool):
+    out = []
+    while True:
+        rec = spool.peek()
+        if rec is None:
+            return out
+        out.append(rec.payload)
+        spool.ack()
+
+
+class TestSpoolBasics:
+    def test_append_peek_ack_order(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(5)
+        for p in data:
+            assert s.append(p)
+        assert s.pending_records() == 5
+        # repeated peeks without ack return the same record
+        assert s.peek().payload == data[0]
+        assert s.peek().payload == data[0]
+        assert drain(s) == data
+        assert s.pending_records() == 0
+        assert s.peek() is None
+        s.close()
+
+    def test_restart_resumes_after_cursor(self, tmp_path):
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        data = payloads(5)
+        for p in data:
+            s.append(p)
+        for _ in range(2):
+            s.peek()
+            s.ack()
+        s.close()
+        s2 = Spool(d)
+        assert s2.pending_records() == 3
+        assert drain(s2) == data[2:]
+        s2.close()
+
+    def test_restart_without_cursor_replays_everything(self, tmp_path):
+        # a crash between 2xx and cursor persist re-delivers: at-least-once
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        data = payloads(4)
+        for p in data:
+            s.append(p)
+        for _ in range(4):
+            s.peek()
+            s.ack()
+        s.close()
+        os.unlink(os.path.join(d, "cursor.json"))
+        s2 = Spool(d)
+        assert drain(s2) == data
+        s2.close()
+
+    def test_corrupt_cursor_replays_from_oldest(self, tmp_path):
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        for p in payloads(3):
+            s.append(p)
+        s.peek(), s.ack()
+        s.close()
+        with open(os.path.join(d, "cursor.json"), "w") as fh:
+            fh.write("{broken json")
+        s2 = Spool(d)
+        assert s2.pending_records() == 3  # never crashes, replays all
+        s2.close()
+
+    def test_rotation_reclaims_acked_segments(self, tmp_path):
+        d = str(tmp_path / "sp")
+        s = Spool(d, segment_bytes=4096, max_bytes=1 << 20)
+        big = [b"x" * 2048 for _ in range(6)]
+        for p in big:
+            s.append(p)
+        assert len([f for f in os.listdir(d) if f.endswith(".seg")]) > 1
+        drain(s)
+        # every sealed segment before the cursor was deleted
+        segs = [f for f in os.listdir(d) if f.endswith(".seg")]
+        assert len(segs) == 1
+        s.close()
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="fsync"):
+            Spool(str(tmp_path / "sp"), fsync="sometimes")
+
+    def test_batch_policy_never_fsyncs_on_append(self, tmp_path,
+                                                 monkeypatch):
+        # review fix: append() runs inside the monitor's refresh lock —
+        # the batch policy must fsync only via sync() (drain thread)
+        calls = []
+        import kepler_tpu.fleet.spool as spoolmod
+
+        monkeypatch.setattr(spoolmod.os, "fsync",
+                            lambda fd: calls.append(fd))
+        s = Spool(str(tmp_path / "sp"), fsync="batch")
+        for p in payloads(5):
+            s.append(p)
+        assert calls == []  # zero fsyncs on the append path
+        s.sync()
+        assert len(calls) == 1  # the drain-thread tick flushed once
+        s.sync()
+        assert len(calls) == 1  # nothing dirty: no redundant fsync
+        s.append(b"more")
+        s.close()
+        assert len(calls) == 2  # close flushes the dirty tail
+        always = Spool(str(tmp_path / "sp2"), fsync="always")
+        always.append(b"x")
+        assert len(calls) == 3  # always-policy pays inline
+        always.close()
+
+    def test_always_fsync_roundtrip(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"), fsync="always")
+        data = payloads(3)
+        for p in data:
+            s.append(p)
+        assert drain(s) == data
+        s.close()
+
+    def test_health_and_utilization(self, tmp_path):
+        clock = [1000.0]
+        s = Spool(str(tmp_path / "sp"), max_bytes=1 << 20,
+                  clock=lambda: clock[0])
+        assert s.health()["ok"]
+        assert s.oldest_age() is None
+        s.append(b"p" * 100)
+        clock[0] += 7.0
+        assert s.oldest_age() == pytest.approx(7.0)
+        h = s.health()
+        assert h["pending_records"] == 1
+        assert 0 < h["utilization"] < 0.9
+        s.close()
+
+
+class TestTornTail:
+    def _build(self, tmp_path, n=3):
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        data = payloads(n)
+        for p in data:
+            s.append(p)
+        s.close()
+        seg = os.path.join(d, sorted(
+            f for f in os.listdir(d) if f.endswith(".seg"))[-1])
+        return d, seg, data
+
+    def test_truncation_at_every_offset_of_final_record(self, tmp_path):
+        """Deterministic kill -9 fixture: for EVERY byte offset inside the
+        final record's frame, a spool truncated there reopens cleanly and
+        replays exactly the intact records."""
+        d, seg, data = self._build(tmp_path)
+        size = os.path.getsize(seg)
+        last_frame = _FRAME.size + len(data[-1])
+        raw = open(seg, "rb").read()
+        for cut in range(size - last_frame, size):
+            with open(seg, "wb") as fh:
+                fh.write(raw[:cut])
+            s = Spool(d)
+            assert s.pending_records() == 2, cut
+            assert drain(s) == data[:2], cut
+            if cut > size - last_frame:  # boundary cut: nothing torn
+                assert s.stats()["truncated_tail_records"] >= 1, cut
+            s.close()
+            # restore for the next cut (and reset the cursor the drain moved)
+            with open(seg, "wb") as fh:
+                fh.write(raw)
+            os.unlink(os.path.join(d, "cursor.json"))
+
+    def test_full_length_reopen_loses_nothing(self, tmp_path):
+        d, seg, data = self._build(tmp_path)
+        s = Spool(d)
+        assert drain(s) == data
+        assert s.stats()["truncated_tail_records"] == 0
+        s.close()
+
+    def test_crc_flip_in_final_record_truncated(self, tmp_path):
+        d, seg, data = self._build(tmp_path)
+        raw = bytearray(open(seg, "rb").read())
+        raw[-3] ^= 0xFF  # corrupt the final record's payload
+        with open(seg, "wb") as fh:
+            fh.write(bytes(raw))
+        s = Spool(d)
+        assert drain(s) == data[:2]
+        s.close()
+
+
+class TestEviction:
+    def test_record_cap_evicts_oldest_and_counts(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"), max_records=8)
+        data = payloads(20)
+        for p in data:
+            assert s.append(p)
+        stats = s.stats()
+        assert stats["evicted_total"] > 0
+        assert stats["evicted_total"] + s.pending_records() == 20
+        got = drain(s)
+        # the survivors are a contiguous newest suffix, in order
+        assert got == data[-len(got):]
+        s.close()
+
+    def test_byte_cap_evicts_oldest(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"), max_bytes=8192, segment_bytes=4096)
+        for p in [b"y" * 1024 for _ in range(16)]:
+            s.append(p)
+        assert s.stats()["evicted_total"] > 0
+        assert s.utilization() <= 1.0
+        assert len(drain(s)) + s.stats()["evicted_total"] == 16
+        s.close()
+
+    def test_record_cap_drives_utilization_too(self, tmp_path):
+        # review fix: a record-cap-bound spool (tiny maxRecords, roomy
+        # maxBytes) must trip the health probe BEFORE eviction starts
+        s = Spool(str(tmp_path / "sp"), max_records=10)
+        for p in payloads(9):
+            s.append(p)
+        assert s.utilization() >= 0.9  # bytes are ~0 of 64 MiB
+        assert s.stats()["evicted_total"] == 0  # nothing discarded yet
+        assert not s.health()["ok"]  # early warning fired pre-eviction
+        s.close()
+
+    def test_acked_segments_evict_without_loss_accounting(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"), max_records=8)
+        for p in payloads(6):
+            s.append(p)
+        drain(s)  # all acked
+        for p in payloads(6, start=6):
+            s.append(p)
+        # eviction of fully-acked old segments counts nothing as lost
+        assert s.stats()["evicted_total"] == 0
+        s.close()
+
+
+class TestDiskFaults:
+    def test_write_error_fault_counts_and_degrades(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        with fault.installed(FaultPlan([
+                FaultSpec("disk.write_error", count=1)])):
+            assert s.append(b"doomed") is False
+        assert s.stats()["write_errors_total"] == 1
+        assert s.append(b"fine")  # disk recovered: stream still framed
+        assert drain(s) == [b"fine"]
+        s.close()
+
+    def test_torn_tail_fault_keeps_stream_consistent(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        s.append(b"before")
+        with fault.installed(FaultPlan([
+                FaultSpec("disk.torn_tail", count=1)])) as plan:
+            assert s.append(b"torn-victim") is False
+            assert plan.fired("disk.torn_tail") == 1
+        s.append(b"after")
+        assert drain(s) == [b"before", b"after"]
+        s.close()
+
+    def test_torn_tail_fault_survives_reopen(self, tmp_path):
+        # even if the in-process cleanup is skipped (the "process died"
+        # half of the fault), reopen recovers via tail truncation
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        s.append(b"good")
+        with fault.installed(FaultPlan([FaultSpec("disk.torn_tail")])):
+            s.append(b"never-lands")
+        s._write_fh.close()  # simulate death without close() bookkeeping
+        s2 = Spool(d)
+        assert drain(s2) == [b"good"]
+        s2.close()
+
+    def test_fsync_error_fault_counted_not_fatal(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"), fsync="always")
+        with fault.installed(FaultPlan([
+                FaultSpec("disk.fsync_error", count=1)])):
+            assert s.append(b"kept")  # append survives a failed fsync
+        assert s.stats()["fsync_errors_total"] == 1
+        assert drain(s) == [b"kept"]
+        s.close()
+
+
+class TestRestamp:
+    def test_restamp_updates_only_sent_at(self):
+        report = make_report("node-a")
+        blob = encode_report(report, ["package", "dram"], seq=9,
+                             run="run-x")
+        stamped = restamp_sent_at(blob, 1234.5)
+        decoded, header = decode_report(stamped)
+        assert header["sent_at"] == 1234.5
+        assert header["seq"] == 9 and header["run"] == "run-x"
+        assert decoded.node_name == "node-a"
+        assert decoded.workload_ids == report.workload_ids
+        # restamping an already-stamped body replaces the value
+        restamped = restamp_sent_at(stamped, 99.0)
+        assert decode_report(restamped)[1]["sent_at"] == 99.0
+
+    def test_restamp_rejects_garbage(self):
+        with pytest.raises(WireError):
+            restamp_sent_at(b"not a report", 1.0)
+
+    def test_restamp_preserves_array_bytes(self):
+        report = make_report("node-b", w=5)
+        blob = encode_report(report, ["package", "dram"], seq=1)
+        a = decode_report(blob)[0]
+        b = decode_report(restamp_sent_at(blob, 7.0))[0]
+        assert (a.zone_deltas_uj == b.zone_deltas_uj).all()
+        assert (a.cpu_deltas == b.cpu_deltas).all()
+
+
+class TestAckValidation:
+    def test_stale_ack_is_a_noop(self, tmp_path):
+        # review fix: an ack for a record whose slot the cursor already
+        # left (eviction moved it) must not skip a different record
+        s = Spool(str(tmp_path / "sp"), max_records=8)
+        first = payloads(1)[0]
+        s.append(first)
+        rec = s.peek()
+        assert rec.payload == first
+        # cap eviction wipes the oldest segments while rec is "in flight"
+        for p in payloads(20, start=1):
+            s.append(p)
+        assert s.stats()["evicted_total"] > 0
+        survivor = s.peek()
+        s.ack(rec)  # stale: cursor no longer at rec's slot → no-op
+        assert s.peek().payload == survivor.payload  # nothing skipped
+        s.close()
+
+    def test_explicit_ack_matches_peek(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(3)
+        for p in data:
+            s.append(p)
+        out = []
+        while True:
+            rec = s.peek()
+            if rec is None:
+                break
+            out.append(rec.payload)
+            s.ack(rec)
+        assert out == data
+        s.close()
+
+
+class TestRotationFailure:
+    def test_failed_rotation_keeps_spool_alive(self, tmp_path,
+                                               monkeypatch):
+        # review fix: when opening the next segment fails (disk full),
+        # the spool keeps limping on the current segment — the write
+        # handle must never end up closed/dangling
+        s = Spool(str(tmp_path / "sp"), segment_bytes=4096)
+        s.append(b"a" * 4096)  # active segment now at rotation size
+        real_open = open
+
+        def failing_open(path, *a, **kw):
+            if str(path).endswith(".seg") and "0000000002" in str(path):
+                raise OSError(28, "No space left on device")
+            return real_open(path, *a, **kw)
+
+        monkeypatch.setattr("builtins.open", failing_open)
+        assert s.append(b"second") is False  # rotation failed, counted
+        assert s.stats()["write_errors_total"] == 1
+        monkeypatch.undo()
+        assert s.append(b"third")  # disk recovered: spool still works
+        got = drain(s)
+        assert got[0] == b"a" * 4096 and got[-1] == b"third"
+        s.close()
+
+
+class TestUnreadableSegment:
+    def test_unreadable_sealed_segment_counted_not_silent(self, tmp_path,
+                                                          caplog):
+        # review fix: a sealed segment the reader cannot open is LOSS —
+        # counted and logged, cursor moves on, pending gauge recounted
+        d = str(tmp_path / "sp")
+        s = Spool(d, segment_bytes=4096)
+        early = [b"e" * 2048 for _ in range(3)]  # fills + seals segment 1
+        late = payloads(2)
+        for p in early + late:
+            s.append(p)
+        assert len(s._segments) >= 1
+        sealed = min(s._segments)
+        count = s._segments[sealed][0]
+        os.unlink(os.path.join(d, f"spool-{sealed:010d}.seg"))
+        with caplog.at_level("WARNING", logger="kepler.fleet.spool"):
+            got = drain(s)
+        assert got[-len(late):] == late  # later records still replay
+        assert s.stats()["evicted_total"] == count  # loss visible
+        assert s.pending_records() == 0  # gauge recounted, no phantom
+        assert any("unreadable" in r.message for r in caplog.records)
+        s.close()
+
+
+class TestCursorFile:
+    def test_cursor_is_atomic_json(self, tmp_path):
+        d = str(tmp_path / "sp")
+        s = Spool(d)
+        s.append(b"one")
+        s.peek(), s.ack()
+        data = json.load(open(os.path.join(d, "cursor.json")))
+        assert data["v"] == 1 and data["segment"] >= 1
+        assert not os.path.exists(os.path.join(d, "cursor.json.tmp"))
+        s.close()
