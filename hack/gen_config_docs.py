@@ -208,6 +208,24 @@ DESCRIPTIONS = {
     "fault.specs": "Fault specs: mappings with a `site` "
                    "(e.g. `net.refuse`, `device.read_error`) plus "
                    "optional probability/count/skip/start/duration/arg.",
+    "telemetry.enabled": "Self-telemetry plane: span tracing of the "
+                         "monitor/exporter/fleet hot paths, "
+                         "`kepler_self_*` metrics, and `/debug/traces`. "
+                         "Disabled spans cost one global read per call "
+                         "(see docs/developer/observability.md).",
+    "telemetry.ring_size": "Complete cycle traces kept for "
+                           "`/debug/traces`, per cycle name (newest "
+                           "wins; per-name rings keep high-rate cycles "
+                           "from evicting rare ones).",
+    "telemetry.stage_buckets": "`kepler_self_stage_duration_seconds` "
+                               "histogram bucket bounds in seconds "
+                               "(empty = built-in defaults, 0.5ms–10s).",
+    "telemetry.delivery_buckets": "`kepler_fleet_delivery_latency_"
+                                  "seconds` histogram bucket bounds in "
+                                  "seconds (empty = built-in defaults, "
+                                  "10ms–6h — the tail reaches hours "
+                                  "because spool replays carry outage "
+                                  "durations).",
     "dev.fake_cpu_meter.enabled": "Dev-only synthetic meter (YAML-only, "
                                   "never a flag — reference "
                                   "config.go:104,189).",
@@ -251,6 +269,7 @@ FLAG_OF = {
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
+    "telemetry.enabled": "--telemetry.enable / --no-telemetry.enable",
 }
 
 _SNAKE_TO_CAMEL = {v: k for k, v in _CANONICAL_YAML_KEYS.items()}

@@ -34,8 +34,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         print(f"error: {err}", file=sys.stderr)
         return 1
     new_logger(cfg.log.level, cfg.log.format)
-    from kepler_tpu import fault
+    from kepler_tpu import fault, telemetry
     fault.install_from_config(cfg.fault)
+    telemetry.install_from_config(cfg.telemetry)
     # multi-host DCN: if JAX_COORDINATOR_ADDRESS is set, join the cluster
     # BEFORE any jax API initialises the backend (no-op single-host)
     from kepler_tpu.parallel import initialize_multihost
@@ -71,7 +72,13 @@ def main(argv: Sequence[str] | None = None) -> int:
         skew_tolerance=cfg.aggregator.skew_tolerance,
         degraded_ttl=cfg.aggregator.degraded_ttl,
         dedup_window=cfg.aggregator.dedup_window,
+        delivery_buckets=cfg.telemetry.delivery_buckets or None,
     )
+    # self-telemetry traces (ingest/decode/merge, window cycles)
+    server.register("/debug/traces", "Traces",
+                    "recent cycle span traces (?format=json|chrome; "
+                    "chrome loads in Perfetto)",
+                    telemetry.make_traces_handler())
     services: list = [server, aggregator]
 
     if cfg.exporter.prometheus.enabled:
@@ -84,6 +91,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         registry.register(aggregator)
         from kepler_tpu.exporter.prometheus import HealthCollector
         registry.register(HealthCollector(server.health))
+        registry.register(telemetry.collector())
         # ~2× the stock renderer at 1k-node fleets in BOTH negotiated
         # formats (byte-identical; fastexpo falls back wholesale on
         # anything beyond the simple kepler families)

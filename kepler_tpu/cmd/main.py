@@ -15,7 +15,7 @@ import os
 import sys
 from typing import Sequence
 
-from kepler_tpu import fault, version
+from kepler_tpu import fault, telemetry, version
 from kepler_tpu.config import Config, parse_args_and_config
 from kepler_tpu.device.fake import FakeCPUMeter
 from kepler_tpu.device.rapl import RaplPowerMeter
@@ -105,6 +105,12 @@ def create_services(cfg: Config) -> list:
         state_max_age=cfg.monitor.state_max_age,
     )
     server = make_api_server(cfg.web.listen_addresses, cfg.web.config_file)
+    # self-telemetry: recent cycle traces (monitor refresh stages, scrape
+    # renders, agent delivery legs) as JSON or Chrome trace-event format
+    server.register("/debug/traces", "Traces",
+                    "recent cycle span traces (?format=json|chrome; "
+                    "chrome loads in Perfetto)",
+                    telemetry.make_traces_handler())
     services: list = []
     if pod_lookup is not None:
         services.append(pod_lookup)
@@ -185,6 +191,10 @@ def create_services(cfg: Config) -> list:
         )
         from kepler_tpu.exporter.prometheus import HealthCollector
         collectors.append(HealthCollector(server.health))
+        # kepler_self_* families (stage histograms, cycle overruns)
+        # scrape beside the power collectors; when telemetry is disabled
+        # the recorder simply has no samples
+        collectors.append(telemetry.collector())
         if agent is not None and cfg.agent.spool.dir:
             collectors.append(agent)  # kepler_fleet_spool_* durability plane
         services.append(PrometheusExporter(
@@ -217,6 +227,7 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     try:
         fault.install_from_config(cfg.fault)
+        telemetry.install_from_config(cfg.telemetry)
         services = create_services(cfg)
     except Exception as err:
         log.error("failed to create services: %s", err)

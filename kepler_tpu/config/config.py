@@ -251,6 +251,25 @@ class AgentConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Self-telemetry plane (``kepler_tpu.telemetry``): span tracing of
+    the monitor/exporter/fleet hot paths, ``kepler_self_*`` metrics, and
+    the ``/debug/traces`` endpoint. Disabled spans cost one global read
+    per call, so ``enabled: false`` is within measurement noise."""
+
+    enabled: bool = True
+    # complete cycle traces kept for /debug/traces, PER cycle name
+    # (newest wins; per-name rings keep a high-rate cycle like
+    # aggregator ingest from evicting the rare once-per-interval ones)
+    ring_size: int = 32
+    # kepler_self_stage_duration_seconds bucket bounds (seconds)
+    stage_buckets: list[float] = field(default_factory=list)
+    # kepler_fleet_delivery_latency_seconds bucket bounds (seconds);
+    # the default tail reaches hours because spool replays carry outages
+    delivery_buckets: list[float] = field(default_factory=list)
+
+
+@dataclass
 class DevConfig:
     fake_cpu_meter: FakeCpuMeterConfig = field(default_factory=FakeCpuMeterConfig)
 
@@ -331,6 +350,7 @@ class Config:
     agent: AgentConfig = field(default_factory=AgentConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     dev: DevConfig = field(default_factory=DevConfig)
 
     # ---- validation (reference config.go:418-509) ----
@@ -421,6 +441,22 @@ class Config:
                 errs.append(f"{name} must be >= 1")
         if self.service.restart_max < 0:
             errs.append("service.restartMax must be >= 0")
+        if self.telemetry.ring_size < 1:
+            errs.append("telemetry.ringSize must be >= 1")
+        for name, buckets in (
+                ("telemetry.stageBuckets", self.telemetry.stage_buckets),
+                ("telemetry.deliveryBuckets",
+                 self.telemetry.delivery_buckets)):
+            # [] = use the built-in defaults; an explicit list must be
+            # strictly increasing positive bounds or the histogram's
+            # cumulative rendering silently lies
+            vals = list(buckets)
+            if any(isinstance(b, bool) or not isinstance(b, (int, float))
+                   for b in vals):
+                errs.append(f"{name} must be numbers")
+            elif vals and (vals[0] <= 0
+                           or any(b >= a for b, a in zip(vals, vals[1:]))):
+                errs.append(f"{name} must be strictly increasing and > 0")
         if self.fault.enabled:
             # a typo'd chaos plan must fail at startup, not inject nothing
             try:
@@ -484,6 +520,9 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
     "fsyncInterval": "fsync_interval",
+    "ringSize": "ring_size",
+    "stageBuckets": "stage_buckets",
+    "deliveryBuckets": "delivery_buckets",
 }
 
 
@@ -626,6 +665,9 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
         choices=["einsum", "pallas"])
+    add("--telemetry.enable", dest="telemetry_enable", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="self-telemetry span tracing + kepler_self_* metrics")
 
 
 def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
@@ -676,6 +718,7 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
         cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
+    set_if(("telemetry", "enabled"), args.telemetry_enable)
     return cfg
 
 

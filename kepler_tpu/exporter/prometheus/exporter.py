@@ -14,6 +14,7 @@ from typing import Sequence
 from prometheus_client import CollectorRegistry
 from prometheus_client.exposition import CONTENT_TYPE_LATEST
 
+from kepler_tpu import telemetry
 from kepler_tpu.config.level import Level
 from kepler_tpu.exporter.prometheus.fastexpo import fast_generate_latest
 from kepler_tpu.exporter.prometheus.collector import PowerCollector
@@ -128,17 +129,24 @@ class PrometheusExporter:
             wants_openmetrics,
         )
 
-        if wants_openmetrics(request):
-            from prometheus_client.openmetrics import exposition as om_exposition
-            payload = (b"".join(c.render_text(openmetrics=True)
-                                for c in self._power)
-                       + om_exposition.generate_latest(self._aux_registry))
-            return (200,
-                    {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
-                    payload)
-        payload = (b"".join(c.render_text() for c in self._power)
-                   + fast_generate_latest(self._aux_registry))
-        return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
+        # the scrape is its own telemetry cycle: kepler_self_stage_
+        # duration_seconds{stage="exporter.scrape"} is the render cost a
+        # Prometheus server actually pays per scrape
+        with telemetry.span("exporter.scrape"):
+            if wants_openmetrics(request):
+                from prometheus_client.openmetrics import (
+                    exposition as om_exposition,
+                )
+                payload = (b"".join(c.render_text(openmetrics=True)
+                                    for c in self._power)
+                           + om_exposition.generate_latest(
+                               self._aux_registry))
+                return (200,
+                        {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
+                        payload)
+            payload = (b"".join(c.render_text() for c in self._power)
+                       + fast_generate_latest(self._aux_registry))
+            return 200, {"Content-Type": CONTENT_TYPE_LATEST}, payload
 
     @property
     def registry(self) -> CollectorRegistry:
@@ -159,11 +167,12 @@ def make_registry_handler(registry: CollectorRegistry):
     )
 
     def handler(request) -> tuple[int, dict[str, str], bytes]:
-        if wants_openmetrics(request):
-            return (200,
-                    {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
-                    fast_generate_openmetrics(registry))
-        return (200, {"Content-Type": CONTENT_TYPE_LATEST},
-                fast_generate_latest(registry))
+        with telemetry.span("exporter.scrape"):
+            if wants_openmetrics(request):
+                return (200,
+                        {"Content-Type": om_exposition.CONTENT_TYPE_LATEST},
+                        fast_generate_openmetrics(registry))
+            return (200, {"Content-Type": CONTENT_TYPE_LATEST},
+                    fast_generate_latest(registry))
 
     return handler

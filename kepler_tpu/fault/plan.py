@@ -48,6 +48,7 @@ KNOWN_SITES = (
     "disk.write_error",     # spool: an append fails cleanly (no bytes land)
     "disk.fsync_error",     # spool: fsync fails (record stays in page cache)
     "disk.torn_tail",       # spool: partial frame written, append "dies"
+    "telemetry.drop",       # telemetry: a completed cycle trace is dropped
 )
 
 

@@ -20,9 +20,16 @@ Durability (ISSUE 3): with a ``fleet.spool.Spool`` attached, every window
 is appended to the crash-safe on-disk spool before any send attempt and
 acknowledged only on a 2xx (or permanent 4xx), so agent crashes and
 outages longer than the ring replay the backlog instead of losing it —
-replayed records keep their original ``run``+``seq`` identity; only
-``sent_at`` is restamped at transmit time. The breaker/backoff machinery
-stays the sole send gate in both modes.
+replayed records keep their original ``run``+``seq`` identity; only the
+transmit-time header fields (``sent_at``, the delivery-latency
+``delivery_path``/``appended_at``) are restamped at send. The
+breaker/backoff machinery stays the sole send gate in both modes.
+
+Self-telemetry (ISSUE 4): the emit→spool-append→drain→send legs carry
+``telemetry.span`` instrumentation, and every window opens a delivery
+trace (``trace`` id + ``emitted_at`` in the wire header) that the
+aggregator closes at merge into
+``kepler_fleet_delivery_latency_seconds{path="fresh"|"replay"}``.
 """
 
 from __future__ import annotations
@@ -43,9 +50,9 @@ import urllib.parse
 import uuid
 from typing import Callable
 
-from kepler_tpu import fault
+from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.spool import Spool
-from kepler_tpu.fleet.wire import WireError, encode_report, restamp_sent_at
+from kepler_tpu.fleet.wire import WireError, encode_report, restamp_transmit
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
 from kepler_tpu.service.lifecycle import CancelContext, backoff_with_jitter
@@ -102,9 +109,13 @@ class FleetAgent:
         self._node_name = node_name or socket.gethostname()
         self._mode = mode
         self._timeout = timeout_s
-        # in-memory ring of (seq, sample): the delivery queue without a
-        # spool, the degraded fallback with one (disk write failures)
-        self._queue: collections.deque[tuple[int, WindowSample]] = \
+        # in-memory ring of (seq, sample, emitted_at, trace_id): the
+        # delivery queue without a spool, the degraded fallback with one
+        # (disk write failures). emitted_at/trace ride along because mem
+        # items serialize lazily at SEND time, but the delivery trace
+        # opens at WINDOW time.
+        self._queue: collections.deque[
+            tuple[int, WindowSample, float, str]] = \
             collections.deque(maxlen=queue_max)
         # durable delivery: when set, every window is appended to the
         # crash-safe spool before any send attempt and only acked on 2xx
@@ -119,6 +130,12 @@ class FleetAgent:
         self._clock = clock or _time.time
         self._monotonic = monotonic or _time.monotonic
         self._drop_logged: float | None = None  # monotonic of last warning
+        # wall clock of the last observed delivery disruption (failed
+        # send, or shedding while the breaker is open): a window that was
+        # emitted at or before it waited out an outage, so its eventual
+        # delivery is labeled path="replay" in the aggregator's
+        # delivery-latency histogram. None = never disrupted.
+        self._disrupted_at: float | None = None
         # retry/backoff + circuit breaker (jitter is seeded so resilience
         # tests replay the exact same schedule)
         self._backoff_initial = max(backoff_initial, 1e-3)
@@ -185,25 +202,34 @@ class FleetAgent:
         # runs inside the monitor's refresh lock: must stay cheap. The
         # window takes its seq HERE so a window lost anywhere downstream
         # (ring overflow, spool eviction, disk failure) leaves a seq gap
-        # the aggregator counts as loss. With a spool, the window is made
-        # durable before any send attempt (one buffered write; fsync is
-        # batched, never per-window by default); a disk failure degrades
-        # to the in-memory ring instead of blocking the monitor.
-        self._seq += 1
-        seq = self._seq
-        if self._spool is not None:
-            try:
-                body = self._encode(sample, seq)
-                if self._spool.append(body):
-                    self._wake.set()
-                    return
-            except Exception:
-                log.exception("spool append failed; falling back to the "
-                              "in-memory ring for this window")
-        if len(self._queue) == self._queue.maxlen:
-            self._stats["dropped_total"] += 1
-        self._queue.append((seq, sample))
-        self._wake.set()
+        # the aggregator counts as loss. It also opens its delivery
+        # trace here: a trace id + emit wall time ride the wire header
+        # so the aggregator can close the trace at merge into a true
+        # end-to-end latency. With a spool, the window is made durable
+        # before any send attempt (one buffered write; fsync is batched,
+        # never per-window by default); a disk failure degrades to the
+        # in-memory ring instead of blocking the monitor.
+        with telemetry.span("agent.emit"):
+            self._seq += 1
+            seq = self._seq
+            emitted_at = self._clock()
+            trace_id = uuid.uuid4().hex[:16]
+            if self._spool is not None:
+                try:
+                    body = self._encode(sample, seq, trace_id=trace_id,
+                                        emitted_at=emitted_at)
+                    with telemetry.span("agent.spool_append"):
+                        appended = self._spool.append(body)
+                    if appended:
+                        self._wake.set()
+                        return
+                except Exception:
+                    log.exception("spool append failed; falling back to "
+                                  "the in-memory ring for this window")
+            if len(self._queue) == self._queue.maxlen:
+                self._stats["dropped_total"] += 1
+            self._queue.append((seq, sample, emitted_at, trace_id))
+            self._wake.set()
 
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
@@ -336,11 +362,24 @@ class FleetAgent:
         one probe send — success closes the breaker, failure re-opens it
         with a doubled (capped) cooldown.
         """
+        if (self._inflight is None and not self._queue
+                and (self._spool is None
+                     or self._spool.pending_records() == 0)):
+            return  # idle wake: no work, no telemetry cycle recorded
+        with telemetry.span("agent.drain"):
+            self._drain_pending(ctx)
+
+    def _drain_pending(self, ctx: CancelContext | None) -> None:
         while not (ctx is not None and ctx.cancelled()):
             now = self._monotonic()
             if (self._breaker_state == BREAKER_OPEN
                     and now < self._breaker_open_until):
-                return  # shedding: backlog stays in the spool/ring
+                # shedding: backlog stays in the spool/ring. The outage
+                # is still ongoing — keep the disruption watermark
+                # current so windows emitted DURING the open window are
+                # labeled replays when they finally deliver.
+                self._disrupted_at = self._clock()
+                return
             item = self._inflight
             if item is None:
                 # an elapsed-cooldown breaker stays OPEN until a sample
@@ -399,8 +438,8 @@ class FleetAgent:
             if rec is not None:
                 return ("spool", rec)
         if self._queue:
-            seq, sample = self._queue.popleft()
-            return ("mem", seq, sample)
+            seq, sample, emitted_at, trace_id = self._queue.popleft()
+            return ("mem", seq, sample, emitted_at, trace_id)
         return None
 
     def _finish_item(self, item: tuple) -> None:
@@ -423,6 +462,9 @@ class FleetAgent:
     def _on_send_failure(self, err: Exception) -> None:
         self._stats["send_failures"] += 1
         self._consecutive_failures += 1
+        # windows emitted at or before this instant waited through a
+        # delivery disruption — their eventual sends are replays
+        self._disrupted_at = self._clock()
         self._log_drop(err)
         half_open = self._breaker_state == BREAKER_HALF_OPEN
         if (half_open
@@ -476,10 +518,14 @@ class FleetAgent:
             except OSError:
                 pass
 
-    def _encode(self, sample: WindowSample, seq: int) -> bytes:
+    def _encode(self, sample: WindowSample, seq: int,
+                trace_id: str = "", emitted_at: float | None = None
+                ) -> bytes:
         """Wire bytes for one window — WITHOUT ``sent_at``, which is a
         transmit-time property stamped by :meth:`_post` (a spooled record
-        may be sent long after it was encoded)."""
+        may be sent long after it was encoded). ``trace_id``/
+        ``emitted_at`` are WINDOW-time properties: the delivery trace
+        opens when the window is emitted, not when it is serialized."""
         batch = sample.batch
         report = NodeReport(
             node_name=self._node_name,
@@ -494,13 +540,34 @@ class FleetAgent:
             workload_kinds=batch.kinds,
         )
         return encode_report(report, list(sample.zone_names), seq=seq,
-                             run=self._run_nonce)
+                             run=self._run_nonce, trace_id=trace_id,
+                             emitted_at=emitted_at)
+
+    def _delivery_path(self, origin_wall: float, recovered: bool) -> str:
+        """Label for the delivery-latency histogram: a crash-backlog
+        record (``recovered``) or a window that waited through a send
+        disruption is a replay; everything else is a fresh send."""
+        if recovered:
+            return "replay"
+        if self._disrupted_at is not None \
+                and origin_wall <= self._disrupted_at:
+            return "replay"
+        return "fresh"
 
     def _send_item(self, item: tuple) -> None:
         if item[0] == "spool":
-            self._post(item[1].payload)
+            rec = item[1]
+            path = self._delivery_path(rec.appended_at, rec.recovered)
+            with telemetry.span("agent.send"):
+                self._post(rec.payload, path=path,
+                           appended_at=rec.appended_at)
         else:
-            self._post(self._encode(item[2], item[1]))
+            _tag, seq, sample, emitted_at, trace_id = item
+            path = self._delivery_path(emitted_at, False)
+            with telemetry.span("agent.send"):
+                self._post(self._encode(sample, seq, trace_id=trace_id,
+                                        emitted_at=emitted_at),
+                           path=path)
 
     def _send(self, sample: WindowSample, seq: int | None = None) -> None:
         """Encode + POST one sample (direct-send path used by tests and
@@ -508,9 +575,12 @@ class FleetAgent:
         if seq is None:
             self._seq += 1
             seq = self._seq
-        self._post(self._encode(sample, seq))
+        self._post(self._encode(sample, seq,
+                                trace_id=uuid.uuid4().hex[:16],
+                                emitted_at=self._clock()))
 
-    def _post(self, body: bytes) -> None:
+    def _post(self, body: bytes, path: str = "fresh",
+              appended_at: float | None = None) -> None:
         spec = fault.fire("net.refuse")
         if spec is not None:
             self._close_conn()
@@ -523,7 +593,8 @@ class FleetAgent:
         if spec is not None:
             sent_at += spec.arg if spec.arg is not None else 300.0
         try:
-            body = restamp_sent_at(body, sent_at)
+            body = restamp_transmit(body, sent_at, delivery_path=path,
+                                    appended_at=appended_at)
         except WireError as err:
             # a spooled record that no longer parses (disk corruption the
             # CRC missed, or a format change across restart) can never be

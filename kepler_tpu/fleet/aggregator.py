@@ -26,12 +26,14 @@ import logging
 import threading
 import time as _time
 from dataclasses import dataclass
-from typing import Mapping
+from typing import Mapping, Sequence
 
 import numpy as np
 
+from kepler_tpu import telemetry
 from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
 from kepler_tpu.monitor.history import HistoryBuffer
+from kepler_tpu.telemetry import DEFAULT_DELIVERY_BUCKETS, Histogram
 from kepler_tpu.parallel.aggregator_core import (
     FleetResult,
     make_fleet_program,
@@ -210,6 +212,7 @@ class Aggregator:
         skew_tolerance: float = 120.0,
         degraded_ttl: float = 60.0,
         dedup_window: int = 1024,
+        delivery_buckets: Sequence[float] | None = None,
         clock=None,
         mesh=None,
     ) -> None:
@@ -275,6 +278,14 @@ class Aggregator:
         # instead (least-recently-observed evicted at the cap), like the
         # cumulative loss table.
         self._dedup_window = max(1, dedup_window)
+        # end-to-end delivery latency: the agent stamps a trace id +
+        # emitted_at at window emit; the accepted (non-duplicate) ingest
+        # closes the trace here. Replays measure from the spool's
+        # original appended_at under their own label so outage backlogs
+        # never pollute the fresh-delivery signal.
+        self._delivery_hist: dict[str, Histogram] = {  # keplint: guarded-by=_lock
+            path: Histogram(delivery_buckets or DEFAULT_DELIVERY_BUCKETS)
+            for path in ("fresh", "replay")}
         self._seq_trackers: dict[str, _SeqTracker] = {}  # keplint: guarded-by=_lock
         self._tracker_cap = 512
         self._lost_by_node: dict[str, int] = {}  # keplint: guarded-by=_lock
@@ -362,10 +373,18 @@ class Aggregator:
     # -- ingest ------------------------------------------------------------
 
     def _handle_report(self, request) -> tuple[int, dict[str, str], bytes]:
+        # one telemetry cycle per ingest POST, with the decode and merge
+        # legs as stages — the receive half of the delivery trace the
+        # agent opened at window emit
+        with telemetry.span("aggregator.ingest"):
+            return self._ingest_report(request)
+
+    def _ingest_report(self, request) -> tuple[int, dict[str, str], bytes]:
         if request.command != "POST":
             return 405, {"Content-Type": "text/plain"}, b"POST only\n"
         try:
-            report, header = decode_report(request.body)
+            with telemetry.span("aggregator.decode"):
+                report, header = decode_report(request.body)
         except (WireError, ValueError) as err:
             # quarantine, charged to the sender when the header survives.
             # The header re-parse runs OFF the store lock — a burst of
@@ -419,7 +438,7 @@ class Aggregator:
                          received=received,
                          seq=seq_raw,
                          run=run_raw)
-        with self._lock:
+        with telemetry.span("aggregator.merge"), self._lock:
             prev = self._reports.get(report.node_name)
             # When BOTH sides carry a run nonce the cases are unambiguous:
             # different nonce = fresh agent process (restart), same nonce +
@@ -520,8 +539,44 @@ class Aggregator:
                         and (prev is None or restarted
                              or stored.seq != prev.seq)):
                     self._push_history(report)
+            self._observe_delivery_locked(report.node_name, header,
+                                          received)
             self._stats["reports_total"] += 1
         return 204, {}, b""
+
+    # keplint: requires-lock=_lock
+    def _observe_delivery_locked(self, node: str, header: Mapping,
+                                 received: float) -> None:
+        """Close the window's delivery trace: observe emit→ingest latency
+        into ``kepler_fleet_delivery_latency_seconds``.
+
+        Runs only for ACCEPTED reports (duplicates were already measured
+        when their first copy arrived; quarantined reports never merged).
+        Fresh sends measure from the agent's ``emitted_at``; spool
+        replays from the ORIGINAL ``appended_at``, under ``path=replay``.
+        All header fields are untrusted: non-numeric stamps mean no
+        observation, and the path label is clamped to the two known
+        values so hostile input can't mint series."""
+        def _num(v) -> float | None:
+            return (float(v) if isinstance(v, (int, float))
+                    and not isinstance(v, bool) else None)
+
+        emitted = _num(header.get("emitted_at"))
+        if emitted is None:
+            return  # pre-telemetry agent: no trace to close
+        path = ("replay" if header.get("delivery_path") == "replay"
+                else "fresh")
+        basis = emitted
+        if path == "replay":
+            appended = _num(header.get("appended_at"))
+            if appended is not None:
+                basis = appended
+        latency = max(0.0, received - basis)
+        self._delivery_hist[path].observe(latency)
+        trace = header.get("trace")
+        if trace:
+            log.debug("delivery trace %s closed: node=%s path=%s "
+                      "latency=%.3fs", trace, node, path, latency)
 
     def _push_history(self, report: NodeReport) -> None:
         """Advance the node's feature-history window (temporal mode).
@@ -624,6 +679,14 @@ class Aggregator:
                 del self._degraded[name]
         if not live:
             return None
+        # one telemetry cycle per non-empty fleet window, with the
+        # assembly/device/scatter legs as stages (the same legs the
+        # last_*_ms stats report — the histograms add distribution)
+        with telemetry.span("aggregator.window"):
+            return self._attribute_window(live, now, t_win)
+
+    def _attribute_window(self, live: dict, now: float,
+                          t_win: float) -> FleetResult:
         # canonical zone axis = sorted union of reported zone names; nodes
         # missing a zone keep their row with that zone masked invalid.
         # Alignment is GROUPED: nodes sharing a zone tuple (in practice the
@@ -1026,6 +1089,20 @@ class Aggregator:
         yield duplicates
         with self._lock:
             lost_by_node = dict(self._lost_by_node)
+            delivery_snap = [
+                (path, h.cumulative(), h.sum)
+                for path, h in sorted(self._delivery_hist.items())]
+        from prometheus_client.core import HistogramMetricFamily
+        delivery = HistogramMetricFamily(
+            "kepler_fleet_delivery_latency_seconds",
+            "End-to-end window delivery latency, agent emit → aggregator "
+            "merge (fresh sends from emitted_at; spool replays from the "
+            "original appended_at)",
+            labels=["path"])
+        for path, buckets, total_sum in delivery_snap:
+            delivery.add_metric([path], buckets=buckets,
+                                sum_value=total_sum)
+        yield delivery
         lost = CounterMetricFamily(
             "kepler_fleet_windows_lost_total",
             "Windows that never arrived (seq gaps), by reporting node",

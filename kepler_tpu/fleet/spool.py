@@ -85,6 +85,11 @@ class SpoolRecord:
     appended_at: float  # agent wall clock at append (clock seam)
     segment: int
     offset: int  # frame start within the segment
+    # appended by a PREVIOUS process (crash backlog found at open): the
+    # structural "this send is a replay" signal for the delivery-latency
+    # path label — wall-clock comparisons can't distinguish a crash
+    # backlog from a fresh window under a frozen test clock
+    recovered: bool = False
 
 
 def _seg_name(index: int) -> str:
@@ -180,6 +185,9 @@ class Spool:
         self._active = last
         self._active_records = count
         self._active_bytes = size
+        # records below this (segment, offset) watermark were appended by
+        # a previous process → their delivery is a replay by construction
+        self._open_tail = (last, size)
         self._write_fh = open(self._seg_path(last), "ab")
         # clamp a cursor pointing at an evicted/older segment or past a
         # truncated tail back onto real data
@@ -511,7 +519,8 @@ class Spool:
             self._pending_records = self._count_pending()
             return None
         return SpoolRecord(payload=payload, appended_at=ts,
-                           segment=seg, offset=offset)
+                           segment=seg, offset=offset,
+                           recovered=(seg, offset) < self._open_tail)
 
     def ack(self, rec: SpoolRecord | None = None) -> None:
         """Advance the cursor past ``rec`` (the record whose delivery

@@ -34,11 +34,18 @@ _DTYPES = {"float32": np.float32, "float64": np.float64,
 
 def encode_report(report: NodeReport, zone_names: list[str],
                   seq: int = 0, run: str = "",
-                  sent_at: float | None = None) -> bytes:
+                  sent_at: float | None = None,
+                  trace_id: str = "",
+                  emitted_at: float | None = None) -> bytes:
     """Serialize one node's window for the POST /v1/report body.
 
     ``sent_at`` (agent wall clock, seconds) lets the aggregator detect
-    clock-skewed senders; omitted for pre-skew-check agents."""
+    clock-skewed senders; omitted for pre-skew-check agents.
+    ``trace_id``/``emitted_at`` open the per-window delivery trace: the
+    agent stamps both at WINDOW time (emit), the aggregator closes the
+    trace at merge and observes ``received - emitted_at`` into
+    ``kepler_fleet_delivery_latency_seconds``. Omitted by pre-telemetry
+    agents — the aggregator then simply records no observation."""
     arrays: list[tuple[str, np.ndarray]] = [
         ("zone_deltas_uj", np.ascontiguousarray(
             report.zone_deltas_uj, np.float32)),
@@ -69,6 +76,10 @@ def encode_report(report: NodeReport, zone_names: list[str],
     }
     if sent_at is not None:
         header["sent_at"] = float(sent_at)
+    if trace_id:
+        header["trace"] = str(trace_id)
+    if emitted_at is not None:
+        header["emitted_at"] = float(emitted_at)
     header_bytes = json.dumps(header, separators=(",", ":")).encode()
     parts = [MAGIC, _HEADER_LEN.pack(len(header_bytes)), header_bytes]
     parts += [a.tobytes() for _, a in arrays]
@@ -79,16 +90,25 @@ class WireError(ValueError):
     pass
 
 
-def restamp_sent_at(data: bytes, sent_at: float) -> bytes:
-    """Rewrite a report payload's ``sent_at`` header field in place.
+def restamp_transmit(data: bytes, sent_at: float,
+                     delivery_path: str | None = None,
+                     appended_at: float | None = None) -> bytes:
+    """Rewrite a report payload's transmit-time header fields in place.
 
     Spooled records (``fleet.spool``) keep their original ``run``/``seq``
     identity but must carry a TRANSMIT-time ``sent_at``: the aggregator's
     clock-skew quarantine compares ``sent_at`` against its receive time,
     so a backlog replayed hours after the window was measured would look
-    like a skewed sender if the append-time stamp rode along. Only the
-    JSON header is re-serialized — array bytes pass through untouched.
-    Raises :class:`WireError` on a payload it cannot parse."""
+    like a skewed sender if the append-time stamp rode along.
+
+    ``delivery_path`` ("fresh"/"replay") and ``appended_at`` (the spool's
+    original append stamp) are transmit-time properties too — the agent
+    only knows at send time whether a window waited out an outage, and
+    the aggregator's delivery-latency histogram measures replays from the
+    ORIGINAL append time under the ``path="replay"`` label.
+
+    Only the JSON header is re-serialized — array bytes pass through
+    untouched. Raises :class:`WireError` on a payload it cannot parse."""
     if len(data) < len(MAGIC) + _HEADER_LEN.size or \
             data[: len(MAGIC)] != MAGIC:
         raise WireError("bad magic")
@@ -104,9 +124,19 @@ def restamp_sent_at(data: bytes, sent_at: float) -> bytes:
     if not isinstance(header, dict):
         raise WireError("header is not a mapping")
     header["sent_at"] = float(sent_at)
+    if delivery_path is not None:
+        header["delivery_path"] = str(delivery_path)
+    if appended_at is not None:
+        header["appended_at"] = float(appended_at)
     header_bytes = json.dumps(header, separators=(",", ":")).encode()
     return b"".join([MAGIC, _HEADER_LEN.pack(len(header_bytes)),
                      header_bytes, data[off + hlen:]])
+
+
+def restamp_sent_at(data: bytes, sent_at: float) -> bytes:
+    """Back-compat alias: rewrite only ``sent_at`` (see
+    :func:`restamp_transmit`)."""
+    return restamp_transmit(data, sent_at)
 
 
 def peek_node_name(data: bytes) -> str | None:

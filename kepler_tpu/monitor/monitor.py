@@ -29,7 +29,7 @@ from typing import Callable, Mapping, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from kepler_tpu import fault
+from kepler_tpu import fault, telemetry
 from kepler_tpu.device.meter import CPUPowerMeter, EnergyZone
 from kepler_tpu.monitor.snapshot import NodeUsage, Snapshot, WorkloadTable
 from kepler_tpu.monitor.terminated import TerminatedTracker
@@ -291,69 +291,85 @@ class PowerMonitor:
     # keplint: hot-loop
     # keplint: requires-lock=_snapshot_lock
     def _refresh_locked(self) -> None:
-        start = _time.perf_counter()
+        # the whole refresh is one telemetry CYCLE; the stage spans below
+        # feed kepler_self_stage_duration_seconds and the /debug/traces
+        # ring, and exceeding one interval counts a cycle overrun. Cycle
+        # timing has ONE source of truth now — the span sink (which also
+        # emits the "monitor.refresh done in …" debug log the old inline
+        # perf_counter line used to).
+        budget = self._interval if self._interval > 0 else None
+        with telemetry.span("monitor.refresh", budget_s=budget):
+            self._refresh_staged()
+
+    # keplint: hot-loop
+    # keplint: requires-lock=_snapshot_lock
+    def _refresh_staged(self) -> None:
         now = self._clock()
         mono = self._monotonic()
         dt = (mono - self._last_read_ts
               if self._last_read_ts is not None else 0.0)
         self._last_read_ts = mono
 
-        zone_deltas, zone_valid = self._read_zone_deltas()
-        self._resources.refresh()
-        batch = self._resources.feature_batch()
+        with telemetry.span("monitor.device_read"):
+            zone_deltas, zone_valid = self._read_zone_deltas()
+        with telemetry.span("monitor.resource_scan"):
+            self._resources.refresh()
+            batch = self._resources.feature_batch()
 
-        w = batch.cpu_deltas.shape[0]
-        padded_w = pad_to_bucket(w, self._bucket)
-        cpu = np.zeros(padded_w, np.float32)
-        cpu[:w] = batch.cpu_deltas
-        valid = np.zeros(padded_w, bool)
-        valid[:w] = True
+        with telemetry.span("monitor.attribute"):
+            w = batch.cpu_deltas.shape[0]
+            padded_w = pad_to_bucket(w, self._bucket)
+            cpu = np.zeros(padded_w, np.float32)
+            cpu[:w] = batch.cpu_deltas
+            valid = np.zeros(padded_w, bool)
+            valid[:w] = True
 
-        result = attribute(
-            jnp.asarray(zone_deltas, jnp.float32),
-            jnp.asarray(zone_valid),
-            jnp.float32(batch.usage_ratio),
-            jnp.asarray(cpu),
-            jnp.asarray(valid),
-            jnp.float32(batch.node_cpu_delta),
-            jnp.float32(max(dt, 0.0)),
-        )
-
-        node = self._accumulate_node(result, batch.usage_ratio)
-        tables = self._accumulate_workloads(batch, result, w)
-        self._handle_terminated(tables)
-
-        self._snapshot = Snapshot(
-            timestamp=now,
-            node=node,
-            terminated_processes=self._trackers["processes"].items(),
-            terminated_containers=self._trackers["containers"].items(),
-            terminated_virtual_machines=self._trackers[
-                "virtual_machines"].items(),
-            terminated_pods=self._trackers["pods"].items(),
-            **tables,
-        )
-        self._data_event.set()
-        if self._window_listeners:
-            sample = WindowSample(
-                timestamp=now, dt_s=max(dt, 0.0),
-                zone_names=self._zone_names,
-                zone_deltas_uj=zone_deltas, zone_valid=zone_valid,
-                usage_ratio=batch.usage_ratio, batch=batch,
+            result = attribute(
+                jnp.asarray(zone_deltas, jnp.float32),
+                jnp.asarray(zone_valid),
+                jnp.float32(batch.usage_ratio),
+                jnp.asarray(cpu),
+                jnp.asarray(valid),
+                jnp.float32(batch.node_cpu_delta),
+                jnp.float32(max(dt, 0.0)),
             )
-            for listener in self._window_listeners:
-                try:
-                    listener(sample)
-                except Exception:
-                    log.exception("window listener failed")
+
+            node = self._accumulate_node(result, batch.usage_ratio)
+            tables = self._accumulate_workloads(batch, result, w)
+            self._handle_terminated(tables)
+
+        with telemetry.span("monitor.publish"):
+            self._snapshot = Snapshot(
+                timestamp=now,
+                node=node,
+                terminated_processes=self._trackers["processes"].items(),
+                terminated_containers=self._trackers["containers"].items(),
+                terminated_virtual_machines=self._trackers[
+                    "virtual_machines"].items(),
+                terminated_pods=self._trackers["pods"].items(),
+                **tables,
+            )
+            self._data_event.set()
+            if self._window_listeners:
+                sample = WindowSample(
+                    timestamp=now, dt_s=max(dt, 0.0),
+                    zone_names=self._zone_names,
+                    zone_deltas_uj=zone_deltas, zone_valid=zone_valid,
+                    usage_ratio=batch.usage_ratio, batch=batch,
+                )
+                for listener in self._window_listeners:
+                    try:
+                        listener(sample)
+                    except Exception:
+                        log.exception("window listener failed")
         self._maybe_prewarm_next_bucket(w, padded_w)
         if self._state_path:
-            self._persist_state(now)
+            with telemetry.span("monitor.persist"):
+                self._persist_state(now)
         self._last_refresh_done = self._monotonic()
         if self._stalled:
             log.info("refresh loop recovered; clearing stall flag")
             self._stalled = False
-        log.debug("refresh done in %.2f ms", (_time.perf_counter() - start) * 1e3)
 
     def _maybe_prewarm_next_bucket(self, w: int, padded_w: int) -> None:
         """When the workload count nears its bucket, compile the next

@@ -23,6 +23,7 @@ import logging
 import time as _time
 from typing import Callable
 
+from kepler_tpu import telemetry
 from kepler_tpu.monitor.monitor import PowerMonitor
 from kepler_tpu.service.lifecycle import CancelContext
 
@@ -50,6 +51,12 @@ class MonitorWatchdog:
         self._monotonic = monotonic or _time.monotonic
         self._started_at: float | None = None
         self._stall_count = 0
+        # where the wedged refresh is stuck: the innermost open span of
+        # the in-flight monitor.refresh cycle, snapshotted from the
+        # telemetry plane when the stall is detected ("" when telemetry
+        # is disabled or no refresh is in flight)
+        self._stuck_stage = ""
+        self._stall_spans: list[dict] = []
 
     def name(self) -> str:
         return "monitor-watchdog"
@@ -85,19 +92,43 @@ class MonitorWatchdog:
         if stalled:
             stalled = self._age() > self._stall_after  # double-check
         if stalled:
+            # snapshot the in-flight trace so the report names WHERE the
+            # refresh is wedged, not just that it is (re-read every
+            # check: the stall may progress into a deeper stage)
+            self._stall_spans = self._inflight_refresh_spans()
+            self._stuck_stage = (self._stall_spans[-1]["name"]
+                                 if self._stall_spans else "")
             if not self._monitor.stalled:
                 self._stall_count += 1
                 log.error("monitor refresh loop stalled: last refresh "
                           "%.1fs ago (threshold %.1fs); marking snapshot "
-                          "stale", self._age(), self._stall_after)
+                          "stale%s", self._age(), self._stall_after,
+                          f" (stuck in {self._stuck_stage})"
+                          if self._stuck_stage else "")
             self._monitor.mark_stalled(True)
         return stalled
+
+    @staticmethod
+    def _inflight_refresh_spans() -> list[dict]:
+        """Open spans of the in-flight monitor.refresh cycle (outermost
+        first), [] when none / telemetry disabled."""
+        for entry in telemetry.inflight():
+            spans = entry.get("spans", [])
+            if spans and spans[0]["name"] == "monitor.refresh":
+                return spans
+        return []
 
     def health(self) -> dict:
         """Probe for /healthz (degraded while the loop is stalled)."""
         out: dict = {"ok": not self._monitor.stalled,
                      "stalled": self._monitor.stalled,
                      "stalls_total": self._stall_count}
+        if self._monitor.stalled and self._stuck_stage:
+            out["stuck_stage"] = self._stuck_stage
+            out["inflight_spans"] = [
+                {"name": s["name"],
+                 "elapsed_s": round(s["elapsed_s"], 3)}
+                for s in self._stall_spans]
         age = self._monitor.last_refresh_age()
         if age is not None:
             out["last_refresh_age_s"] = round(age, 3)

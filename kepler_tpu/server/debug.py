@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import collections
 import io
+import math
 import sys
 import tempfile
 import threading
@@ -51,9 +52,20 @@ class DebugService:
             return self._stacks()
         if which == "profile":
             qs = parse_qs(url.query)
-            seconds = float(qs.get("seconds", ["5"])[0])
-            hz = float(qs.get("hz", ["100"])[0])
-            return self._profile(min(seconds, 60.0), min(max(hz, 1.0), 1000.0))
+            # query values come off the wire: a non-numeric (or NaN/inf)
+            # seconds/hz must be a 400, never a traceback into the
+            # server's generic 500 handler
+            try:
+                seconds = float(qs.get("seconds", ["5"])[0])
+                hz = float(qs.get("hz", ["100"])[0])
+            except ValueError:
+                return (400, {"Content-Type": "text/plain"},
+                        b"seconds/hz must be numeric\n")
+            if not (math.isfinite(seconds) and math.isfinite(hz)):
+                return (400, {"Content-Type": "text/plain"},
+                        b"seconds/hz must be finite\n")
+            return self._profile(min(max(seconds, 0.0), 60.0),
+                                 min(max(hz, 1.0), 1000.0))
         if which == "jax":
             return self._jax_trace()
         body = (

@@ -124,7 +124,12 @@ def init_services(services: Sequence[Service]) -> None:
             continue
         try:
             log.debug("initializing service", extra={"service": svc.name()})
-            init()
+            # one telemetry cycle per service init: slow startups (XLA
+            # warmup, spool recovery scans) become visible stages in
+            # /debug/traces instead of an opaque boot delay
+            from kepler_tpu import telemetry
+            with telemetry.span(f"service.init.{svc.name()}"):
+                init()
             initialized.append(svc)
         except Exception as err:
             log.error("initialization failed for %s: %s", svc.name(), err)

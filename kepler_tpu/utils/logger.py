@@ -22,13 +22,22 @@ _LEVELS = {
 
 
 class JSONFormatter(logging.Formatter):
+    """RFC3339 UTC timestamps with millisecond precision, plus the
+    thread name — a JSON log line must be correlatable with the
+    telemetry plane's traces (/debug/traces anchors are wall-clock) and
+    with logs from other nodes, which second-granularity localtime with
+    no offset made impossible."""
+
     def format(self, record: logging.LogRecord) -> str:
         payload = {
-            "time": time.strftime(
-                "%Y-%m-%dT%H:%M:%S", time.localtime(record.created)
+            "time": "%s.%03dZ" % (
+                time.strftime("%Y-%m-%dT%H:%M:%S",
+                              time.gmtime(record.created)),
+                int(record.msecs),
             ),
             "level": record.levelname,
             "logger": record.name,
+            "thread": record.threadName,
             "msg": record.getMessage(),
         }
         if record.exc_info:

@@ -342,6 +342,16 @@ FIELD_MATRIX = [
               ["--aggregator.training-dump-max-files", "6"], 6),
     FieldCase("aggregator.node_mode", "aggregator: {nodeMode: model}",
               "model", ["--aggregator.node-mode", "ratio"], "ratio"),
+    # self-telemetry (ISSUE 4): the enable switch has a flag; bucket
+    # bounds and the ring size are YAML-only tuning knobs
+    FieldCase("telemetry.enabled", "telemetry: {enabled: false}", False,
+              ["--telemetry.enable"], True),
+    FieldCase("telemetry.ring_size", "telemetry: {ringSize: 8}", 8),
+    FieldCase("telemetry.stage_buckets",
+              "telemetry: {stageBuckets: [0.001, 0.01]}", [0.001, 0.01]),
+    FieldCase("telemetry.delivery_buckets",
+              "telemetry: {deliveryBuckets: [1, 60, 3600]}",
+              [1, 60, 3600]),
     # resilience knobs (ISSUE 1): YAML-only — chaos/backoff tuning is a
     # config-file decision, never a stray CLI argument
     FieldCase("monitor.stall_after", "monitor: {stallAfter: 20s}", 20.0),
@@ -483,6 +493,9 @@ class TestYAMLSpellings:
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
         "fsyncInterval": ("agent", "spool"),
+        "ringSize": "telemetry",
+        "stageBuckets": "telemetry",
+        "deliveryBuckets": "telemetry",
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -527,6 +540,9 @@ class TestYAMLSpellings:
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),
         "fsyncInterval": ("500ms", 0.5),
+        "ringSize": ("16", 16),
+        "stageBuckets": ("[0.001, 0.1]", [0.001, 0.1]),
+        "deliveryBuckets": ("[1, 60]", [1, 60]),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))

@@ -1,0 +1,101 @@
+"""server/debug.py profile endpoints (ISSUE 4 satellite): query
+clamping, /debug/pprof/stack smoke, and non-numeric query values
+returning 400 instead of a 500 traceback."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kepler_tpu.server.debug import DebugService
+from kepler_tpu.server.http import APIServer
+
+
+class _Req:
+    def __init__(self, path):
+        self.path = path
+
+
+@pytest.fixture()
+def service():
+    svc = DebugService(APIServer(listen_addresses=["127.0.0.1:0"]))
+    return svc
+
+
+class TestStack:
+    def test_stack_smoke_lists_every_thread(self, service):
+        status, headers, body = service._handle(
+            _Req("/debug/pprof/stack"))
+        assert status == 200
+        assert headers["Content-Type"] == "text/plain"
+        text = body.decode()
+        # at least the handler's own thread, with a real frame under it
+        assert f"thread {threading.current_thread().name}" in text
+        assert "test_debug_endpoints.py" in text
+
+    def test_index_lists_profiles(self, service):
+        status, _, body = service._handle(_Req("/debug/pprof/"))
+        assert status == 200
+        for link in (b"stack", b"profile", b"jax"):
+            assert link in body
+
+
+class TestProfileQueryValidation:
+    @pytest.mark.parametrize("query", [
+        "seconds=abc", "hz=abc", "seconds=1e",
+        "seconds=0.01&hz=zap",
+    ])
+    def test_non_numeric_is_400_not_500(self, service, query):
+        status, headers, body = service._handle(
+            _Req(f"/debug/pprof/profile?{query}"))
+        assert status == 400
+        assert b"numeric" in body
+        assert headers["Content-Type"] == "text/plain"
+
+    @pytest.mark.parametrize("query", [
+        "seconds=nan", "seconds=inf", "hz=nan", "hz=-inf",
+    ])
+    def test_non_finite_is_400(self, service, query):
+        status, _, body = service._handle(
+            _Req(f"/debug/pprof/profile?{query}"))
+        assert status == 400
+        assert b"finite" in body
+
+    def test_profile_smoke_with_tiny_window(self, service):
+        status, _, body = service._handle(
+            _Req("/debug/pprof/profile?seconds=0.01&hz=200"))
+        assert status == 200
+        assert b"sampling profile" in body
+
+    def test_seconds_clamped_to_sixty(self, service, monkeypatch):
+        seen = {}
+
+        def fake_profile(seconds, hz):
+            seen["seconds"], seen["hz"] = seconds, hz
+            return 200, {}, b""
+
+        monkeypatch.setattr(service, "_profile", fake_profile)
+        service._handle(_Req("/debug/pprof/profile?seconds=9999&hz=50"))
+        assert seen == {"seconds": 60.0, "hz": 50.0}
+
+    def test_negative_seconds_clamped_to_zero(self, service, monkeypatch):
+        seen = {}
+        monkeypatch.setattr(
+            service, "_profile",
+            lambda s, hz: seen.update(s=s, hz=hz) or (200, {}, b""))
+        service._handle(_Req("/debug/pprof/profile?seconds=-5"))
+        assert seen["s"] == 0.0
+
+    @pytest.mark.parametrize("hz,expected", [
+        ("0.1", 1.0), ("-3", 1.0), ("99999", 1000.0), ("250", 250.0),
+    ])
+    def test_hz_clamped_into_range(self, service, monkeypatch, hz,
+                                   expected):
+        seen = {}
+        monkeypatch.setattr(
+            service, "_profile",
+            lambda s, h: seen.update(h=h) or (200, {}, b""))
+        service._handle(
+            _Req(f"/debug/pprof/profile?seconds=0.01&hz={hz}"))
+        assert seen["h"] == expected

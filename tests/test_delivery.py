@@ -358,6 +358,10 @@ class TestDurableDeliveryEndToEnd:
         assert agg._stats["windows_lost_total"] == 0
         assert agg._stats["duplicates_total"] == 0
         assert agg._stats["reports_total"] == 12  # exactly once each
+        # every window waited out the outage → the delivery-latency
+        # histogram observed all 12 under path="replay", none fresh
+        assert agg._delivery_hist["replay"].count == 12
+        assert agg._delivery_hist["fresh"].count == 0
         agent._close_conn()
         spool.close()
 
@@ -582,6 +586,161 @@ while True:
         # the parent's SIGKILL can never race the first append
         sys.stdout.write("ready\n"); sys.stdout.flush()
 """
+
+
+class TestDeliveryLatencyTelemetry:
+    """ISSUE 4: the outage→recovery E2E observes
+    kepler_fleet_delivery_latency_seconds for BOTH fresh and replayed
+    windows — replays measured from the original appended_at and
+    labeled path="replay" so outage backlogs never pollute the
+    fresh-delivery signal."""
+
+    def _emit(self, monitor, n, start=0):
+        for i in range(n):
+            monitor.emit(make_sample(ts=100.0 + start + i))
+
+    def test_outage_recovery_observes_fresh_and_replay(self, server,
+                                                       tmp_path):
+        now = [1000.0]
+        clock = lambda: now[0]  # noqa: E731 — shared frozen clock
+        agg = make_agg(server, stale_after=1e9, clock=clock)
+        monitor = FakeMeterMonitor()
+        spool = Spool(str(tmp_path / "sp"), clock=clock)
+        agent = make_agent(server, monitor, spool=spool, clock=clock,
+                           breaker_threshold=2, breaker_cooldown=0.01)
+        ctx = CancelContext()
+        # steady state: two windows deliver fresh, ~0 latency
+        self._emit(monitor, 2)
+        agent._drain(ctx)
+        assert agg._delivery_hist["fresh"].count == 2
+        assert agg._delivery_hist["fresh"].sum == 0.0
+        assert agg._delivery_hist["replay"].count == 0
+        # outage: 3 windows spool while sends fail and the breaker opens
+        with fault.installed(FaultPlan([FaultSpec("net.refuse",
+                                                  count=2)])):
+            self._emit(monitor, 3, start=10)
+            agent._drain(ctx)
+            assert agent._breaker_state == BREAKER_OPEN
+        # recovery 120 s later (agent wall time): the backlog replays,
+        # measured from the ORIGINAL append time
+        now[0] += 120.0
+        time.sleep(0.02)  # real-time breaker cooldown elapses
+        agent._drain(ctx)
+        assert spool.pending_records() == 0
+        replay = agg._delivery_hist["replay"]
+        assert replay.count == 3
+        assert replay.sum == pytest.approx(3 * 120.0)
+        # post-recovery windows are fresh again
+        now[0] += 10.0
+        self._emit(monitor, 2, start=20)
+        agent._drain(ctx)
+        fresh = agg._delivery_hist["fresh"]
+        assert fresh.count == 4
+        assert fresh.sum == 0.0
+        assert agg._stats["windows_lost_total"] == 0
+        # the histogram is exported with both path labels
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import generate_latest
+        registry = CollectorRegistry()
+        registry.register(agg)
+        text = generate_latest(registry).decode()
+        assert ('kepler_fleet_delivery_latency_seconds_count{'
+                'path="fresh"} 4.0') in text
+        assert ('kepler_fleet_delivery_latency_seconds_count{'
+                'path="replay"} 3.0') in text
+        assert ('kepler_fleet_delivery_latency_seconds_bucket{'
+                'le="300.0",path="replay"} 3.0') in text
+        agent._close_conn()
+        spool.close()
+
+    def test_crash_backlog_replays_with_replay_label(self, server,
+                                                     tmp_path):
+        # records recovered from a PREVIOUS process's spool are replays
+        # by construction (structural flag), even with no send failure
+        # in the new run and a frozen clock
+        now = [2000.0]
+        clock = lambda: now[0]  # noqa: E731
+        agg = make_agg(server, stale_after=1e9, clock=clock)
+        d = str(tmp_path / "sp")
+        monitor = FakeMeterMonitor()
+        spool = Spool(d, clock=clock)
+        agent = make_agent(server, monitor, spool=spool, clock=clock)
+        self._emit(monitor, 3)  # never drained: agent "crashes"
+        spool.close()
+        now[0] += 300.0  # the node was down five minutes
+        spool2 = Spool(d, clock=clock)
+        rec = spool2.peek()
+        assert rec is not None and rec.recovered
+        monitor2 = FakeMeterMonitor()
+        agent2 = make_agent(server, monitor2, spool=spool2, clock=clock)
+        self._emit(monitor2, 1)  # the new run's own window: fresh
+        agent2._drain(CancelContext())
+        assert agg._delivery_hist["replay"].count == 3
+        assert agg._delivery_hist["replay"].sum == pytest.approx(900.0)
+        assert agg._delivery_hist["fresh"].count == 1
+        agent2._close_conn()
+        spool2.close()
+
+    def test_duplicates_never_observe_twice(self, server, tmp_path):
+        # a redelivered report is acked but NOT re-measured: the first
+        # copy already closed the delivery trace
+        agg = make_agg(server, stale_after=1e9)
+        monitor = FakeMeterMonitor()
+        d = str(tmp_path / "sp")
+        spool = Spool(d)
+        agent = make_agent(server, monitor, spool=spool)
+        self._emit(monitor, 4)
+        agent._drain(CancelContext())
+        total = (agg._delivery_hist["fresh"].count
+                 + agg._delivery_hist["replay"].count)
+        assert total == 4
+        agent._close_conn()
+        spool.close()
+        os.unlink(os.path.join(d, "cursor.json"))  # the "crash"
+        spool2 = Spool(d)
+        agent2 = FleetAgent(monitor, endpoint=agent._endpoint,
+                            node_name="dur-node", spool=spool2,
+                            jitter_seed=0)
+        agent2._run_nonce = agent._run_nonce  # same logical run
+        agent2._drain(CancelContext())
+        assert agg._stats["duplicates_total"] == 4
+        assert (agg._delivery_hist["fresh"].count
+                + agg._delivery_hist["replay"].count) == total
+        agent2._close_conn()
+        spool2.close()
+
+    def test_pre_telemetry_reports_observe_nothing(self, server):
+        # a report without emitted_at (older agent) merges fine and
+        # records no latency observation
+        agg = make_agg(server)
+        post_report(server, make_report("old-agent"), seq=1, run="r1")
+        assert agg._stats["reports_total"] == 1
+        assert agg._delivery_hist["fresh"].count == 0
+        assert agg._delivery_hist["replay"].count == 0
+
+    def test_hostile_delivery_headers_are_clamped(self, server):
+        # untrusted label/basis values: an unknown delivery_path falls
+        # back to "fresh" (no series minting), a non-numeric
+        # appended_at falls back to emitted_at, and a skewed emitted_at
+        # in the future clamps at 0 rather than going negative
+        agg = make_agg(server, stale_after=1e9, clock=lambda: 100.0)
+        blob = encode_report(make_report("hostile"), ["package", "dram"],
+                             seq=1, run="r1")
+        mutated = mutate_header(blob, emitted_at=50.0,
+                                delivery_path="evil-label")
+        post_raw(server, mutated)
+        assert agg._delivery_hist["fresh"].count == 1
+        assert "evil-label" not in agg._delivery_hist
+        mutated = mutate_header(blob, seq=2, emitted_at=999.0)
+        post_raw(server, mutated)
+        assert agg._delivery_hist["fresh"].count == 2
+        assert agg._delivery_hist["fresh"].sum == pytest.approx(50.0)
+        mutated = mutate_header(blob, seq=3, emitted_at=50.0,
+                                delivery_path="replay",
+                                appended_at="not-a-number")
+        post_raw(server, mutated)
+        assert agg._delivery_hist["replay"].count == 1
+        assert agg._delivery_hist["replay"].sum == pytest.approx(50.0)
 
 
 @pytest.mark.chaos
@@ -830,6 +989,8 @@ agent: {{spool: {{dir: {tmp_path / 'spool'}}}}}
                   if s.__class__.__name__ == "APIServer"][0]
         ok, components = server.health.check_health()
         assert "fleet-spool" in components
+        # the self-telemetry trace endpoint is on the APIServer
+        assert "/debug/traces" in server._endpoints
         agent._spool.close()
 
 

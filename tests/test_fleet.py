@@ -422,7 +422,7 @@ class TestAgent:
         assert monitor.listeners  # subscribed
         monitor.emit(make_sample())
         # drain the queue synchronously (run() would do this in a thread)
-        seq, sample = agent._queue.popleft()
+        seq, sample, _emitted, _trace = agent._queue.popleft()
         agent._send(sample, seq)
         result = agg.aggregate_once()
         assert result is not None
@@ -461,7 +461,7 @@ class TestAgent:
             bare.init()
             monitor.emit(make_sample())
             with pytest.raises(http.client.HTTPException, match="401"):
-                seq, sample = bare._queue.popleft()
+                seq, sample, _emitted, _trace = bare._queue.popleft()
                 bare._send(sample, seq)
             # with credentials in the URL: accepted
             authed = FleetAgent(monitor,
@@ -471,7 +471,7 @@ class TestAgent:
                 b"agent:pw").decode()
             authed.init()
             monitor.emit(make_sample())
-            seq, sample = authed._queue.popleft()
+            seq, sample, _emitted, _trace = authed._queue.popleft()
             authed._send(sample, seq)
             assert agg.aggregate_once() is not None
         finally:
@@ -484,7 +484,7 @@ class TestAgent:
                            node_name="test-node", timeout_s=0.2)
         agent.init()
         monitor.emit(make_sample())
-        seq, sample = agent._queue.popleft()
+        seq, sample, _emitted, _trace = agent._queue.popleft()
         with pytest.raises(OSError):
             agent._send(sample, seq)  # run() catches this and logs
 

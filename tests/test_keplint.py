@@ -45,11 +45,11 @@ def ids(diags):
 
 
 class TestEngine:
-    def test_registry_has_eight_domain_rules(self):
+    def test_registry_has_nine_domain_rules(self):
         rules = all_rules()
         assert [r.id for r in rules] == sorted(r.id for r in rules)
-        assert len(rules) == 8
-        assert len({r.name for r in rules}) == 8
+        assert len(rules) == 9
+        assert len({r.name for r in rules}) == 9
         for r in rules:
             assert r.summary and r.rationale, f"{r.id} lacks docs"
 
@@ -594,6 +594,116 @@ class TestLockGuardedRule:
 
                 def init(self):
                     self._state = {}
+        """)
+        assert diags == []
+
+
+class TestSpanDisciplineRule:
+    def test_bad_wall_clock_in_span_body(self, lint):
+        diags = lint("""
+            import time
+            from kepler_tpu import telemetry
+
+            def refresh(self):
+                with telemetry.span("monitor.device_read"):
+                    started = time.time()
+                    return started
+        """)
+        assert ids(diags) == ["KTL109"]
+        assert "time.time" in diags[0].message
+
+    def test_bad_datetime_now_in_nested_span(self, lint):
+        diags = lint("""
+            import datetime
+            from kepler_tpu.telemetry import span
+
+            def scrape(self):
+                with span("exporter.scrape"):
+                    with span("exporter.render"):
+                        return datetime.datetime.now()
+        """)
+        # the call sits inside BOTH span bodies: one diag per enclosing
+        # span with-block is acceptable, but they must all be KTL109
+        assert set(ids(diags)) == {"KTL109"}
+
+    def test_good_monotonic_and_seam_in_span_body(self, lint):
+        diags = lint("""
+            import time
+            from kepler_tpu import telemetry
+
+            def refresh(self):
+                with telemetry.span("monitor.refresh"):
+                    t0 = time.monotonic()
+                    now = self._clock()  # injected seam: sanctioned
+                    return t0, now
+        """)
+        assert diags == []
+
+    def test_bad_span_inside_jitted_kernel(self, lint):
+        diags = lint("""
+            import jax
+            from kepler_tpu import telemetry
+
+            @jax.jit
+            def attribute(x):
+                with telemetry.span("ops.attribute"):
+                    return x * 2
+        """)
+        assert ids(diags) == ["KTL109"]
+        assert "trace time" in diags[0].message
+
+    def test_bad_span_inside_pallas_kernel(self, lint):
+        diags = lint("""
+            from jax.experimental.pallas import pallas_call
+            from kepler_tpu.telemetry import span
+
+            def kernel(x_ref, o_ref):
+                with span("kernel"):
+                    o_ref[...] = x_ref[...]
+
+            def launch(x):
+                return pallas_call(kernel, out_shape=x)(x)
+        """)
+        assert ids(diags) == ["KTL109"]
+
+    def test_good_span_at_call_site_of_kernel(self, lint):
+        diags = lint("""
+            import jax
+            from kepler_tpu import telemetry
+
+            @jax.jit
+            def attribute(x):
+                return x * 2
+
+            def refresh(x):
+                with telemetry.span("monitor.attribute"):
+                    return attribute(x)
+        """)
+        assert diags == []
+
+    def test_good_deferred_callback_may_use_wall_clock(self, lint):
+        # a function/lambda DEFINED inside the span body runs after the
+        # span closed — its clock calls are not span-body timing
+        diags = lint("""
+            import time
+            from kepler_tpu import telemetry
+
+            def drain(self):
+                with telemetry.span("agent.drain"):
+                    def on_retry():
+                        return time.time()
+                    stamp = lambda: time.time()
+                    self.schedule(on_retry, stamp)
+        """)
+        assert diags == []
+
+    def test_unrelated_span_named_calls_out_of_scope(self, lint):
+        diags = lint("""
+            import time
+
+            def f(doc):
+                with doc.span("hello"):
+                    return time.time()
         """)
         assert diags == []
 

@@ -1,0 +1,98 @@
+"""utils/logger.py (ISSUE 4 satellite): JSON log lines must carry
+RFC3339 UTC millisecond timestamps and the thread name so they correlate
+with telemetry traces and with logs from other nodes."""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import re
+import threading
+
+import pytest
+
+from kepler_tpu.utils.logger import JSONFormatter, new_logger
+
+RFC3339_UTC_MS = re.compile(
+    r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z$")
+
+
+@pytest.fixture(autouse=True)
+def _restore_kepler_logger():
+    """new_logger() mutates the process-wide "kepler" logger (handlers,
+    propagate=False); restore it so later tests' caplog still sees
+    kepler.* records."""
+    logger = logging.getLogger("kepler")
+    saved = (list(logger.handlers), logger.propagate, logger.level)
+    yield
+    logger.handlers[:], logger.propagate, logger.level = saved
+
+
+def make_record(msg="hello", created=None, msecs=None):
+    record = logging.LogRecord(
+        name="kepler.test", level=logging.INFO, pathname=__file__,
+        lineno=1, msg=msg, args=(), exc_info=None)
+    if created is not None:
+        record.created = created
+        record.msecs = msecs if msecs is not None else 0.0
+    return record
+
+
+class TestJSONFormatter:
+    def test_rfc3339_utc_millisecond_timestamp(self):
+        payload = json.loads(JSONFormatter().format(make_record()))
+        assert RFC3339_UTC_MS.match(payload["time"]), payload["time"]
+
+    def test_timestamp_is_utc_not_localtime(self):
+        # 2021-01-01T00:00:00Z + 123ms, independent of the host TZ
+        payload = json.loads(JSONFormatter().format(
+            make_record(created=1609459200.123, msecs=123.0)))
+        assert payload["time"] == "2021-01-01T00:00:00.123Z"
+
+    def test_includes_thread_name(self):
+        payload = json.loads(JSONFormatter().format(make_record()))
+        assert payload["thread"] == threading.current_thread().name
+
+    def test_thread_name_from_worker(self):
+        out = {}
+
+        def worker():
+            out["line"] = JSONFormatter().format(make_record())
+
+        t = threading.Thread(target=worker, name="kepler-worker-7")
+        t.start()
+        t.join(5.0)
+        assert json.loads(out["line"])["thread"] == "kepler-worker-7"
+
+    def test_exception_still_attached(self):
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            record = make_record()
+            import sys
+            record.exc_info = sys.exc_info()
+        payload = json.loads(JSONFormatter().format(record))
+        assert "boom" in payload["exc"]
+
+    def test_core_fields_stable(self):
+        payload = json.loads(JSONFormatter().format(make_record("m")))
+        assert payload["level"] == "INFO"
+        assert payload["logger"] == "kepler.test"
+        assert payload["msg"] == "m"
+
+
+class TestNewLogger:
+    def test_json_stream_lines_parse_and_correlate(self):
+        stream = io.StringIO()
+        logger = new_logger("info", "json", stream=stream)
+        logger.info("window published")
+        (line,) = stream.getvalue().splitlines()
+        payload = json.loads(line)
+        assert RFC3339_UTC_MS.match(payload["time"])
+        assert payload["thread"] == threading.current_thread().name
+        assert payload["msg"] == "window published"
+
+    def test_invalid_level_rejected(self):
+        with pytest.raises(ValueError):
+            new_logger("verbose")

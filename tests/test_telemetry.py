@@ -1,0 +1,424 @@
+"""Self-telemetry plane (ISSUE 4): span recorder core, the disabled-path
+cost contract (< 1µs/call), self-metric exposition, Chrome trace-event
+export schema, monitor stage integration (≥ 4 stages), watchdog
+stuck-stage naming, the telemetry.drop fault site, and the
+/debug/traces endpoint."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from kepler_tpu import fault, telemetry
+from kepler_tpu.fault import FaultPlan, FaultSpec
+from kepler_tpu.telemetry import Histogram, SpanRecorder
+
+from tests.test_monitor import make_monitor
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_state():
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def make_recorder(**kw):
+    kw.setdefault("enabled", True)
+    return SpanRecorder(**kw)
+
+
+class TestRecorderCore:
+    def test_nested_spans_build_one_cycle_trace(self):
+        rec = make_recorder(clock=lambda: 1000.0)
+        with rec.span("outer"):
+            with rec.span("inner_a"):
+                pass
+            with rec.span("inner_b"):
+                pass
+        traces = rec.recent_traces()
+        assert len(traces) == 1
+        tr = traces[0]
+        assert tr.name == "outer"
+        assert tr.start_wall == 1000.0
+        # events appended at exit: inners first, the cycle last
+        assert [e.name for e in tr.events] == ["inner_a", "inner_b",
+                                               "outer"]
+        assert [e.depth for e in tr.events] == [1, 1, 0]
+        for e in tr.events:
+            assert e.duration_s >= 0.0
+            assert e.rel_start_s >= 0.0
+
+    def test_ring_is_bounded_newest_wins(self):
+        rec = make_recorder(ring_size=3)
+        for _ in range(7):
+            with rec.span("monitor.refresh"):
+                pass
+        assert len(rec.recent_traces()) == 3
+
+    def test_ring_partitioned_per_cycle_name(self):
+        # a high-rate cycle (aggregator ingest) must not evict the rare
+        # interesting ones (the fleet window) from /debug/traces
+        wall = [0.0]
+
+        def clock():
+            wall[0] += 1.0
+            return wall[0]
+
+        rec = make_recorder(ring_size=3, clock=clock)
+        with rec.span("aggregator.window"):
+            pass
+        for _ in range(50):
+            with rec.span("aggregator.ingest"):
+                pass
+        names = [t.name for t in rec.recent_traces()]
+        assert names.count("aggregator.ingest") == 3
+        assert names.count("aggregator.window") == 1
+        # ordered by wall-clock start: the old window trace leads
+        assert names[0] == "aggregator.window"
+
+    def test_stage_histograms_accumulate_per_name(self):
+        rec = make_recorder()
+        for _ in range(3):
+            with rec.span("outer"):
+                with rec.span("inner"):
+                    pass
+        stats = rec.stats()
+        assert stats["cycles"] == 3
+        assert stats["stages"] == ["inner", "outer"]
+        assert rec._hist["inner"].count == 3
+
+    def test_budget_overrun_counted(self):
+        rec = make_recorder()
+        with rec.span("slow_cycle", budget_s=1e-9):
+            time.sleep(0.002)
+        with rec.span("fast_cycle", budget_s=60.0):
+            pass
+        assert rec.stats()["overruns"] == {"slow_cycle": 1}
+        assert rec.recent_traces()[0].overrun is True
+        assert rec.recent_traces()[1].overrun is False
+
+    def test_disabled_recorder_records_nothing(self):
+        rec = SpanRecorder(enabled=False)
+        with rec.span("x"):
+            pass
+        assert rec.recent_traces() == []
+        assert rec.stats()["cycles"] == 0
+
+    def test_inflight_reports_open_spans_cross_thread(self):
+        rec = make_recorder()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def worker():
+            with rec.span("monitor.refresh"):
+                with rec.span("monitor.device_read"):
+                    entered.set()
+                    release.wait(5.0)
+
+        t = threading.Thread(target=worker, name="wedged-refresh")
+        t.start()
+        try:
+            assert entered.wait(5.0)
+            snap = rec.inflight()
+            assert len(snap) == 1
+            assert snap[0]["thread"] == "wedged-refresh"
+            names = [s["name"] for s in snap[0]["spans"]]
+            assert names == ["monitor.refresh", "monitor.device_read"]
+            assert all(s["elapsed_s"] >= 0.0 for s in snap[0]["spans"])
+        finally:
+            release.set()
+            t.join(5.0)
+        assert rec.inflight() == []  # all closed
+
+    def test_fault_site_drops_trace_and_counts(self):
+        rec = make_recorder()
+        with fault.installed(FaultPlan([FaultSpec("telemetry.drop",
+                                                  count=1)])) as plan:
+            with rec.span("dropped"):
+                pass
+            with rec.span("kept"):
+                pass
+            assert plan.fired("telemetry.drop") == 1
+        assert [t.name for t in rec.recent_traces()] == ["kept"]
+        assert rec.stats()["dropped"] == 1
+        # the dropped cycle never reached the histograms either
+        assert "dropped" not in rec.stats()["stages"]
+
+    def test_installed_swaps_module_recorder(self):
+        rec = make_recorder()
+        with telemetry.installed(rec):
+            with telemetry.span("via_module"):
+                pass
+        assert [t.name for t in rec.recent_traces()] == ["via_module"]
+        # restored: the module default is disabled again
+        assert not telemetry.recorder().enabled
+
+
+class TestDisabledCost:
+    def test_disabled_span_is_sub_microsecond(self):
+        """Acceptance: with telemetry disabled, one `with span(...)`
+        round-trip costs < 1µs — cheap enough to leave inline in the
+        monitor's refresh loop."""
+        assert not telemetry.recorder().enabled  # module default
+        n = 100_000
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with telemetry.span("monitor.device_read"):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, f"disabled span cost {best * 1e9:.0f}ns/call"
+
+
+class TestHistogram:
+    def test_observe_and_cumulative(self):
+        h = Histogram([0.1, 1.0, 10.0])
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(56.05)
+        cum = h.cumulative()
+        assert cum == [("0.1", 1), ("1.0", 3), ("10.0", 4), ("+Inf", 5)]
+
+    def test_boundary_value_counts_le(self):
+        h = Histogram([1.0, 2.0])
+        h.observe(1.0)  # le="1.0" is inclusive
+        assert h.cumulative()[0] == ("1.0", 1)
+
+
+class TestSelfMetrics:
+    def render(self, rec):
+        from prometheus_client import CollectorRegistry
+        from prometheus_client.exposition import generate_latest
+
+        registry = CollectorRegistry()
+        with telemetry.installed(rec):
+            registry.register(telemetry.collector())
+            return generate_latest(registry).decode()
+
+    def test_families_and_names(self):
+        rec = make_recorder()
+        with rec.span("monitor.refresh", budget_s=1e-9):
+            with rec.span("monitor.device_read"):
+                pass
+            time.sleep(0.002)
+        text = self.render(rec)
+        assert ('kepler_self_stage_duration_seconds_bucket{'
+                'le="0.0005",stage="monitor.device_read"}') in text
+        assert ('kepler_self_stage_duration_seconds_count{'
+                'stage="monitor.refresh"} 1.0') in text
+        assert ('kepler_self_cycle_overrun_total{'
+                'cycle="monitor.refresh"} 1.0') in text
+        assert "kepler_self_traces_dropped_total 0.0" in text
+
+    def test_collector_follows_installed_recorder(self):
+        # the registry adapter reads the INSTALLED recorder at scrape
+        # time, so late install_from_config is always the one scraped
+        rec = make_recorder()
+        with rec.span("late"):
+            pass
+        assert 'stage="late"' in self.render(rec)
+
+
+class TestChromeTrace:
+    def validate_chrome_schema(self, payload):
+        """Chrome trace-event format: dict with traceEvents; every
+        event needs name/ph; X events need µs ts + dur and pid/tid."""
+        assert isinstance(payload, dict)
+        events = payload["traceEvents"]
+        assert isinstance(events, list) and events
+        for ev in events:
+            assert isinstance(ev["name"], str) and ev["name"]
+            assert ev["ph"] in ("X", "M")
+            if ev["ph"] == "X":
+                assert isinstance(ev["ts"], (int, float))
+                assert isinstance(ev["dur"], (int, float))
+                assert ev["dur"] >= 0
+                assert isinstance(ev["pid"], int)
+                assert isinstance(ev["tid"], int)
+
+    def test_chrome_export_validates_and_nests(self):
+        rec = make_recorder(clock=lambda: 2000.0)
+        with rec.span("monitor.refresh"):
+            with rec.span("monitor.device_read"):
+                pass
+        payload = json.loads(json.dumps(rec.chrome_trace()))
+        self.validate_chrome_schema(payload)
+        xs = {e["name"]: e for e in payload["traceEvents"]
+              if e["ph"] == "X"}
+        assert set(xs) == {"monitor.refresh", "monitor.device_read"}
+        # the stage nests inside the cycle on the µs axis
+        outer, inner = xs["monitor.refresh"], xs["monitor.device_read"]
+        assert outer["ts"] <= inner["ts"]
+        assert (inner["ts"] + inner["dur"]
+                <= outer["ts"] + outer["dur"] + 1.0)  # float slack
+        assert outer["ts"] == pytest.approx(2000.0 * 1e6)
+        # thread metadata present for the emitting thread
+        meta = [e for e in payload["traceEvents"] if e["ph"] == "M"]
+        assert meta and meta[0]["name"] == "thread_name"
+
+
+class TestMonitorIntegration:
+    def test_refresh_exposes_at_least_four_stages(self):
+        """Acceptance: with telemetry enabled, one monitor refresh feeds
+        ≥ 4 monitor stages into kepler_self_stage_duration_seconds."""
+        rec = make_recorder()
+        with telemetry.installed(rec):
+            mon, _, zones, clock = make_monitor()
+            mon.refresh()
+            zones[0].increment = 1_000_000
+            clock.step(5.0)
+            mon.refresh()
+        stages = [s for s in rec.stats()["stages"]
+                  if s.startswith("monitor.") and s != "monitor.refresh"]
+        assert len(stages) >= 4, stages
+        assert {"monitor.device_read", "monitor.resource_scan",
+                "monitor.attribute", "monitor.publish"} <= set(stages)
+        traces = rec.recent_traces()
+        assert [t.name for t in traces] == ["monitor.refresh"] * 2
+        # stage spans nest under the refresh cycle in the same trace
+        assert {"monitor.device_read", "monitor.publish"} <= {
+            e.name for e in traces[-1].events}
+
+    def test_overrun_counts_against_monitor_interval(self):
+        rec = make_recorder()
+        with telemetry.installed(rec):
+            mon, _, _, _ = make_monitor(interval=1e-9)
+            mon.refresh()
+        assert rec.stats()["overruns"].get("monitor.refresh", 0) >= 1
+
+    def test_disabled_recorder_keeps_refresh_clean(self):
+        # module default recorder is disabled: refresh must not record
+        mon, _, _, _ = make_monitor()
+        mon.refresh()
+        assert telemetry.recorder().recent_traces() == []
+
+
+class _StubMonitor:
+    """Just enough PowerMonitor surface for the watchdog."""
+
+    def __init__(self):
+        self.stalled = False
+
+    def last_refresh_age(self):
+        return 1e9  # stalled forever
+
+    def mark_stalled(self, stalled):
+        self.stalled = stalled
+
+
+class TestWatchdogStuckStage:
+    def test_stall_names_the_stuck_stage(self):
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        rec = make_recorder()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def wedged():
+            with rec.span("monitor.refresh"):
+                with rec.span("monitor.device_read"):
+                    entered.set()
+                    release.wait(5.0)
+
+        t = threading.Thread(target=wedged, name="refresh-thread")
+        t.start()
+        try:
+            assert entered.wait(5.0)
+            mon = _StubMonitor()
+            wd = MonitorWatchdog(mon, interval=5.0, stall_after=10.0)
+            with telemetry.installed(rec):
+                assert wd.check_once() is True
+            assert mon.stalled
+            health = wd.health()
+            assert health["ok"] is False
+            # acceptance: the health probe detail names the stuck stage
+            assert health["stuck_stage"] == "monitor.device_read"
+            names = [s["name"] for s in health["inflight_spans"]]
+            assert names == ["monitor.refresh", "monitor.device_read"]
+        finally:
+            release.set()
+            t.join(5.0)
+
+    def test_stall_without_telemetry_still_reports(self):
+        from kepler_tpu.monitor.watchdog import MonitorWatchdog
+
+        mon = _StubMonitor()
+        wd = MonitorWatchdog(mon, interval=5.0, stall_after=10.0)
+        assert wd.check_once() is True  # default recorder: no inflight
+        health = wd.health()
+        assert health["ok"] is False
+        assert "stuck_stage" not in health
+
+
+class _Req:
+    def __init__(self, path):
+        self.path = path
+
+
+class TestTracesEndpoint:
+    def test_json_format(self):
+        rec = make_recorder(clock=lambda: 3000.0)
+        with rec.span("monitor.refresh"):
+            with rec.span("monitor.publish"):
+                pass
+        handler = telemetry.make_traces_handler(rec)
+        status, headers, body = handler(_Req("/debug/traces"))
+        assert status == 200
+        assert headers["Content-Type"] == "application/json"
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["inflight"] == []
+        (trace,) = payload["traces"]
+        assert trace["name"] == "monitor.refresh"
+        assert trace["start"] == 3000.0
+        assert [s["name"] for s in trace["spans"]] == [
+            "monitor.publish", "monitor.refresh"]
+
+    def test_chrome_format_validates(self):
+        rec = make_recorder()
+        with rec.span("agent.drain"):
+            with rec.span("agent.send"):
+                pass
+        handler = telemetry.make_traces_handler(rec)
+        status, _, body = handler(
+            _Req("/debug/traces?format=chrome"))
+        assert status == 200
+        TestChromeTrace().validate_chrome_schema(json.loads(body))
+
+    def test_unknown_format_is_400(self):
+        handler = telemetry.make_traces_handler(make_recorder())
+        status, _, body = handler(_Req("/debug/traces?format=xml"))
+        assert status == 400
+        assert b"xml" in body
+
+    def test_endpoint_served_over_http(self):
+        from kepler_tpu.server.http import APIServer
+        from kepler_tpu.service.lifecycle import CancelContext
+        import urllib.request
+
+        rec = make_recorder()
+        with rec.span("cycle"):
+            pass
+        server = APIServer(listen_addresses=["127.0.0.1:0"])
+        server.register("/debug/traces", "Traces", "spans",
+                        telemetry.make_traces_handler(rec))
+        server.init()
+        ctx = CancelContext()
+        t = threading.Thread(target=server.run, args=(ctx,), daemon=True)
+        t.start()
+        try:
+            host, port = server.addresses[0]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/traces?format=chrome",
+                    timeout=5) as resp:
+                payload = json.loads(resp.read())
+            TestChromeTrace().validate_chrome_schema(payload)
+        finally:
+            ctx.cancel()
+            server.shutdown()
