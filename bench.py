@@ -373,6 +373,14 @@ def main() -> None:
               f"p99 {aggwin_fields.get('aggwin_host_p99_ms')} ms)",
               file=sys.stderr)
         failed = True
+    if aggwin_fields.get("aggwin_pipeline_ok") is False:
+        print(f"GATE: pipelined window cadence "
+              f"{aggwin_fields.get('aggwin_pipeline_p50_ms')} ms is "
+              f"{aggwin_fields.get('aggwin_pipeline_ratio')}x the serial "
+              f"window {aggwin_fields.get('aggwin_serial_p50_ms')} ms "
+              f"(budget {aggwin_fields.get('aggwin_pipeline_ratio_budget')}x)",
+              file=sys.stderr)
+        failed = True
     if failed:
         sys.exit(1)
 

@@ -270,6 +270,14 @@ def measure_reader(proc: str, sysfs: str, pids, use_native: bool,
     }
 
 
+# churn-burst absorption budget at the reference burst size (2000 new
+# procs = 20% of a 10k-proc node). Round-5 measured 175 ms on the native
+# reader; the budget is measured + ~3× margin so it trips on regressions
+# (per-burst-proc Python creeping back in), not on host noise.
+NODE_BURST_BUDGET_MS = float(os.environ.get(
+    "KEPLER_NODE_BURST_BUDGET_MS", "600.0"))
+
+
 def run(n_procs: int = 10_000, iters: int = 11, root: str | None = None
         ) -> dict:
     """→ flat dict of node_scrape_* fields (bench.py merges them)."""
@@ -311,6 +319,18 @@ def run(n_procs: int = 10_000, iters: int = 11, root: str | None = None
     out["node_churn_burst_procs"] = best["burst_new_procs"]
     out["node_churn_burst_ms"] = best["burst_refresh_ms"]
     out["node_churn_burst_py_ms"] = python["burst_refresh_ms"]
+    # churn-burst absorption gate (ISSUE 5): one refresh that absorbs a
+    # 20%-of-fleet pod reschedule must stay within an explicit budget —
+    # the monitor's staging reuses its padded buffers across refreshes
+    # (the node-side delta-slice analog of the aggregator's resident
+    # batch), so the burst pays only scan+classify+the new tail, never a
+    # fresh full-fleet allocation. Scaled linearly with the burst size;
+    # like the scrape budget, informational on the pure-Python fallback
+    # (the native reader is the shipped configuration).
+    burst_budget = NODE_BURST_BUDGET_MS * (best["burst_new_procs"] / 2000)
+    out["node_churn_burst_budget_ms"] = round(burst_budget, 1)
+    out["node_churn_burst_ok"] = bool(
+        best["burst_refresh_ms"] < burst_budget)
     if native:
         out["native_scan_speedup"] = round(
             python["refresh_p50_ms"] / max(native["refresh_p50_ms"], 1e-9),

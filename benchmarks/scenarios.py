@@ -188,6 +188,13 @@ def run_node_path_scenario(n_procs: int) -> dict:
     row["budget_ms"] = budget
     row["within_budget"] = (
         row["node_scrape_to_export_p99_ms"] <= budget)
+    # churn-burst absorption gates only on the shipped (native-reader)
+    # configuration — the pure-Python fallback's burst cost tracks the
+    # host's file-I/O speed, not the code (same policy as the scrape
+    # budget in benchmarks/node_path.py)
+    if (row.get("node_scrape_reader") == "native"
+            and row.get("node_churn_burst_ok") is False):
+        row["within_budget"] = False
     return row
 
 
@@ -201,36 +208,41 @@ def run_node_path_scenario(n_procs: int) -> dict:
 # regression class that matters (reintroducing O(nodes×workloads) Python
 # per window, which measures 50 ms+), without flaking the lane on VM
 # noise. Env-overridable so a quieter TPU-host capture can ratchet down
-# without a code change.
+# without a code change. Round 6 recalibration: the assembly leg now
+# CONTAINS the packed-row staging that used to be the device leg's H2D
+# (delta-H2D packs every dirty row host-side) plus the per-row identity
+# bookkeeping — measured ~20-23 ms p50 at full-fleet re-report on the
+# 2-core capture host, with the legs taken from a depth-1 run so
+# pipelined XLA compute threads can't pollute the wall time. The
+# budgets move 15/25 → 30/60 accordingly; the regression class they
+# guard (reintroducing O(nodes×workloads) Python per window, 100 ms+)
+# still fails 3×+.
 AGG_HOST_BUDGET_MS = float(os.environ.get(
-    "KEPLER_AGG_HOST_BUDGET_MS", "15.0"))
+    "KEPLER_AGG_HOST_BUDGET_MS", "30.0"))
 AGG_HOST_P99_BUDGET_MS = float(os.environ.get(
-    "KEPLER_AGG_HOST_P99_BUDGET_MS", "25.0"))
+    "KEPLER_AGG_HOST_P99_BUDGET_MS", "60.0"))
+# the ISSUE-5 tentpole gate: steady-state pipelined cadence (packed-f16
+# resident default, depth 2) must come in at ≤ this fraction of the
+# serial einsum-f32 window p50 (the retained accuracy-mode path, depth
+# 1 — the pre-pipeline configuration). A RATIO of two measurements on
+# the same host, so it gates on CPU CI machines too.
+AGG_PIPELINE_RATIO_BUDGET = float(os.environ.get(
+    "KEPLER_AGG_PIPELINE_RATIO_BUDGET", "0.7"))
 
 
-def run_aggregator_window_scenario(iters: int) -> dict:
-    """A LIVE Aggregator at the north-star fleet shape: 1024 nodes × ~100
-    workloads through ``aggregate_once``, measuring the host-side legs
-    (assembly + scatter) the device can't hide. Reports are seeded
-    directly into the store (the HTTP ingest path is exercised by the
-    soak benchmark); the gate is on HOST work, which is machine-portable
-    enough to enforce everywhere."""
-    import time
-
-    from kepler_tpu.fleet.aggregator import Aggregator, _Stored
+def _seed_fleet_reports(agg, n_nodes: int, w: int, seq: int,
+                        received: float) -> None:
+    """(Re-)seed every node's report at ``seq`` — the steady-state shape:
+    the whole fleet re-reports each interval, so the delta path uploads
+    every row (its best case is measured by the churn tests, not here)."""
+    from kepler_tpu.fleet.aggregator import _Stored
     from kepler_tpu.parallel.fleet import NodeReport
-    from kepler_tpu.parallel.mesh import make_mesh
-    from kepler_tpu.server.http import APIServer
 
-    rng = np.random.default_rng(0)
-    n_nodes, w = 1024, 100
-    agg = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
-                     workload_bucket=128, stale_after=1e9)
-    agg._mesh = make_mesh()
-    now = time.time()
+    rng = np.random.default_rng(seq)
     zones = ("package", "core", "dram", "uncore")
+    cpu_all = rng.uniform(0.1, 5.0, (n_nodes, w)).astype(np.float32)
     for i in range(n_nodes):
-        cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+        cpu = cpu_all[i]
         rep = NodeReport(
             node_name=f"node-{i:04d}",
             zone_deltas_uj=rng.uniform(1e7, 5e8, 4).astype(np.float32),
@@ -244,19 +256,90 @@ def run_aggregator_window_scenario(iters: int) -> dict:
             workload_kinds=np.ones(w, np.int8),
         )
         agg._reports[rep.node_name] = _Stored(
-            report=rep, zone_names=zones, received=now + 1e9, seq=1)
-    host_ms, window_ms = [], []
-    for it in range(iters + 2):
-        if agg.aggregate_once() is None:  # not assert: -O must still run it
-            raise RuntimeError("aggregator produced no window")
-        if it < 2:
-            continue  # warm the jit cache untimed
+            report=rep, zone_names=zones, received=received, seq=seq,
+            run="bench")
+
+
+def _measure_agg(agg, n_nodes: int, w: int, iters: int, warm: int = 2):
+    """Drive ``iters`` timed windows through ``aggregate_once`` (tight
+    loop = steady-state cadence), re-seeding the fleet before each so
+    every row is dirty. → (cadence_ms sorted, host_ms sorted)."""
+    import time
+
+    now = time.time() + 1e9
+    cadence, host = [], []
+    for it in range(iters + warm):
+        _seed_fleet_reports(agg, n_nodes, w, seq=it + 1, received=now)
+        t0 = time.perf_counter()
+        agg.aggregate_once()
+        dt = (time.perf_counter() - t0) * 1e3
+        if it < warm:
+            continue  # compile + resident rebuild stay untimed
         s = agg._stats
-        host_ms.append(s["last_assembly_ms"] + s["last_scatter_ms"])
-        window_ms.append(s["last_attribution_ms"])
-    host_ms.sort()
-    window_ms.sort()
-    s = agg._stats
+        cadence.append(dt)
+        host.append(s["last_assembly_ms"] + s["last_scatter_ms"])
+    # snapshot the per-leg stats from the last STEADY window: the drain
+    # below publishes its window right after dispatch (nothing overlaps
+    # it), so post-shutdown legs would show zero pipeline overlap
+    steady_stats = dict(agg._stats)
+    agg.shutdown()  # drain in-flight windows
+    cadence.sort()
+    host.sort()
+    return cadence, host, steady_stats
+
+
+def run_aggregator_window_scenario(iters: int) -> dict:
+    """LIVE Aggregators at the north-star fleet shape (1024 nodes × ~100
+    workloads), both window configurations:
+
+    * **pipelined** — the shipped default: packed-f16 device-resident
+      batch, delta H2D, sparse model rows, pipeline depth 2. Measured as
+      steady-state cadence (wall time per ``aggregate_once`` in a tight
+      loop, every row dirty).
+    * **serial** — the retained einsum-f32 accuracy path at depth 1 (the
+      pre-pipeline assemble→dispatch→fetch cycle).
+
+    Reports are seeded directly into the store (the HTTP ingest path is
+    exercised by the soak benchmark). Gates: the host legs against the
+    absolute budgets (machine-portable enough to enforce everywhere) and
+    the pipelined/serial cadence RATIO against
+    ``AGG_PIPELINE_RATIO_BUDGET`` (a same-host ratio — portable by
+    construction)."""
+    from kepler_tpu.fleet.aggregator import Aggregator
+    from kepler_tpu.parallel.mesh import make_mesh
+    from kepler_tpu.server.http import APIServer
+
+    n_nodes, w = 1024, 100
+    mesh = make_mesh()
+    agg = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
+                     workload_bucket=128, stale_after=1e9,
+                     pipeline_depth=2)
+    agg._mesh = mesh
+    pipe_ms, _, s = _measure_agg(agg, n_nodes, w, iters)
+    if agg._stats["attributions_total"] < iters:  # not assert: -O runs it
+        raise RuntimeError("pipelined aggregator lost windows")
+
+    # host legs measured at depth 1: with the pipeline overlapping, the
+    # host staging shares cores with XLA's compute threads and its WALL
+    # time stops measuring host WORK — the serial-packed run keeps the
+    # gate on the code, not on CI core count
+    host_agg = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
+                          workload_bucket=128, stale_after=1e9,
+                          pipeline_depth=1)
+    host_agg._mesh = mesh
+    packed_serial_ms, host_ms, _ = _measure_agg(host_agg, n_nodes, w,
+                                                max(3, iters // 2))
+
+    serial = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
+                        workload_bucket=128, stale_after=1e9,
+                        accuracy_mode=True, pipeline_depth=1)
+    serial._mesh = mesh
+    serial_ms, _, _ = _measure_agg(serial, n_nodes, w,
+                                   max(3, iters // 2))
+
+    pipe_p50 = pipe_ms[len(pipe_ms) // 2]
+    serial_p50 = serial_ms[len(serial_ms) // 2]
+    ratio = pipe_p50 / max(serial_p50, 1e-9)
     return {
         "scenario": "aggregator-window",
         "nodes": n_nodes,
@@ -265,8 +348,20 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "host_p99_ms": round(host_ms[-1], 3),
         "assembly_ms": round(s["last_assembly_ms"], 3),
         "device_ms": round(s["last_device_ms"], 3),
+        "dispatch_ms": round(s["last_dispatch_ms"], 3),
+        "wait_ms": round(s["last_wait_ms"], 3),
         "scatter_ms": round(s["last_scatter_ms"], 3),
-        "window_p50_ms": round(window_ms[len(window_ms) // 2], 3),
+        "h2d_delta_rows": int(s["last_h2d_rows"]),
+        "compile_count": int(s["window_compiles_total"]),
+        "window_p50_ms": round(pipe_p50, 3),
+        "pipeline_p50_ms": round(pipe_p50, 3),
+        "pipeline_p99_ms": round(pipe_ms[-1], 3),
+        "packed_serial_p50_ms": round(
+            packed_serial_ms[len(packed_serial_ms) // 2], 3),
+        "serial_p50_ms": round(serial_p50, 3),
+        "pipeline_ratio": round(ratio, 3),
+        "pipeline_ratio_budget": AGG_PIPELINE_RATIO_BUDGET,
+        "pipeline_ok": bool(ratio <= AGG_PIPELINE_RATIO_BUDGET),
         "budget_ms": AGG_HOST_BUDGET_MS,
         "p99_budget_ms": AGG_HOST_P99_BUDGET_MS,
         "within_budget": (
@@ -306,10 +401,20 @@ def main() -> None:
     if args.only == "aggregator-window":
         row = run_aggregator_window_scenario(max(5, args.iters // 2))
         print(json.dumps(row))
+        failed = False
         if not row["within_budget"]:
             print(f"BUDGET VIOLATION: aggregator-window host p50 "
                   f"{row['host_p50_ms']} / p99 {row['host_p99_ms']} ms",
                   file=sys.stderr)
+            failed = True
+        if not row["pipeline_ok"]:
+            print(f"BUDGET VIOLATION: pipelined cadence "
+                  f"{row['pipeline_p50_ms']} ms is "
+                  f"{row['pipeline_ratio']}x the serial window "
+                  f"{row['serial_p50_ms']} ms (budget "
+                  f"{row['pipeline_ratio_budget']}x)", file=sys.stderr)
+            failed = True
+        if failed:
             sys.exit(1)
         return
 
@@ -408,6 +513,13 @@ def main() -> None:
             f"{agg_row['host_p99_ms']} ms (budget "
             f"{AGG_HOST_P99_BUDGET_MS}) over budget (assembly "
             f"{agg_row['assembly_ms']} + scatter {agg_row['scatter_ms']})")
+    if not agg_row["pipeline_ok"]:
+        failures.append(
+            f"aggregator-window: pipelined cadence "
+            f"{agg_row['pipeline_p50_ms']} ms is "
+            f"{agg_row['pipeline_ratio']}x the serial window "
+            f"{agg_row['serial_p50_ms']} ms (budget "
+            f"{AGG_PIPELINE_RATIO_BUDGET}x)")
 
     row = run_temporal_scenario(mesh, args.backend, on_tpu, args.iters,
                                 repeats)

@@ -177,6 +177,21 @@ DESCRIPTIONS = {
                                "replay, retries) are absorbed "
                                "idempotently; seq jumps beyond it count "
                                "as `kepler_fleet_windows_lost_total`.",
+    "aggregator.pipeline_depth": "Aggregator: in-flight fleet windows. "
+                                 "`1` = serial assemble→dispatch→fetch; "
+                                 "`2` (default) overlaps window N's "
+                                 "fetch/scatter behind window N+1's "
+                                 "assembly+dispatch — results are at "
+                                 "most `pipelineDepth−1` intervals "
+                                 "stale; shutdown drains in-flight "
+                                 "windows deterministically.",
+    "aggregator.bucket_shrink_after": "Aggregator: consecutive windows "
+                                      "at under half bucket occupancy "
+                                      "before a padded batch bucket "
+                                      "shrinks one geometric step "
+                                      "(growth is immediate; hysteresis "
+                                      "prevents recompile thrash at a "
+                                      "bucket edge).",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -266,6 +281,8 @@ FLAG_OF = {
     "aggregator.training_dump_max_files":
         "--aggregator.training-dump-max-files",
     "aggregator.dedup_window": "--aggregator.dedup-window",
+    "aggregator.pipeline_depth": "--aggregator.pipeline-depth",
+    "aggregator.bucket_shrink_after": "--aggregator.bucket-shrink-after",
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",

@@ -53,7 +53,7 @@ SEVERITY_WARNING = "warning"
 _DIRECTIVE = re.compile(
     r"#\s*keplint:\s*"
     r"(?P<kind>disable-file|disable|monotonic-only|hot-loop|"
-    r"guarded-by|requires-lock)"
+    r"guarded-by|requires-lock|donates)"
     r"(?:=(?P<arg>[A-Za-z0-9_,\- ]+))?")
 
 

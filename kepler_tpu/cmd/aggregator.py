@@ -73,6 +73,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         degraded_ttl=cfg.aggregator.degraded_ttl,
         dedup_window=cfg.aggregator.dedup_window,
         delivery_buckets=cfg.telemetry.delivery_buckets or None,
+        pipeline_depth=cfg.aggregator.pipeline_depth,
+        bucket_shrink_after=cfg.aggregator.bucket_shrink_after,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

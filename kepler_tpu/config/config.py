@@ -332,6 +332,17 @@ class AggregatorConfig:
     # aggregator: per-node (run, seq) dedup window — spool replays and
     # retries are absorbed idempotently instead of double-ingesting
     dedup_window: int = 1024
+    # -- window pipeline (docs/developer/observability.md) --
+    # in-flight fleet windows: 1 = serial assemble→dispatch→fetch; 2
+    # (the shipped default) overlaps window N's fetch/scatter behind
+    # window N+1's assembly+dispatch — published results are at most
+    # pipelineDepth−1 intervals stale, shutdown drains deterministically
+    pipeline_depth: int = 2
+    # bucket hysteresis: padded batch shapes grow geometrically on
+    # demand but only SHRINK after this many consecutive windows at
+    # under half occupancy — a fleet hovering at a bucket edge never
+    # recompile-thrashes
+    bucket_shrink_after: int = 16
 
 
 @dataclass
@@ -426,6 +437,12 @@ class Config:
             errs.append("aggregator.breakerThreshold must be >= 1")
         if self.aggregator.dedup_window < 1:
             errs.append("aggregator.dedupWindow must be >= 1")
+        if not 1 <= self.aggregator.pipeline_depth <= 8:
+            # beyond a few intervals of staleness the "latest" results
+            # stop meaning anything; 8 is already generous
+            errs.append("aggregator.pipelineDepth must be in [1, 8]")
+        if self.aggregator.bucket_shrink_after < 1:
+            errs.append("aggregator.bucketShrinkAfter must be >= 1")
         if self.monitor.state_max_age < 0:
             errs.append("monitor.stateMaxAge must be >= 0")
         spool = self.agent.spool
@@ -516,6 +533,8 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "statePath": "state_path",
     "stateMaxAge": "state_max_age",
     "dedupWindow": "dedup_window",
+    "pipelineDepth": "pipeline_depth",
+    "bucketShrinkAfter": "bucket_shrink_after",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
@@ -659,6 +678,12 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         dest="aggregator_dump_max_files", default=None, type=int)
     add("--aggregator.dedup-window", dest="aggregator_dedup_window",
         default=None, type=int)
+    add("--aggregator.pipeline-depth", dest="aggregator_pipeline_depth",
+        default=None, type=int,
+        help="in-flight fleet windows (1 = serial, 2 = double-buffered)")
+    add("--aggregator.bucket-shrink-after",
+        dest="aggregator_bucket_shrink_after", default=None, type=int,
+        help="consecutive under-half windows before a batch bucket shrinks")
     add("--agent.spool-dir", dest="agent_spool_dir", default=None,
         help="crash-safe report spool directory (empty disables)")
     add("--tpu.platform", dest="tpu_platform", default=None,
@@ -714,6 +739,9 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "training_dump_max_files"),
            args.aggregator_dump_max_files)
     set_if(("aggregator", "dedup_window"), args.aggregator_dedup_window)
+    set_if(("aggregator", "pipeline_depth"), args.aggregator_pipeline_depth)
+    set_if(("aggregator", "bucket_shrink_after"),
+           args.aggregator_bucket_shrink_after)
     if args.agent_spool_dir is not None:
         cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)

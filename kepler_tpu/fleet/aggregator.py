@@ -2,16 +2,29 @@
 
 The aggregator half of the DCN plane (BASELINE.json north star, SURVEY §7
 step 9): node agents POST per-window feature rows; every ``interval`` the
-aggregator pads/masks the latest report from each node into one
-``[nodes × workloads × zones]`` batch, runs the sharded mixed-mode
-attribution program (``kepler_tpu.parallel.aggregator_core`` — ratio for
-RAPL nodes, learned estimator for the rest, one device computation), and
-publishes:
+aggregator runs one fleet window over the latest report from each node
+and publishes:
 
 - ``GET /v1/results[?node=…]`` — attributed watts scattered back per node
   (JSON), the pull leg for non-RAPL nodes that want their estimates;
 - ``GET /metrics`` — cluster-level Prometheus families
   (``kepler_fleet_…``), the same scrape plane the reference leans on.
+
+The default window path is DEVICE-RESIDENT and PIPELINED
+(``kepler_tpu.fleet.window``): the padded packed-f16 batch lives on
+device, each window scatter-updates only the rows whose report changed
+(delta H2D through a donated in-place program), and with
+``pipeline_depth`` ≥ 2 the fetch/scatter of window N overlaps window
+N+1's host assembly and dispatch — steady-state cadence approaches
+max(assembly, device) instead of their sum, at the cost of results
+being at most ``pipeline_depth − 1`` intervals stale. Shutdown (and an
+emptied fleet) deterministically drains in-flight windows.
+
+The serial einsum-f32 path — full assemble + one sharded dispatch + a
+multi-array fetch per window — is retained for ``accuracy_mode`` (the
+configuration the 0.5% budget is validated under), temporal mode (whose
+feature-history tensor has no packed layout), and training-dump capture
+(which needs the assembled host batch).
 
 Late/missing nodes: a node whose latest report is older than
 ``stale_after`` falls out of the batch (its row just isn't assembled) —
@@ -32,10 +45,11 @@ import numpy as np
 
 from kepler_tpu import telemetry
 from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
+from kepler_tpu.fleet.window import (PackedWindowEngine, RowInput,
+                                     WindowMeta, align_zone_matrices)
 from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.telemetry import DEFAULT_DELIVERY_BUCKETS, Histogram
 from kepler_tpu.parallel.aggregator_core import (
-    FleetResult,
     make_fleet_program,
     make_temporal_fleet_program,
     run_fleet_attribution,
@@ -77,6 +91,32 @@ class _Stored:
     received: float
     seq: int
     run: str = ""  # agent-run nonce (empty for pre-nonce agents)
+
+
+@dataclass
+class _Pending:
+    """One dispatched, not-yet-published window in the pipeline.
+
+    Everything here was SNAPSHOTTED at dispatch: fetching and publishing
+    window N after window N+1 changed the fleet must never mix rows —
+    the metadata (and, on the packed path, the resident batch version the
+    program read) is this window's own.
+    """
+
+    kind: str  # "packed" | "legacy"
+    out: object  # device handle(s): packed f16 array, or FleetResult
+    meta: WindowMeta | None  # packed path row layout
+    now: float  # publication timestamp (dispatch-time clock)
+    assembly_ms: float
+    dispatch_ms: float
+    h2d_rows: int
+    compiled: bool
+    # legacy path extras (training dump + dense scatter)
+    batch: object = None
+    aligned: list | None = None
+    zone_names: list | None = None
+    feat_hist: object = None
+    t_valid: object = None
 
 
 class _SeqTracker:
@@ -134,19 +174,32 @@ class FleetResults:
 
     Publication is a handful of array references — no per-workload (or
     even per-node) Python happens per window; JSON materializes lazily
-    per ``/v1/results`` request via :meth:`render_node`."""
+    per ``/v1/results`` request via :meth:`render_node`.
+
+    Arrays are indexed by ROW via ``rows[name]`` — on the packed
+    resident path nodes sit at stable row indices with holes, so
+    ``names`` is the key list, never an implicit index order.
+
+    On the packed path the per-workload matrices arrive as ONE f16
+    watts array; the µW/µJ f32 materialization (two [N, W, Z] passes)
+    is deferred to first access (``wl_power_uw``/``wl_energy_uj``
+    properties) so the window hot loop never pays it — renders slice
+    per row straight from the f16 plane."""
 
     __slots__ = ("timestamp", "zones", "names", "rows", "mode",
                  "node_power_uw", "node_energy_uj", "node_joules_total",
-                 "workload_ids", "workload_kinds", "counts",
-                 "wl_power_uw", "wl_energy_uj")
+                 "workload_ids", "workload_kinds", "counts", "dt",
+                 "_wl_watts_f16", "_wl_power_uw", "_wl_energy_uj")
 
     def __init__(self, timestamp: float, zones: list[str],
                  names: list[str], rows: dict[str, int], mode: np.ndarray,
                  node_power_uw: np.ndarray, node_energy_uj: np.ndarray,
                  node_joules_total: np.ndarray, workload_ids: list,
                  workload_kinds: list, counts: list,
-                 wl_power_uw: np.ndarray, wl_energy_uj: np.ndarray) -> None:
+                 wl_power_uw: np.ndarray | None = None,
+                 wl_energy_uj: np.ndarray | None = None,
+                 wl_watts_f16: np.ndarray | None = None,
+                 dt: np.ndarray | None = None) -> None:
         self.timestamp = timestamp
         self.zones = zones
         self.names = names
@@ -158,11 +211,35 @@ class FleetResults:
         self.workload_ids = workload_ids
         self.workload_kinds = workload_kinds
         self.counts = counts
-        self.wl_power_uw = wl_power_uw
-        self.wl_energy_uj = wl_energy_uj
+        self.dt = dt
+        self._wl_watts_f16 = wl_watts_f16
+        self._wl_power_uw = wl_power_uw
+        self._wl_energy_uj = wl_energy_uj
 
     def __contains__(self, name: str) -> bool:
         return name in self.rows
+
+    @property
+    def wl_power_uw(self) -> np.ndarray:
+        if self._wl_power_uw is None:
+            self._wl_power_uw = np.multiply(
+                self._wl_watts_f16, 1e6, dtype=np.float32)
+        return self._wl_power_uw
+
+    @property
+    def wl_energy_uj(self) -> np.ndarray:
+        if self._wl_energy_uj is None:
+            self._wl_energy_uj = self.wl_power_uw * self.dt[:, None, None]
+        return self._wl_energy_uj
+
+    def _row_wl(self, i: int, w: int) -> tuple[np.ndarray, np.ndarray]:
+        """(power_uw [w, Z], energy_uj [w, Z]) for one row — slices the
+        f16 plane directly when the full f32 planes were never forced."""
+        if self._wl_power_uw is not None:
+            return self._wl_power_uw[i, :w], self.wl_energy_uj[i, :w]
+        power = np.multiply(self._wl_watts_f16[i, :w], 1e6,
+                            dtype=np.float32)
+        return power, power * float(self.dt[i])
 
     def render_node(self, name: str) -> dict:
         """The node's JSON payload (wire schema unchanged from the
@@ -170,6 +247,7 @@ class FleetResults:
         i = self.rows[name]
         w = self.counts[i]
         kinds = self.workload_kinds[i]
+        power, energy = self._row_wl(i, w)
         return {
             "timestamp": self.timestamp,
             "zones": list(self.zones),
@@ -186,8 +264,8 @@ class FleetResults:
                 }
                 for k, (wid, p, e) in enumerate(zip(
                     self.workload_ids[i],
-                    self.wl_power_uw[i, :w].tolist(),
-                    self.wl_energy_uj[i, :w].tolist()))
+                    power.tolist(),
+                    energy.tolist()))
             ],
         }
 
@@ -213,6 +291,8 @@ class Aggregator:
         degraded_ttl: float = 60.0,
         dedup_window: int = 1024,
         delivery_buckets: Sequence[float] | None = None,
+        pipeline_depth: int = 1,
+        bucket_shrink_after: int = 16,
         clock=None,
         mesh=None,
     ) -> None:
@@ -299,12 +379,19 @@ class Aggregator:
                        "duplicates_total": 0, "windows_lost_total": 0,
                        "attributions_total": 0, "last_batch_nodes": 0,
                        "last_batch_workloads": 0,
-                       # whole-window latency (assembly + device + scatter)
+                       # whole-window cost (sum of the legs below — in
+                       # pipelined mode wall time spans two calls, so the
+                       # sum is the honest per-window figure)
                        "last_attribution_ms": 0.0,
                        # its legs, so a regression is attributable
                        "last_assembly_ms": 0.0,
                        "last_device_ms": 0.0,
-                       "last_scatter_ms": 0.0}
+                       "last_scatter_ms": 0.0,
+                       # pipelined-window legs + delta-H2D accounting
+                       "last_dispatch_ms": 0.0,
+                       "last_wait_ms": 0.0,
+                       "last_h2d_rows": 0,
+                       "window_compiles_total": 0}
         # cumulative per-node energy for _total counters: a shared dense
         # RowStore (the same machinery as the monitor's per-workload
         # accumulators) whose columns follow the canonical zone axis and
@@ -314,9 +401,24 @@ class Aggregator:
         self._cum_zones: list[str] = []
         self._cum_last_seen: dict[str, float] = {}
         self._cum_retention = max(stale_after * 20.0, 600.0)
-        self._program = None  # jitted once; jax caches per input shape
+        self._program = None  # legacy-path jit; jax caches per input shape
         # untrained fallbacks per zone count — never clobber trained params
         self._fallback_params: dict[int, object] = {}
+        # -- window pipeline (fleet.window) --------------------------------
+        # depth 1 = serial (dispatch then fetch in the same call, the
+        # library-call contract every aggregate_once() test relies on);
+        # depth D ≥ 2 keeps D−1 windows in flight: the fetch/scatter of
+        # window N overlaps window N+1's assembly+dispatch, and published
+        # results are at most D−1 intervals stale. The deque normally
+        # belongs to the aggregation loop alone, but shutdown() may drain
+        # it from the lifecycle thread when the runner overruns its join
+        # timeout — _pipeline_lock serializes those drains (uncontended
+        # in steady state; never held during dispatch).
+        self._pipeline_depth = max(1, int(pipeline_depth))
+        self._bucket_shrink_after = max(1, int(bucket_shrink_after))
+        self._pipeline_lock = threading.Lock()
+        self._inflight: collections.deque[_Pending] = collections.deque()  # keplint: guarded-by=_pipeline_lock
+        self._engine: PackedWindowEngine | None = None
 
     def name(self) -> str:
         return "fleet-aggregator"
@@ -361,14 +463,22 @@ class Aggregator:
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
             if ctx.wait(self._interval):
-                return
+                break
             try:
                 self.aggregate_once()
             except Exception:
                 log.exception("fleet aggregation failed")
+        # deterministic drain: every dispatched window is published before
+        # the loop exits — no result is abandoned in flight on shutdown
+        try:
+            self._drain_pipeline()
+        except Exception:
+            log.exception("fleet pipeline drain failed")
 
     def shutdown(self) -> None:
-        pass
+        # idempotent with the run()-exit drain (the deque is empty then);
+        # covers direct aggregate_once() users who never ran the loop
+        self._drain_pipeline()
 
     # -- ingest ------------------------------------------------------------
 
@@ -650,17 +760,22 @@ class Aggregator:
 
     # -- aggregation -------------------------------------------------------
 
-    def aggregate_once(self) -> FleetResult | None:
-        """One fleet batch: align zones, pad, run the sharded program.
+    def aggregate_once(self) -> "FleetResults | None":
+        """One pipeline step: dispatch this interval's window, publish the
+        oldest in-flight one.
 
-        The window is measured in three legs (assembly → device →
-        scatter) and the device leg is ASYNC-dispatched: host work that
-        doesn't need the outputs (cumulative-store pruning, result-dict
-        skeletons) overlaps the device computation, and the single
-        blocking point is the output fetch. The scatter is column-wise —
-        per-node array views published as-is; JSON materializes lazily in
-        ``/v1/results`` (VERDICT r3 weak #3: the old per-workload dict
-        scatter was O(nodes × workloads) Python per window).
+        At ``pipeline_depth`` 1 (the constructor default) the two halves
+        run back-to-back — classic serial semantics, every call publishes
+        the window it assembled. At depth D ≥ 2 the dispatched window
+        stays in flight while the PREVIOUS window is fetched, scattered,
+        and published: the device computes window N while the host
+        assembles N+1, and the blocking fetch (``window.pipeline_wait``)
+        only pays whatever the device hasn't already finished. Returns
+        the published :class:`FleetResults` (None when nothing published
+        yet — the pipeline is still filling).
+
+        An empty fleet drains the pipeline instead of dispatching, so
+        results never rot in flight when reports stop.
         """
         t_win = _time.perf_counter()
         now = self._clock()
@@ -678,66 +793,106 @@ class Aggregator:
                          if now - e["last_at"] > self._degraded_ttl]:
                 del self._degraded[name]
         if not live:
-            return None
+            return self._drain_pipeline()
         # one telemetry cycle per non-empty fleet window, with the
-        # assembly/device/scatter legs as stages (the same legs the
+        # assembly/h2d/compile/wait legs as stages (the same legs the
         # last_*_ms stats report — the histograms add distribution)
         with telemetry.span("aggregator.window"):
-            return self._attribute_window(live, now, t_win)
+            stored_sorted = sorted(live.values(),
+                                   key=lambda s: s.report.node_name)
+            zone_names = sorted(
+                {z for s in stored_sorted for z in s.zone_names})
+            if self._use_packed():
+                pending = self._dispatch_packed(stored_sorted, zone_names,
+                                                now, t_win)
+            else:
+                pending = self._dispatch_legacy(stored_sorted, zone_names,
+                                                now, t_win)
+            with self._pipeline_lock:
+                self._inflight.append(pending)
+                # prune cumulative totals while the device computes —
+                # host work needing no outputs overlaps the window
+                for name, seen in list(self._cum_last_seen.items()):
+                    if now - seen > self._cum_retention:
+                        del self._cum_last_seen[name]
+                        self._cum.pop(name)
+                published = None
+                while len(self._inflight) >= self._pipeline_depth:
+                    published = self._publish(self._inflight.popleft())
+                return published
 
-    def _attribute_window(self, live: dict, now: float,
-                          t_win: float) -> FleetResult:
-        # canonical zone axis = sorted union of reported zone names; nodes
-        # missing a zone keep their row with that zone masked invalid.
-        # Alignment is GROUPED: nodes sharing a zone tuple (in practice the
-        # whole fleet) scatter into the canonical matrix with one stacked
-        # fancy-index per group — no per-node zone arrays.
-        zone_names = sorted({z for s in live.values() for z in s.zone_names})
-        z_index = {z: i for i, z in enumerate(zone_names)}
-        n_zones = len(zone_names)
-        stored_sorted = sorted(live.values(),
-                               key=lambda s: s.report.node_name)
-        aligned = [s.report for s in stored_sorted]
-        n_live = len(aligned)
-        zd_mat = np.empty((n_live, n_zones), np.float32)
-        zv_mat = np.empty((n_live, n_zones), bool)
-        first_zones = stored_sorted[0].zone_names
-        if all(s.zone_names is first_zones or s.zone_names == first_zones
-               for s in stored_sorted):
-            # homogeneous fleet (the normal case): one stacked fill —
-            # np.stack gathers the 1k tiny rows in C; the per-row
-            # assignment loop it replaces cost ~3 ms of the ~9 ms
-            # assembly leg at 1024 nodes
-            zd_mat = np.stack([r.zone_deltas_uj for r in aligned]).astype(
-                np.float32, copy=False)
-            zv_mat = np.stack([r.zone_valid for r in aligned]).astype(
-                bool, copy=False)
-            perm = np.asarray([z_index[z] for z in first_zones])
-            inv = np.empty_like(perm)
-            inv[perm] = np.arange(n_zones)
-            zd_mat = zd_mat[:, inv]
-            zv_mat = zv_mat[:, inv]
+    def _use_packed(self) -> bool:
+        """Packed-f16 resident path is the default; the serial einsum-f32
+        path serves accuracy mode (the 0.5%-budget validation config),
+        temporal mode (no packed layout for [N, W, T, F] histories), and
+        training-dump capture (which needs the assembled host batch)."""
+        return (not self._accuracy_mode and self._model_mode != "temporal"
+                and not self._dump_dir)
+
+    def _drain_pipeline(self) -> "FleetResults | None":
+        published = None
+        with self._pipeline_lock:
+            while self._inflight:
+                published = self._publish(self._inflight.popleft())
+        return published
+
+    # -- dispatch half ------------------------------------------------------
+
+    def _dispatch_packed(self, stored_sorted: list, zone_names: list[str],
+                         now: float, t_win: float) -> _Pending:
+        """Sync the device-resident packed batch (delta H2D) and dispatch
+        the packed-f16 program asynchronously."""
+        if self._engine is None:
+            self._engine = PackedWindowEngine(
+                self._mesh, backend=self._backend,
+                model_mode=self._model_mode,
+                node_bucket=self._node_bucket,
+                workload_bucket=self._workload_bucket,
+                shrink_after=self._bucket_shrink_after,
+                staging_slots=self._pipeline_depth + 1)
+        rows = [
+            RowInput(name=s.report.node_name, report=s.report,
+                     zone_names=s.zone_names,
+                     ident=((s.run, s.seq) if s.run and s.seq > 0
+                            else None))
+            for s in stored_sorted]
+        params = self._params_for_zones(len(zone_names))
+        if params is None:
+            params = np.zeros((), np.float32)  # ratio-only: unused leaf
+        with telemetry.span("window.h2d_delta"):
+            plan = self._engine.plan_window(rows, zone_names, params)
+        t_planned = _time.perf_counter()
+        if plan.cold:
+            # first dispatch of this (buckets, zones, mode) key: the call
+            # blocks on trace+XLA-compile; execution itself stays async
+            with telemetry.span("window.compile"):
+                out = plan.program(*plan.args)
         else:
-            zd_mat[:] = 0.0
-            zv_mat[:] = False
-            groups: dict[tuple[str, ...], list[int]] = {}
-            for i, s in enumerate(stored_sorted):
-                groups.setdefault(s.zone_names, []).append(i)
-            for ztuple, idxs in groups.items():
-                perm = np.asarray([z_index[z] for z in ztuple])
-                rows = np.asarray(idxs)
-                zd_mat[rows[:, None], perm] = np.stack(
-                    [np.asarray(aligned[i].zone_deltas_uj, np.float32)
-                     for i in idxs])
-                zv_mat[rows[:, None], perm] = np.stack(
-                    [np.asarray(aligned[i].zone_valid, bool)
-                     for i in idxs])
+            out = plan.program(*plan.args)
+        copy_async = getattr(out, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()  # D2H queues behind the compute, off the host
+        t_dispatched = _time.perf_counter()
+        return _Pending(
+            kind="packed", out=out, meta=plan.meta, now=now,
+            assembly_ms=(t_planned - t_win) * 1e3,
+            dispatch_ms=(t_dispatched - t_planned) * 1e3,
+            h2d_rows=plan.h2d_rows, compiled=plan.cold)
 
+    def _dispatch_legacy(self, stored_sorted: list, zone_names: list[str],
+                         now: float, t_win: float) -> _Pending:
+        """Serial-path dispatch: full assemble, one big H2D, the sharded
+        einsum/temporal program, async output copies."""
+        aligned = [s.report for s in stored_sorted]
+        n_zones = len(zone_names)
+        zd_mat, zv_mat = align_zone_matrices(
+            aligned, [s.zone_names for s in stored_sorted], zone_names)
         batch = assemble_fleet_batch(
             aligned, n_zones=n_zones, node_bucket=self._node_bucket,
             workload_bucket=self._workload_bucket,
             zone_deltas_mat=zd_mat, zone_valid_mat=zv_mat)
-        if self._program is None:
+        cold = self._program is None
+        if cold:
             if self._model_mode == "temporal":
                 self._program = make_temporal_fleet_program(
                     self._mesh, backend=self._backend,
@@ -755,38 +910,143 @@ class Aggregator:
         t_assembled = _time.perf_counter()
         # ASYNC dispatch: jax returns device futures immediately; the D2H
         # copies start NOW (they queue behind the compute on the device
-        # stream) instead of at the np.asarray fetch below, so transfer
-        # overlaps the host work in between
-        result = run_fleet_attribution(program, batch, params,
-                                       feat_hist, t_valid)
+        # stream) instead of at the np.asarray fetch in _publish. The
+        # FIRST dispatch blocks on trace + XLA compile — time it as the
+        # window.compile stage (later per-shape recompiles hide inside
+        # jax's own cache and are not individually attributable here;
+        # the packed path's keyed program cache counts those exactly)
+        if cold:
+            with telemetry.span("window.compile"):
+                result = run_fleet_attribution(program, batch, params,
+                                               feat_hist, t_valid)
+        else:
+            result = run_fleet_attribution(program, batch, params,
+                                           feat_hist, t_valid)
         for arr in (result.node_power_uw, result.node_energy_uj,
                     result.workload_power_uw, result.workload_energy_uj):
             copy_async = getattr(arr, "copy_to_host_async", None)
             if copy_async is not None:
                 copy_async()
-        # ---- host work that overlaps the device computation ----
-        # prune cumulative totals only after prolonged total silence
-        for name, seen in list(self._cum_last_seen.items()):
-            if now - seen > self._cum_retention:
-                del self._cum_last_seen[name]
-                self._cum.pop(name)
+        t_dispatched = _time.perf_counter()
+        return _Pending(
+            kind="legacy", out=result, meta=None, now=now,
+            assembly_ms=(t_assembled - t_win) * 1e3,
+            dispatch_ms=(t_dispatched - t_assembled) * 1e3,
+            h2d_rows=batch.n_nodes, compiled=cold,
+            batch=batch, aligned=aligned, zone_names=zone_names,
+            feat_hist=feat_hist, t_valid=t_valid)
+
+    # -- publish half -------------------------------------------------------
+
+    # keplint: requires-lock=_pipeline_lock
+    def _publish(self, p: _Pending) -> "FleetResults":
+        """Fetch one in-flight window (the pipeline's only blocking point),
+        scatter it into a :class:`FleetResults`, publish, account legs.
+        Holding the pipeline lock keeps a lifecycle-thread drain from
+        interleaving publishes (out-of-order ``_results``) with the
+        aggregation loop's own."""
+        t0 = _time.perf_counter()
+        if p.kind == "packed":
+            with telemetry.span("window.pipeline_wait"):
+                packed = np.asarray(p.out)
+            t_fetched = _time.perf_counter()
+            results = self._scatter_packed(p, packed)
+        else:
+            result = p.out
+            with telemetry.span("window.pipeline_wait"):
+                node_power = np.asarray(result.node_power_uw)
+                node_energy = np.asarray(result.node_energy_uj)
+                wl_power = np.asarray(result.workload_power_uw)
+                wl_energy = np.asarray(result.workload_energy_uj)
+            t_fetched = _time.perf_counter()
+            results = self._scatter_legacy(p, node_power, node_energy,
+                                           wl_power, wl_energy)
+        t_done = _time.perf_counter()
+        wait_ms = (t_fetched - t0) * 1e3
+        scatter_ms = (t_done - t_fetched) * 1e3
+        n_workloads = sum(results.counts)
+        with self._results_lock:
+            self._results = results
+            self._last_window_at = p.now
+            self._stats["attributions_total"] += 1
+            self._stats["last_batch_nodes"] = len(results.names)
+            self._stats["last_batch_workloads"] = int(n_workloads)
+            self._stats["last_assembly_ms"] = p.assembly_ms
+            self._stats["last_dispatch_ms"] = p.dispatch_ms
+            self._stats["last_wait_ms"] = wait_ms
+            self._stats["last_device_ms"] = p.dispatch_ms + wait_ms
+            self._stats["last_scatter_ms"] = scatter_ms
+            self._stats["last_attribution_ms"] = (
+                p.assembly_ms + p.dispatch_ms + wait_ms + scatter_ms)
+            self._stats["last_h2d_rows"] = p.h2d_rows
+            if self._engine is not None:
+                self._stats["window_compiles_total"] = \
+                    self._engine.compile_count
+        log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms "
+                  "(h2d rows %d)", len(results.names), n_workloads,
+                  self._stats["last_attribution_ms"], p.h2d_rows)
+        if p.kind == "legacy" and self._dump_dir:
+            # AFTER results publication — file I/O must not delay /v1/results
+            try:
+                self._dump_training_window(p.batch, wl_power, p.zone_names,
+                                           p.now, p.feat_hist, p.t_valid)
+            except OSError as err:
+                log.warning("training dump failed: %s", err)
+        return results
+
+    def _scatter_packed(self, p: _Pending,
+                        packed: np.ndarray) -> "FleetResults":
+        """One f16 D2H array → the published column-oriented results.
+
+        All arrays are indexed by RESIDENT ROW (``results.rows`` maps
+        names to rows — free rows simply hold zeros); node energy is
+        reconstituted as power × dt, which is exact for ratio nodes
+        (their power was measured energy / dt) and definitional for
+        model nodes, modulo the f16 watt quantization the accuracy bench
+        budgets at ≤ 0.5%.
+        """
+        from kepler_tpu.parallel.packed import unpack_fleet_window
+
+        m = p.meta
+        wl_watts, _active_w, total_w = unpack_fleet_window(packed)
+        node_power = np.multiply(total_w, 1e6, dtype=np.float32)  # W → µW
+        node_energy = node_power * m.dt[:, None]  # µW·s = µJ
+        row_idx = np.asarray([m.rows[name] for name in m.names],
+                             np.intp)
+        joules = np.zeros_like(node_power)
+        if row_idx.size:
+            joules[row_idx] = self._accumulate_node_energy(
+                m.names, m.zones, node_energy[row_idx], p.now)
+        return FleetResults(
+            timestamp=p.now,
+            zones=m.zones,
+            names=m.names,
+            rows=m.rows,
+            mode=m.mode,
+            node_power_uw=node_power,
+            node_energy_uj=node_energy,
+            node_joules_total=joules,
+            workload_ids=m.ids,
+            workload_kinds=m.kinds,
+            counts=m.counts,
+            wl_watts_f16=wl_watts,
+            dt=m.dt,
+        )
+
+    def _scatter_legacy(self, p: _Pending, node_power, node_energy,
+                        wl_power, wl_energy) -> "FleetResults":
+        """Dense-layout scatter: per-node array views published as-is;
+        JSON materializes lazily in ``/v1/results`` (VERDICT r3 weak #3:
+        the old per-workload dict scatter was O(nodes × workloads)
+        Python per window)."""
+        batch = p.batch
         n_real = batch.n_nodes
-        kinds_by_node: list[np.ndarray | None] = [
-            a.workload_kinds for a in aligned]
-        # ---- the one blocking point: fetch the outputs ----
-        node_power = np.asarray(result.node_power_uw)
-        node_energy = np.asarray(result.node_energy_uj)
-        wl_power = np.asarray(result.workload_power_uw)
-        wl_energy = np.asarray(result.workload_energy_uj)
-        t_fetched = _time.perf_counter()
-        # ---- vectorized scatter: one gather-add-scatter on the
-        # cumulative matrix, one column-oriented published object ------
         names = batch.node_names[:n_real]
-        joules = self._accumulate_node_energy(names, zone_names,
-                                              node_energy[:n_real], now)
-        results = FleetResults(
-            timestamp=now,
-            zones=zone_names,  # shared ref; treated immutable
+        joules = self._accumulate_node_energy(names, p.zone_names,
+                                              node_energy[:n_real], p.now)
+        return FleetResults(
+            timestamp=p.now,
+            zones=p.zone_names,  # shared ref; treated immutable
             names=names,
             rows={name: i for i, name in enumerate(names)},
             mode=batch.mode,
@@ -794,34 +1054,11 @@ class Aggregator:
             node_energy_uj=node_energy,
             node_joules_total=joules,
             workload_ids=batch.workload_ids,
-            workload_kinds=kinds_by_node,
+            workload_kinds=[a.workload_kinds for a in p.aligned],
             counts=batch.workload_counts,
             wl_power_uw=wl_power,
             wl_energy_uj=wl_energy,
         )
-        t_done = _time.perf_counter()
-        with self._results_lock:
-            self._results = results
-            self._last_window_at = now
-            self._stats["attributions_total"] += 1
-            self._stats["last_batch_nodes"] = n_real
-            self._stats["last_batch_workloads"] = int(
-                batch.workload_valid.sum())
-            self._stats["last_assembly_ms"] = (t_assembled - t_win) * 1e3
-            self._stats["last_device_ms"] = (t_fetched - t_assembled) * 1e3
-            self._stats["last_scatter_ms"] = (t_done - t_fetched) * 1e3
-            self._stats["last_attribution_ms"] = (t_done - t_win) * 1e3
-        log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms",
-                  n_real, self._stats["last_batch_workloads"],
-                  self._stats["last_attribution_ms"])
-        if self._dump_dir:
-            # AFTER results publication — file I/O must not delay /v1/results
-            try:
-                self._dump_training_window(batch, wl_power, zone_names, now,
-                                           feat_hist, t_valid)
-            except OSError as err:
-                log.warning("training dump failed: %s", err)
-        return result
 
     def _accumulate_node_energy(self, names: list[str],
                                 zone_names: list[str],
@@ -1057,12 +1294,28 @@ class Aggregator:
         yield lat
         legs = GaugeMetricFamily(
             "kepler_fleet_window_leg_ms",
-            "Last fleet window's latency by leg",
+            "Last fleet window's latency by leg (device = dispatch + "
+            "pipeline wait; assembly includes the delta-H2D staging)",
             labels=["leg"])
         legs.add_metric(["assembly"], stats["last_assembly_ms"])
         legs.add_metric(["device"], stats["last_device_ms"])
+        legs.add_metric(["dispatch"], stats["last_dispatch_ms"])
+        legs.add_metric(["wait"], stats["last_wait_ms"])
         legs.add_metric(["scatter"], stats["last_scatter_ms"])
         yield legs
+        h2d_rows = GaugeMetricFamily(
+            "kepler_fleet_window_h2d_rows",
+            "Node rows re-uploaded (delta H2D) for the last fleet window "
+            "— 0 when the resident device batch was already current")
+        h2d_rows.add_metric([], stats["last_h2d_rows"])
+        yield h2d_rows
+        compiles = CounterMetricFamily(
+            "kepler_fleet_window_compiles_total",
+            "Fleet-window program-cache misses — attribution programs "
+            "AND delta scatter-updates (bucket-ladder shape changes; "
+            "growth is geometric, shrink is hysteretic)")
+        compiles.add_metric([], stats["window_compiles_total"])
+        yield compiles
         total = CounterMetricFamily(
             "kepler_fleet_attributions_total", "Completed fleet attributions")
         total.add_metric([], stats["attributions_total"])
@@ -1125,7 +1378,10 @@ class Aggregator:
             labels=["node_name", "zone", "mode"])
         if results is not None:
             zones = results.zones
-            for i, name in enumerate(results.names):
+            for name in results.names:
+                # rows map, not enumerate: the packed-resident layout
+                # keeps nodes at stable row indices with holes
+                i = results.rows[name]
                 mode = "model" if results.mode[i] else "ratio"
                 power = results.node_power_uw[i]
                 joules = results.node_joules_total[i]

@@ -1,0 +1,509 @@
+"""Device-resident pipelined fleet windows: the aggregator's hot-path engine.
+
+The serial window cycle (assemble → one big H2D → dispatch → fetch) pays
+three costs every interval that this module removes:
+
+* **Re-allocation + full H2D per window.** The padded packed batch is kept
+  RESIDENT on device. Each window, only the rows of nodes whose report
+  actually changed since the last window are re-packed on host and
+  scatter-updated into the resident array through a ``donate_argnums``
+  program — the update writes in place (no per-window batch allocation),
+  and a churn burst or partial window uploads only its slice
+  (``window.h2d_delta``). The donated handle is dead after the call; the
+  engine rebinds (``resident = update(resident, …)``) — keplint KTL110
+  enforces that discipline lexically.
+
+* **Recompile thrash on fleet growth.** Padded shapes come from
+  :class:`BucketLadder`\\ s: buckets grow geometrically (so a growing
+  fleet crosses O(log N) shapes, ever) and only SHRINK after
+  ``shrink_after`` consecutive windows at under half occupancy — a fleet
+  oscillating around a bucket edge never flip-flops compilations.
+  Programs are cached per (node-bucket, workload-bucket, zones, mode)
+  key and compile events are counted and surfaced
+  (``window.compile``, ``kepler_fleet_window_compiles_total``).
+
+* **Dense mixed-fleet evaluation.** With a model mode set, the packed
+  program runs the estimator sparsely: only MODE_MODEL rows are gathered
+  through a bucketed ``model_rows`` index vector (bit-identical results —
+  see ``parallel.packed``), halving the device leg on a 50/50 fleet.
+
+The engine owns no locks and no HTTP: :class:`Aggregator` snapshots the
+report store, hands the engine plain :class:`RowInput`\\ s, and overlaps
+the returned dispatch handle with the next window's host work (the
+depth-2 pipeline lives in ``fleet.aggregator``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple, Sequence
+
+import numpy as np
+
+from kepler_tpu.parallel.fleet import (MODE_MODEL, NodeReport,
+                                       assemble_fleet_batch)
+
+__all__ = [
+    "BucketLadder",
+    "PackedWindowEngine",
+    "RowInput",
+    "WindowMeta",
+    "WindowPlan",
+    "align_zone_matrices",
+]
+
+# per-buffer row-content sentinels: _EMPTY = the device row is the packed
+# empty row (cleared / never filled); _DIRTY = unknown content, must be
+# re-staged before the buffer serves again (set on cross-buffer row
+# reassignment). Compared by identity — they never equal a (run, seq).
+_EMPTY = object()
+_DIRTY = object()
+
+
+class BucketLadder:
+    """Geometric bucket sizing with shrink hysteresis.
+
+    ``fit(need)`` returns the current bucket, growing it by doubling
+    whenever ``need`` exceeds it (growth is immediate: a window must
+    never be truncated) and shrinking it — one halving step at a time —
+    only after ``shrink_after`` CONSECUTIVE fits at ≤ half occupancy.
+    The bucket never drops below ``base``, and ``base`` is rounded up to
+    a multiple of ``align`` (the mesh's node-axis size for the node
+    ladder) so every rung stays evenly shardable.
+    """
+
+    __slots__ = ("base", "align", "shrink_after", "bucket", "_under")
+
+    def __init__(self, base: int, shrink_after: int, align: int = 1) -> None:
+        align = max(1, int(align))
+        base = max(1, int(base))
+        if base % align:
+            base = (base // align + 1) * align
+        self.base = base
+        self.align = align
+        self.shrink_after = max(1, int(shrink_after))
+        self.bucket = base
+        self._under = 0
+
+    def fit(self, need: int) -> int:
+        need = max(1, int(need))
+        if need > self.bucket:
+            while self.bucket < need:
+                self.bucket *= 2
+            self._under = 0
+        elif self.bucket > self.base and need <= self.bucket // 2:
+            self._under += 1
+            if self._under >= self.shrink_after:
+                self.bucket = max(self.base, self.bucket // 2)
+                self._under = 0
+        else:
+            self._under = 0
+        return self.bucket
+
+
+class RowInput(NamedTuple):
+    """One live node's contribution to a window, as the engine sees it.
+
+    A NamedTuple, not a dataclass: the aggregator builds one per node
+    per window and frozen-dataclass construction alone costs real
+    milliseconds at 1k nodes.
+    """
+
+    name: str
+    report: NodeReport
+    zone_names: tuple[str, ...]
+    # data identity: (run, seq) for nonce-carrying agents. None = no
+    # identity (pre-nonce agent) → the row is re-uploaded every window.
+    ident: tuple[str, int] | None
+
+
+@dataclass
+class WindowMeta:
+    """Per-window snapshot of the resident row layout (immutable once
+    captured — the next window's sync mutates the engine, not this)."""
+
+    zones: list[str]
+    names: list[str]  # live node names (publication order)
+    rows: dict[str, int]  # name → resident row index
+    mode: np.ndarray  # int32 [N]
+    dt: np.ndarray  # f32 [N] per-row report interval
+    counts: list[int]  # per-ROW real workload count
+    ids: list[list[str]]  # per-ROW workload ids
+    kinds: list[np.ndarray | None]  # per-ROW workload kinds
+    n_live: int
+    n_rows: int
+
+
+@dataclass
+class WindowPlan:
+    """Everything the caller needs to dispatch one window."""
+
+    program: Callable
+    args: tuple  # (params, resident_batch[, model_rows])
+    cold: bool  # True → dispatching compiles (time it as window.compile)
+    meta: WindowMeta
+    h2d_rows: int  # rows staged + uploaded this window (delta or full)
+
+
+def align_zone_matrices(reports: Sequence[NodeReport],
+                        zone_tuples: Sequence[tuple[str, ...]],
+                        zone_names: Sequence[str]) -> tuple[np.ndarray,
+                                                            np.ndarray]:
+    """Ragged per-node zone arrays → canonical [n, Z] matrices.
+
+    Alignment is GROUPED: nodes sharing a zone tuple (in practice the
+    whole fleet) scatter into the canonical matrix with one stacked
+    fancy-index per group — no per-node zone arrays. The homogeneous
+    case is one stacked fill + a column permutation.
+    """
+    z_index = {z: i for i, z in enumerate(zone_names)}
+    n_zones = len(zone_names)
+    n = len(reports)
+    zd_mat = np.empty((n, n_zones), np.float32)
+    zv_mat = np.empty((n, n_zones), bool)
+    if n == 0:
+        return zd_mat, zv_mat
+    first = zone_tuples[0]
+    if all(zt is first or zt == first for zt in zone_tuples):
+        # homogeneous batch (the normal case): one stacked fill scattered
+        # through the shared column permutation. The batch may cover only
+        # PART of the canonical axis (a delta slice while some other node
+        # reports an extra zone), so absent columns stay zero/invalid.
+        stacked_zd = np.stack([r.zone_deltas_uj for r in reports]).astype(
+            np.float32, copy=False)
+        stacked_zv = np.stack([r.zone_valid for r in reports]).astype(
+            bool, copy=False)
+        perm = np.asarray([z_index[z] for z in first])
+        zd_mat[:] = 0.0
+        zv_mat[:] = False
+        zd_mat[:, perm] = stacked_zd
+        zv_mat[:, perm] = stacked_zv
+        return zd_mat, zv_mat
+    zd_mat[:] = 0.0
+    zv_mat[:] = False
+    groups: dict[tuple[str, ...], list[int]] = {}
+    for i, zt in enumerate(zone_tuples):
+        groups.setdefault(zt, []).append(i)
+    for ztuple, idxs in groups.items():
+        perm = np.asarray([z_index[z] for z in ztuple])
+        rows = np.asarray(idxs)
+        zd_mat[rows[:, None], perm] = np.stack(
+            [np.asarray(reports[i].zone_deltas_uj, np.float32)
+             for i in idxs])
+        zv_mat[rows[:, None], perm] = np.stack(
+            [np.asarray(reports[i].zone_valid, bool) for i in idxs])
+    return zd_mat, zv_mat
+
+
+class PackedWindowEngine:
+    """Resident packed batch + program/update cache for the default
+    (packed-f16) fleet path. Single-threaded by contract: only the
+    aggregation loop calls :meth:`plan_window`."""
+
+    # program-cache bound: ladder moves retire old shapes; keep a few
+    # around for oscillation, evict the oldest beyond this
+    _CACHE_CAP = 32
+
+    def __init__(self, mesh, backend: str = "einsum",
+                 model_mode: str | None = None,
+                 node_bucket: int = 8, workload_bucket: int = 256,
+                 shrink_after: int = 16, staging_slots: int = 2) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from kepler_tpu.parallel.mesh import NODE_AXIS
+
+        self._jax = jax
+        self._mesh = mesh
+        self._backend = backend
+        self._model_mode = model_mode
+        n_dev = mesh.devices.size
+        self._ladder_n = BucketLadder(node_bucket, shrink_after, align=n_dev)
+        self._ladder_w = BucketLadder(workload_bucket, shrink_after)
+        self._ladder_m = BucketLadder(max(8, n_dev), shrink_after)
+        self._ladder_d = BucketLadder(8, shrink_after)
+        # sparse model evaluation needs the einsum gather path
+        self._sparse = bool(model_mode) and backend == "einsum"
+        self._sh_batch = NamedSharding(mesh, P(NODE_AXIS, None))
+        self._sh_repl = NamedSharding(mesh, P())
+        self._programs: dict[tuple, list] = {}  # key → [program, cold]
+        self._updates: dict[tuple, list] = {}  # (n, width, db) → [fn, cold]
+        self.compile_count = 0  # program-cache misses (attribution + update)
+
+        # resident state (invalid until the first plan_window). The
+        # resident batch is PING-PONGED across `staging_slots` device
+        # buffers: the donated in-place update must never target a buffer
+        # an in-flight window still reads (donation with outstanding
+        # readers blocks the host on CPU PJRT — measured at the full
+        # device-leg cost — and would alias on a stream-ordered backend
+        # only by luck). Each buffer tracks its own per-row content
+        # identity, so the delta staged into buffer B covers everything
+        # that changed since B last served.
+        self._key: tuple | None = None  # (n_bucket, w_bucket, zones)
+        self._buffers: list = []  # device f32 [N, width] ring
+        self._content: list[list] = []  # per-buffer per-row ident/_EMPTY/_DIRTY
+        self._buf_i = 0
+        self._names: list[str | None] = []
+        self._row_of: dict[str, int] = {}
+        # python lists, not np arrays: the per-row bookkeeping loop does
+        # thousands of scalar writes per window and np scalar assignment
+        # is ~10× a list store; meta snapshots convert once in C
+        self._mode: list[int] = []
+        self._dt: list[float] = []
+        self._counts: list[int] = []
+        self._ids: list[list[str]] = []
+        self._kinds: list[np.ndarray | None] = []
+        self._free: list[int] = []
+        self._empty_row = np.zeros(0, np.float32)
+        # reusable HOST staging arrays, rotated per window: a slot is
+        # only rewritten after the window that uploaded from it has been
+        # fetched (the H2D provably completed), so an async transfer can
+        # never observe a half-rewritten source. One slot per pipeline
+        # stage plus one covers any depth ≤ staging_slots. The slot count
+        # also sizes the device buffer ring.
+        self._stages: list[np.ndarray] = [
+            np.zeros((0, 0), np.float32)
+            for _ in range(max(2, staging_slots))]
+        self._stage_i = 0
+
+    # -- program/update caches ---------------------------------------------
+
+    def _program_for(self, nb: int, wb: int, z: int,
+                     mb: int | None) -> list:
+        key = (nb, wb, z, self._model_mode or "", mb)
+        entry = self._programs.get(key)
+        if entry is None:
+            from kepler_tpu.parallel.packed import make_packed_fleet_program
+
+            program = make_packed_fleet_program(
+                self._mesh, n_workloads=wb, n_zones=z,
+                model_mode=self._model_mode, backend=self._backend,
+                model_bucket=mb)
+            entry = [program, True]
+            self._programs[key] = entry
+            self.compile_count += 1
+            while len(self._programs) > self._CACHE_CAP:
+                self._programs.pop(next(iter(self._programs)))
+        return entry
+
+    def _update_for(self, n: int, width: int, db: int) -> list:
+        key = (n, width, db)
+        entry = self._updates.get(key)
+        if entry is None:
+            jax = self._jax
+
+            def scatter_rows(resident, rows, idx):
+                # index n (the pad value) is out of bounds → dropped
+                return resident.at[idx].set(rows, mode="drop")
+
+            fn = jax.jit(
+                scatter_rows, donate_argnums=(0,),
+                in_shardings=(self._sh_batch, self._sh_repl, self._sh_repl),
+                out_shardings=self._sh_batch)
+            entry = [fn, True]
+            self._updates[key] = entry
+            self.compile_count += 1
+            while len(self._updates) > self._CACHE_CAP:
+                self._updates.pop(next(iter(self._updates)))
+        return entry
+
+    # -- window planning ---------------------------------------------------
+
+    def plan_window(self, rows: Sequence[RowInput],
+                    zone_names: Sequence[str], params: Any) -> WindowPlan:
+        """Sync the resident batch to ``rows`` and return the dispatchable
+        plan. The donated update (if any) runs HERE; the caller dispatches
+        ``plan.program(*plan.args)`` (timing the compile when ``cold``)."""
+        zones_t = tuple(zone_names)
+        z = len(zones_t)
+        need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
+        wb = self._ladder_w.fit(need_w)
+        nb = self._ladder_n.fit(len(rows))
+        key = (nb, wb, zones_t)
+        if key != self._key or not self._buffers:
+            h2d_rows = self._rebuild(rows, nb, wb, zones_t)
+        else:
+            # rotate to the least-recently-read buffer BEFORE updating:
+            # its in-flight readers (if any) are ≥ staging_slots windows
+            # old and therefore already fetched, so the donated in-place
+            # scatter neither blocks nor aliases live reads
+            self._buf_i = (self._buf_i + 1) % len(self._buffers)
+            h2d_rows = self._delta_sync(rows, zones_t)
+        meta = WindowMeta(
+            zones=list(zones_t),
+            names=[r.name for r in rows],
+            rows=dict(self._row_of),
+            mode=np.asarray(self._mode, np.int32),
+            dt=np.asarray(self._dt, np.float32),
+            counts=list(self._counts),
+            ids=list(self._ids),
+            kinds=list(self._kinds),
+            n_live=len(rows),
+            n_rows=nb,
+        )
+        resident = self._buffers[self._buf_i]
+        args: tuple
+        mb: int | None = None
+        if self._sparse:
+            model_idx = np.flatnonzero(
+                np.asarray(self._mode, np.int32) == MODE_MODEL)
+            mb = self._ladder_m.fit(max(1, len(model_idx)))
+            idx = np.full(mb, nb, np.int32)  # pad → gather-clamped, scatter-dropped
+            idx[:len(model_idx)] = model_idx
+            args = (params, resident,
+                    self._jax.device_put(idx, self._sh_repl))
+        else:
+            args = (params, resident)
+        entry = self._program_for(nb, wb, z, mb)
+        program, cold = entry
+        entry[1] = False
+        return WindowPlan(program=program, args=args, cold=cold, meta=meta,
+                          h2d_rows=h2d_rows)
+
+    # -- resident maintenance ----------------------------------------------
+
+    def _rebuild(self, rows: Sequence[RowInput], nb: int, wb: int,
+                 zones_t: tuple[str, ...]) -> int:
+        """Full re-pack: shape key or zone axis changed (or first window)."""
+        from kepler_tpu.parallel.packed import pack_fleet_inputs, packed_width
+
+        ordered = sorted(rows, key=lambda r: r.name)
+        reports = [r.report for r in ordered]
+        zd, zv = align_zone_matrices(reports,
+                                     [r.zone_names for r in ordered],
+                                     zones_t)
+        batch = assemble_fleet_batch(reports, n_zones=len(zones_t),
+                                     node_bucket=nb, workload_bucket=wb,
+                                     zone_deltas_mat=zd, zone_valid_mat=zv)
+        packed = pack_fleet_inputs(batch)
+        if packed.shape != (nb, packed_width(wb, len(zones_t))):
+            raise AssertionError(  # ladder/assembly contract violation
+                f"packed shape {packed.shape} != resident bucket "
+                f"({nb}, {packed_width(wb, len(zones_t))})")
+        n_real = len(ordered)
+        # every ring buffer starts from this full pack (each device_put
+        # is its own device allocation), all content-current
+        self._buffers = [self._jax.device_put(packed, self._sh_batch)
+                         for _ in self._stages]
+        idents = ([r.ident for r in ordered]
+                  + [_EMPTY] * (nb - n_real))
+        self._content = [list(idents) for _ in self._buffers]
+        self._buf_i = 0
+        self._key = (nb, wb, zones_t)
+        self._names = [r.name for r in ordered] + [None] * (nb - n_real)
+        self._row_of = {r.name: i for i, r in enumerate(ordered)}
+        self._mode = batch.mode.tolist()
+        self._dt = batch.dt_s.tolist()
+        self._counts = list(batch.workload_counts)
+        self._ids = list(batch.workload_ids)
+        self._kinds = ([r.workload_kinds for r in reports]
+                       + [None] * (nb - n_real))
+        self._free = list(range(nb - 1, n_real - 1, -1))
+        width = packed.shape[1]
+        self._empty_row = np.zeros(width, np.float32)
+        self._empty_row[:wb] = np.nan  # no valid workloads
+        self._stages = [np.zeros((0, width), np.float32)
+                        for _ in self._stages]
+        return n_real
+
+    def _delta_sync(self, rows: Sequence[RowInput],
+                    zones_t: tuple[str, ...]) -> int:
+        """Bring the CURRENT ring buffer up to date: stage every row whose
+        content identity differs from what this buffer last held (changed
+        reports, joins, clears), upload the slice through the donated
+        scatter-update. → rows staged (0 = the buffer is already true).
+
+        The layout (row assignment, mode/dt/count mirrors) is shared
+        across buffers and updated once; content identity is PER BUFFER —
+        a buffer that sat out K windows stages the union of those
+        windows' changes when its turn comes."""
+        nb, wb, _ = self._key  # type: ignore[misc]
+        live = {r.name for r in rows}
+        content = self._content[self._buf_i]
+        for name, i in list(self._row_of.items()):
+            if name not in live:
+                del self._row_of[name]
+                self._names[i] = None
+                self._mode[i] = 0
+                self._dt[i] = 0.0
+                self._counts[i] = 0
+                self._ids[i] = []
+                self._kinds[i] = None
+                self._free.append(i)
+        changed: list[tuple[int, RowInput]] = []
+        for r in rows:
+            i = self._row_of.get(r.name)
+            if i is None:
+                i = self._free.pop()
+                self._row_of[r.name] = i
+                self._names[i] = r.name
+                # the row may still hold another node's data in the OTHER
+                # ring buffers — mark their content unknown so they
+                # restage it on their next turn (a (run, seq) collision
+                # across nodes must never be mistaken for "current")
+                for other in self._content:
+                    if other is not content:
+                        other[i] = _DIRTY
+            elif (r.ident is not None and content[i] is not _EMPTY
+                    and content[i] is not _DIRTY and content[i] == r.ident):
+                continue  # this buffer's row is current
+            self._mode[i] = r.report.mode
+            self._dt[i] = r.report.dt_s
+            self._counts[i] = len(r.report.cpu_deltas)
+            self._ids[i] = r.report.workload_ids
+            self._kinds[i] = r.report.workload_kinds
+            content[i] = r.ident
+            changed.append((i, r))
+        # clear every freed row THIS buffer still carries data for (rows
+        # freed this window or while the buffer sat out), except rows a
+        # join just reclaimed — those are in `changed` and a duplicate
+        # scatter index would race the two writes nondeterministically
+        changed_rows = {i for i, _ in changed}
+        cleared = [i for i in range(nb)
+                   if self._names[i] is None and content[i] is not _EMPTY
+                   and i not in changed_rows]
+        for i in cleared:
+            content[i] = _EMPTY
+        n_stage = len(changed) + len(cleared)
+        if n_stage == 0:
+            return 0
+        # changed and cleared rows are disjoint subsets of the nb resident
+        # rows, so n_stage ≤ nb and the cap below can never truncate
+        db = min(self._ladder_d.fit(n_stage), nb)
+        width = self._empty_row.shape[0]
+        self._stage_i = (self._stage_i + 1) % len(self._stages)
+        if self._stages[self._stage_i].shape != (db, width):
+            self._stages[self._stage_i] = np.zeros((db, width), np.float32)
+        stage, idx = self._stages[self._stage_i], np.full(db, nb, np.int32)
+        if changed:
+            from kepler_tpu.parallel.packed import pack_reports_into
+
+            reports = [r.report for _, r in changed]
+            zd, zv = align_zone_matrices(
+                reports, [r.zone_names for _, r in changed], zones_t)
+            pack_reports_into(stage, reports, zd, zv, wb)
+            idx[:len(changed)] = [i for i, _ in changed]
+        for k, i in enumerate(cleared):
+            stage[len(changed) + k] = self._empty_row
+            idx[len(changed) + k] = i
+        jax = self._jax
+        entry = self._update_for(nb, width, db)
+        update = entry[0]  # keplint: donates=0
+        update_cold, entry[1] = entry[1], False
+        # the donated handle dies inside the call; rebind immediately
+        # (KTL110 tracks `resident` through the donating call)
+        resident = self._buffers[self._buf_i]
+        rows_dev = jax.device_put(stage, self._sh_repl)
+        idx_dev = jax.device_put(idx, self._sh_repl)
+        if update_cold:
+            # a new (n, width, delta-bucket) scatter-update key: the call
+            # blocks on trace+compile — surface it as window.compile
+            # (nested inside the caller's window.h2d_delta span)
+            from kepler_tpu import telemetry
+
+            with telemetry.span("window.compile"):
+                resident = update(resident, rows_dev, idx_dev)
+        else:
+            resident = update(resident, rows_dev, idx_dev)
+        self._buffers[self._buf_i] = resident
+        return n_stage

@@ -136,6 +136,13 @@ class PowerMonitor:
         # so a churn burst crossing a bucket boundary doesn't pay an XLA
         # compile inside its refresh
         self._warmed_buckets: set[int] = set()
+        # padded attribution staging, reused across refreshes (the
+        # node-side analog of the aggregator's delta-H2D slice: a steady
+        # window rewrites only the live prefix + the stale tail slice; a
+        # churn burst that crosses a bucket boundary reallocates once)
+        self._cpu_stage = np.zeros(0, np.float32)
+        self._valid_stage = np.zeros(0, bool)
+        self._stage_live = 0  # rows of the staging prefix in use
         self._window_listeners: list[Callable[[WindowSample], None]] = []
         self._snapshot: Snapshot | None = None  # keplint: guarded-by=_snapshot_lock
         self._snapshot_lock = threading.Lock()  # singleflight for refresh
@@ -319,10 +326,17 @@ class PowerMonitor:
         with telemetry.span("monitor.attribute"):
             w = batch.cpu_deltas.shape[0]
             padded_w = pad_to_bucket(w, self._bucket)
-            cpu = np.zeros(padded_w, np.float32)
+            if self._cpu_stage.shape[0] != padded_w:
+                self._cpu_stage = np.zeros(padded_w, np.float32)
+                self._valid_stage = np.zeros(padded_w, bool)
+                self._stage_live = 0
+            cpu, valid = self._cpu_stage, self._valid_stage
             cpu[:w] = batch.cpu_deltas
-            valid = np.zeros(padded_w, bool)
             valid[:w] = True
+            if self._stage_live > w:  # clear the shrunk tail only
+                cpu[w:self._stage_live] = 0.0
+                valid[w:self._stage_live] = False
+            self._stage_live = w
 
             result = attribute(
                 jnp.asarray(zone_deltas, jnp.float32),

@@ -7,13 +7,25 @@ trips, not compute. This module packs the whole fleet window into ONE f32
 input array and the whole scatter-back payload into ONE f16 output array:
 
   input  [N, W + 2Z + 4]  — cpu | zone | zone_valid | ratio, denom, dt, mode
-  output [N, W + 1, Z]    — per-workload watts, with node active watts as
-                            the extra row (f16: watts stay well inside
-                            half range and carry ~0.05% error, inside the
-                            0.5%-of-RAPL budget; µW or µJ would overflow)
+  output [N, W + 2, Z]    — per-workload watts, with node ACTIVE watts and
+                            node TOTAL watts as the two extra rows (f16:
+                            watts stay well inside half range and carry
+                            ~0.05% error, inside the 0.5%-of-RAPL budget;
+                            µW or µJ would overflow)
 
 The unpack/slice lives inside the jitted program, so XLA fuses it with the
 attribution math and the device sees exactly one executable.
+
+Sparse model evaluation (``model_bucket``): mixed fleets evaluate BOTH
+paths for every node in the dense program ("cheaper than a branch on
+TPU"), but the estimator is the whole device leg at fleet shapes — an MLP
+forward over [N·W] rows whose output is discarded for every MODE_RATIO
+node. The sparse variant takes an extra ``model_rows`` index vector
+(padded with N — gather clamps, scatter drops) and runs the estimator
+only on the gathered MODE_MODEL rows: bit-identical outputs at half the
+FLOPs on a 50/50 fleet. The row-index gather has no shard_map story, so
+the sparse variant is einsum-backend only; pallas keeps the dense
+program.
 """
 
 from __future__ import annotations
@@ -25,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kepler_tpu.parallel.aggregator_core import (
     fleet_attribution_program,
+    mix_model_watts,
     resolve_attribute_fn,
     shard_by_node,
 )
@@ -32,11 +45,29 @@ from kepler_tpu.parallel.fleet import FleetBatch
 from kepler_tpu.parallel.mesh import NODE_AXIS
 from kepler_tpu.models.estimator import predictor
 
+# packed output layout: the two synthetic rows appended after the W
+# workload rows (kept as named offsets so unpackers and the window
+# engine agree by construction)
+ROW_NODE_ACTIVE = -2
+ROW_NODE_TOTAL = -1
 
-def pack_fleet_inputs(batch: FleetBatch) -> np.ndarray:
-    """FleetBatch → one f32 [N, W + 2Z + 4] host array (one H2D)."""
+
+def packed_width(n_workloads: int, n_zones: int) -> int:
+    """Row width of the packed INPUT layout."""
+    return n_workloads + 2 * n_zones + 4
+
+
+def pack_fleet_inputs(batch: FleetBatch,
+                      out: np.ndarray | None = None) -> np.ndarray:
+    """FleetBatch → one f32 [N, W + 2Z + 4] host array (one H2D).
+
+    ``out``: optional preallocated destination (the window engine's
+    reusable staging buffer); a fresh array is returned when absent or
+    mis-shaped.
+    """
     n, w, z = batch.shape
-    out = np.empty((n, w + 2 * z + 4), np.float32)
+    if out is None or out.shape != (n, w + 2 * z + 4):
+        out = np.empty((n, w + 2 * z + 4), np.float32)
     # invalid workload slots ride as NaN in the cpu column — no separate
     # mask plane needed in the packed layout
     out[:, :w] = np.where(batch.workload_valid, batch.cpu_deltas, np.nan)
@@ -49,37 +80,134 @@ def pack_fleet_inputs(batch: FleetBatch) -> np.ndarray:
     return out
 
 
+def pack_reports_into(out: np.ndarray, reports,
+                      zone_deltas_mat: np.ndarray,
+                      zone_valid_mat: np.ndarray,
+                      n_workloads: int) -> None:
+    """Pack ragged reports straight into ``out[:len(reports)]`` (packed
+    row layout) without materializing an intermediate FleetBatch — the
+    delta-H2D staging path packs every window, so the extra cpu/valid
+    planes and the NaN-merge pass the two-step route pays are real
+    milliseconds at fleet scale. Rows beyond each report's workload
+    count stay NaN (invalid)."""
+    n, w = len(reports), n_workloads
+    z = zone_deltas_mat.shape[1]
+    out[:n, :w] = np.nan
+    lengths = np.fromiter((len(r.cpu_deltas) for r in reports),
+                          np.int64, n)
+    total = int(lengths.sum())
+    if total:
+        flat = np.concatenate(
+            [np.asarray(r.cpu_deltas, np.float32) for r in reports])
+        rows = np.repeat(np.arange(n), lengths)
+        starts = np.concatenate(([0], np.cumsum(lengths)[:-1]))
+        cols = np.arange(total) - np.repeat(starts, lengths)
+        out[rows, cols] = flat
+    out[:n, w: w + z] = zone_deltas_mat
+    out[:n, w + z: w + 2 * z] = zone_valid_mat
+    out[:n, w + 2 * z + 0] = np.fromiter(
+        (r.usage_ratio for r in reports), np.float64, n)
+    out[:n, w + 2 * z + 1] = np.fromiter(
+        (r.node_cpu_delta for r in reports), np.float64, n)
+    out[:n, w + 2 * z + 2] = np.fromiter(
+        (r.dt_s for r in reports), np.float64, n)
+    out[:n, w + 2 * z + 3] = np.fromiter(
+        (r.mode for r in reports), np.int64, n)
+
+
+def _unpack_fields(packed: jax.Array, w: int, z: int):
+    cpu_nan = packed[:, :w]
+    workload_valid = ~jnp.isnan(cpu_nan)
+    cpu = jnp.where(workload_valid, cpu_nan, 0.0)
+    zone = packed[:, w: w + z]
+    zone_valid = packed[:, w + z: w + 2 * z] > 0.5
+    ratio = packed[:, w + 2 * z + 0]
+    denom = packed[:, w + 2 * z + 1]
+    dt = packed[:, w + 2 * z + 2]
+    mode = packed[:, w + 2 * z + 3].astype(jnp.int32)
+    return cpu, workload_valid, zone, zone_valid, ratio, denom, dt, mode
+
+
+def _pack_watts_f16(res) -> jax.Array:
+    """FleetResult → one f16 [N, W+2, Z] output (one D2H), in watts."""
+    watts = res.workload_power_uw * 1e-6  # µW → W for f16 range
+    active = res.node_active_power_uw[:, None, :] * 1e-6
+    total = res.node_power_uw[:, None, :] * 1e-6
+    return jnp.concatenate([watts, active, total],
+                           axis=1).astype(jnp.float16)
+
+
 def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
                               model_mode: str | None = None,
-                              backend: str = "einsum"):
-    """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+1, Z]``.
+                              backend: str = "einsum",
+                              model_bucket: int | None = None):
+    """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+2, Z]``.
 
     W and Z are static (they define the packing layout); N stays dynamic
     per compilation, sharded over the mesh's node axis.
+
+    ``model_bucket``: when given (and ``model_mode`` is set), the program
+    takes a third ``model_rows`` int32 [model_bucket] argument and
+    evaluates the estimator ONLY on those rows (sparse mixed-fleet
+    evaluation; see module docstring). Entries ≥ N are padding: the
+    gather clamps them to a real row whose scatter-back is then dropped.
     """
     predict_fn = predictor(model_mode) if model_mode else None
+    if predict_fn is not None and model_mode != "linear" \
+            and mesh.devices.flat[0].platform != "tpu":
+        # bf16 trunks are an MXU throughput feature; off-TPU, bf16 is
+        # emulated — measurably SLOWER than f32 and noisier. Serve f32
+        # compute on CPU/GPU hosts (output dtype unchanged: the f16
+        # packed wire format is the quantizer either way).
+        base_fn = predict_fn
+
+        def predict_fn(params, feats, valid, _fn=base_fn):
+            return _fn(params, feats, valid, compute_dtype=jnp.float32)
+
     w, z = n_workloads, n_zones
     attribute_fn = resolve_attribute_fn(mesh, backend)
+    sparse = model_bucket is not None and predict_fn is not None
+    if sparse and backend != "einsum":
+        raise ValueError(
+            "sparse model evaluation (model_bucket) requires the einsum "
+            f"backend; got {backend!r}")
 
     def unpack_and_attribute(model_params, packed):
-        cpu_nan = packed[:, :w]
-        workload_valid = ~jnp.isnan(cpu_nan)
-        cpu = jnp.where(workload_valid, cpu_nan, 0.0)
-        zone = packed[:, w: w + z]
-        zone_valid = packed[:, w + z: w + 2 * z] > 0.5
-        ratio = packed[:, w + 2 * z + 0]
-        denom = packed[:, w + 2 * z + 1]
-        dt = packed[:, w + 2 * z + 2]
-        mode = packed[:, w + 2 * z + 3].astype(jnp.int32)
+        fields = _unpack_fields(packed, w, z)
+        cpu, workload_valid, zone, zone_valid, ratio, denom, dt, mode = fields
         res = fleet_attribution_program(
             model_params, zone, zone_valid, ratio, cpu, workload_valid,
             denom, dt, mode, predict_fn=predict_fn,
             attribute_fn=attribute_fn)
-        watts = res.workload_power_uw * 1e-6  # µW → W for f16 range
-        node_watts = res.node_active_power_uw[:, None, :] * 1e-6
-        return jnp.concatenate([watts, node_watts],
-                               axis=1).astype(jnp.float16)
+        return _pack_watts_f16(res)
 
+    def unpack_and_attribute_sparse(model_params, packed, model_rows):
+        from kepler_tpu.models.features import build_features
+
+        fields = _unpack_fields(packed, w, z)
+        cpu, workload_valid, zone, zone_valid, ratio, denom, dt, mode = fields
+        ratio_res = attribute_fn(zone, zone_valid, ratio, cpu,
+                                 workload_valid, denom, dt)
+        sub_valid = workload_valid[model_rows]
+        feats = build_features(cpu[model_rows], sub_valid,
+                               denom[model_rows], ratio[model_rows],
+                               dt[model_rows])
+        sub_watts = predict_fn(model_params, feats, sub_valid)
+        # padding entries (index N) drop on the scatter; MODE_RATIO rows
+        # keep zeros here, which mix_model_watts' where() never selects
+        model_watts = jnp.zeros(cpu.shape + (z,), jnp.float32).at[
+            model_rows].set(sub_watts)
+        return _pack_watts_f16(mix_model_watts(ratio_res, model_watts,
+                                               mode, dt))
+
+    if sparse:
+        return jax.jit(
+            unpack_and_attribute_sparse,
+            in_shardings=(NamedSharding(mesh, P()),
+                          NamedSharding(mesh, P(NODE_AXIS, None)),
+                          NamedSharding(mesh, P())),
+            out_shardings=NamedSharding(mesh, P(NODE_AXIS)),
+        )
     fn = unpack_and_attribute
     if backend == "pallas":
         fn = shard_by_node(fn, mesh, in_specs=(P(), P(NODE_AXIS, None)))
@@ -94,4 +222,14 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
 def unpack_fleet_watts(packed_watts: np.ndarray) -> tuple[np.ndarray,
                                                           np.ndarray]:
     """One D2H array → (workload_watts [N, W, Z], node_active_watts [N, Z])."""
-    return packed_watts[:, :-1, :], packed_watts[:, -1, :]
+    return packed_watts[:, :ROW_NODE_ACTIVE, :], \
+        packed_watts[:, ROW_NODE_ACTIVE, :]
+
+
+def unpack_fleet_window(packed_watts: np.ndarray) -> tuple[
+        np.ndarray, np.ndarray, np.ndarray]:
+    """One D2H array → (workload_watts [N, W, Z], node_active_watts [N, Z],
+    node_total_watts [N, Z]) — the aggregator's scatter-back triple."""
+    return (packed_watts[:, :ROW_NODE_ACTIVE, :],
+            packed_watts[:, ROW_NODE_ACTIVE, :],
+            packed_watts[:, ROW_NODE_TOTAL, :])

@@ -289,4 +289,4 @@ class TestAggregatorIngestRaces:
         assert not agg_errors, agg_errors[:2]
         result = agg.aggregate_once()
         assert result is not None
-        assert np.isfinite(np.asarray(result.workload_power_uw)).all()
+        assert np.isfinite(np.asarray(result.wl_power_uw)).all()

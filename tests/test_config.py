@@ -373,6 +373,13 @@ FIELD_MATRIX = [
     FieldCase("aggregator.dedup_window",
               "aggregator: {dedupWindow: 64}", 64,
               ["--aggregator.dedup-window", "32"], 32),
+    # window pipeline (ISSUE 5)
+    FieldCase("aggregator.pipeline_depth",
+              "aggregator: {pipelineDepth: 3}", 3,
+              ["--aggregator.pipeline-depth", "1"], 1),
+    FieldCase("aggregator.bucket_shrink_after",
+              "aggregator: {bucketShrinkAfter: 4}", 4,
+              ["--aggregator.bucket-shrink-after", "8"], 8),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -489,6 +496,8 @@ class TestYAMLSpellings:
         "statePath": "monitor",
         "stateMaxAge": "monitor",
         "dedupWindow": "aggregator",
+        "pipelineDepth": "aggregator",
+        "bucketShrinkAfter": "aggregator",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
@@ -536,6 +545,8 @@ class TestYAMLSpellings:
         "statePath": ("/tmp/s.json", "/tmp/s.json"),
         "stateMaxAge": ("2m", 120.0),
         "dedupWindow": ("64", 64),
+        "pipelineDepth": ("3", 3),
+        "bucketShrinkAfter": ("4", 4),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),

@@ -196,8 +196,11 @@ class TestFleetChurnSoak:
                     got = wl_e.sum(axis=0)
                     mask = np.asarray(
                         [zn in stored.zone_names for zn in zl])
+                    # 2e-3 covers the packed-f16 default path (watts are
+                    # f16 on the wire-back: ~1e-3 quantization, inside
+                    # the 0.5% budget the accuracy bench gates)
                     np.testing.assert_allclose(
-                        got[mask], active[mask], rtol=5e-4, atol=10.0,
+                        got[mask], active[mask], rtol=2e-3, atol=10.0,
                         err_msg=f"conservation broke on {name} win {win}")
                     conservation_checked += 1
                 # monotonic cumulative joules
@@ -247,8 +250,7 @@ class TestTemporalHistorySoak:
                 assert status == 204
             result = agg.aggregate_once()
             assert result is not None
-            assert np.isfinite(
-                np.asarray(result.workload_power_uw)).all()
+            assert np.isfinite(np.asarray(result.wl_power_uw)).all()
             for _, buf in agg._history.values():
                 assert buf.window == 4  # ring never grows
         assert "t-5" not in agg._history  # evicted with its node

@@ -45,11 +45,11 @@ def ids(diags):
 
 
 class TestEngine:
-    def test_registry_has_nine_domain_rules(self):
+    def test_registry_has_ten_domain_rules(self):
         rules = all_rules()
         assert [r.id for r in rules] == sorted(r.id for r in rules)
-        assert len(rules) == 9
-        assert len({r.name for r in rules}) == 9
+        assert len(rules) == 10
+        assert len({r.name for r in rules}) == 10
         for r in rules:
             assert r.summary and r.rationale, f"{r.id} lacks docs"
 
@@ -706,6 +706,140 @@ class TestSpanDisciplineRule:
                     return time.time()
         """)
         assert diags == []
+
+
+class TestDonatedBufferRule:
+    REL = "kepler_tpu/parallel/mod.py"
+
+    def test_bad_read_after_donate(self, lint):
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows):
+                out = update(resident, rows)
+                return resident.sum()  # dead buffer
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+        assert "resident" in diags[0].message
+
+    def test_good_rebind_pattern(self, lint):
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows):
+                resident = update(resident, rows)
+                return resident.sum()  # rebound: the new buffer
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_directive_marks_indirect_jit(self, lint):
+        diags = lint("""
+            def step(self, rows):
+                update = self._entry[0]  # keplint: donates=0
+                update(self._resident, rows)
+                return self._resident
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+        assert "self._resident" in diags[0].message
+
+    def test_directive_rebind_is_clean(self, lint):
+        diags = lint("""
+            def step(self, rows):
+                update = self._entry[0]  # keplint: donates=0
+                self._resident = update(self._resident, rows)
+                return self._resident
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_tuple_positions_and_multiple_args(self, lint):
+        diags = lint("""
+            import jax
+
+            f = jax.jit(lambda a, b: a + b, donate_argnums=(0, 1))
+
+            def step(x, y):
+                x = f(x, y)
+                return y.sum()  # y was donated at position 1
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+        assert "'y'" in diags[0].message
+
+    def test_out_of_scope_path_ignored(self, lint):
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows):
+                update(resident, rows)
+                return resident.sum()
+        """, rel="kepler_tpu/models/mod.py")
+        assert diags == []
+
+    def test_rebind_inside_compound_statements_is_clean(self, lint):
+        # the canonical pattern inside if/for/while/try bodies must not
+        # double-count the donation via the parent statement's subtree
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, windows, cond):
+                if cond:
+                    resident = update(resident, windows[0])
+                for w in windows:
+                    resident = update(resident, w)
+                try:
+                    resident = update(resident, windows[-1])
+                except ValueError:
+                    pass
+                return resident.sum()
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_read_after_donate_inside_compound_still_flagged(self, lint):
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, windows, cond):
+                if cond:
+                    update(resident, windows[0])  # not rebound
+                return resident.sum()
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+
+    def test_jit_without_donation_ignored(self, lint):
+        diags = lint("""
+            import jax
+
+            run = jax.jit(lambda r, x: r + x)
+
+            def step(resident, rows):
+                run(resident, rows)
+                return resident.sum()
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_fleet_window_files_in_scope(self, lint):
+        source = """
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows):
+                update(resident, rows)
+                return resident.sum()
+        """
+        for rel in ("kepler_tpu/fleet/window.py",
+                    "kepler_tpu/fleet/aggregator.py"):
+            diags = lint(source, rel=rel)
+            assert ids(diags) == ["KTL110"], rel
 
 
 class TestBaselineRatchet:

@@ -1,0 +1,377 @@
+"""Device-resident pipelined fleet windows (ISSUE 5).
+
+Correctness contracts of `kepler_tpu.fleet.window` + the pipelined
+`Aggregator.aggregate_once`:
+
+* depth-2 pipelining publishes BIT-IDENTICAL windows to the serial
+  (depth-1) cycle, per mode, under churn (joins, drops, restarts, zone
+  changes) — the strongest possible statement that the resident batch,
+  delta H2D, ping-pong donation, and sparse model evaluation change
+  scheduling, never results;
+* shutdown (and an emptied fleet) drains in-flight windows
+  deterministically;
+* a mid-pipeline drop/join never mixes stale rows into a fresh window;
+* donated-buffer reuse never aliases a window still being read (the
+  churn stress would corrupt the bit-exact comparison if it did);
+* bucket ladders grow geometrically and shrink only after the
+  hysteresis window; delta-H2D row accounting matches what changed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kepler_tpu.fleet.aggregator import Aggregator, _Stored
+from kepler_tpu.fleet.window import BucketLadder
+from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
+from kepler_tpu.parallel.mesh import make_mesh
+from kepler_tpu.server.http import APIServer
+
+ZONES = ("package", "dram")
+ZONES_WIDE = ("package", "dram", "uncore")
+
+
+def make_report(name: str, seed: int, w: int = 4, zones=ZONES,
+                mode: int = MODE_RATIO) -> NodeReport:
+    rng = np.random.default_rng(abs(hash((name, seed))) % (2**32))
+    cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+    z = len(zones)
+    return NodeReport(
+        node_name=name,
+        zone_deltas_uj=rng.uniform(1e7, 5e8, z).astype(np.float32),
+        zone_valid=np.ones(z, bool),
+        usage_ratio=float(rng.uniform(0.2, 0.9)),
+        cpu_deltas=cpu,
+        workload_ids=[f"{name}-w{k}" for k in range(w)],
+        node_cpu_delta=float(cpu.sum()),
+        dt_s=5.0,
+        mode=mode,
+        workload_kinds=np.ones(w, np.int8),
+    )
+
+
+def make_agg(depth: int, **kw) -> Aggregator:
+    kw.setdefault("model_mode", "mlp")
+    kw.setdefault("node_bucket", 8)
+    kw.setdefault("workload_bucket", 8)
+    kw.setdefault("stale_after", 1e9)
+    if "clock" not in kw:
+        ticks = [1e9]
+        kw["clock"] = lambda: ticks[0]
+        agg = Aggregator(APIServer(), pipeline_depth=depth, **kw)
+        agg.test_clock = ticks  # driven by run_schedule/seed helpers
+    else:
+        agg = Aggregator(APIServer(), pipeline_depth=depth, **kw)
+    agg._mesh = make_mesh()
+    return agg
+
+
+def churn_schedule(n_windows: int, base_nodes: int = 6) -> list[dict]:
+    """Per-window {name: (seed, zones, mode, seq, run)} with joins,
+    drops, a restart, and a zone-set change sprinkled in."""
+    schedules = []
+    for win in range(n_windows):
+        sched = {}
+        for i in range(base_nodes):
+            name = f"n{i:02d}"
+            if win % 5 == 2 and i == 1:
+                continue  # n01 drops out this window
+            zones = ZONES_WIDE if (win >= 4 and i == 2) else ZONES
+            run = "r2" if (win >= 3 and i == 3) else "r1"
+            seq = win + 1 if run == "r1" else win - 1  # restart resets
+            mode = MODE_MODEL if i % 2 else MODE_RATIO
+            sched[name] = (win * 100 + i, zones, mode, max(1, seq), run)
+        if win >= 3:  # a late joiner
+            sched["n99"] = (win * 100 + 99, ZONES, MODE_MODEL,
+                            win - 2, "r1")
+        schedules.append(sched)
+    return schedules
+
+
+def seed_window(agg: Aggregator, sched: dict, now: float) -> None:
+    for name, (seed, zones, mode, seq, run) in sched.items():
+        rep = make_report(name, seed, zones=zones, mode=mode)
+        agg._reports[name] = _Stored(report=rep, zone_names=tuple(zones),
+                                     received=now, seq=seq, run=run)
+    for name in list(agg._reports):
+        if name not in sched:
+            del agg._reports[name]
+
+
+def run_schedule(agg: Aggregator, schedules: list[dict]) -> list:
+    published = []
+    for sched in schedules:
+        agg.test_clock[0] += 5.0
+        seed_window(agg, sched, agg.test_clock[0])
+        result = agg.aggregate_once()
+        if result is not None:
+            published.append(result)
+    tail = agg._drain_pipeline()
+    if tail is not None:
+        published.append(tail)
+    return published
+
+
+def assert_windows_equal(a, b) -> None:
+    assert set(a.names) == set(b.names)
+    assert list(a.zones) == list(b.zones)
+    for name in a.names:
+        i, j = a.rows[name], b.rows[name]
+        assert int(a.mode[i]) == int(b.mode[j]), name
+        np.testing.assert_array_equal(a.node_power_uw[i],
+                                      b.node_power_uw[j], err_msg=name)
+        np.testing.assert_array_equal(a.node_energy_uj[i],
+                                      b.node_energy_uj[j], err_msg=name)
+        np.testing.assert_array_equal(a.node_joules_total[i],
+                                      b.node_joules_total[j], err_msg=name)
+        assert a.counts[i] == b.counts[j]
+        assert a.workload_ids[i] == b.workload_ids[j]
+        ra, rb = a.render_node(name), b.render_node(name)
+        assert ra == rb, name
+
+
+class TestPipelineBitExact:
+    @pytest.mark.parametrize("model_mode", [None, "mlp"])
+    def test_depth2_matches_serial_under_churn(self, model_mode):
+        schedules = churn_schedule(9)
+        serial = run_schedule(make_agg(1, model_mode=model_mode),
+                              schedules)
+        piped = run_schedule(make_agg(2, model_mode=model_mode),
+                             schedules)
+        assert len(serial) == len(schedules)
+        assert len(piped) == len(schedules)
+        for a, b in zip(serial, piped):
+            assert a.timestamp == b.timestamp
+            assert_windows_equal(a, b)
+
+    def test_accuracy_mode_legacy_path_pipelines_bit_exact(self):
+        schedules = churn_schedule(6)
+        serial = run_schedule(make_agg(1, accuracy_mode=True), schedules)
+        piped = run_schedule(make_agg(2, accuracy_mode=True), schedules)
+        assert len(piped) == len(serial) == len(schedules)
+        for a, b in zip(serial, piped):
+            assert_windows_equal(a, b)
+
+    def test_temporal_mode_pipelines(self):
+        schedules = churn_schedule(4)
+        piped = run_schedule(
+            make_agg(2, model_mode="temporal", history_window=4),
+            schedules)
+        assert len(piped) == len(schedules)
+        for res in piped:
+            for name in res.names:
+                node = res.render_node(name)
+                assert all(np.isfinite(w["power_uw"]).all()
+                           for w in node["workloads"])
+
+    def test_packed_default_within_budget_of_accuracy_path(self):
+        # the f16 packed default vs the einsum-f32 accuracy path: node
+        # power must agree within the 0.5% budget (ratio-only fleet —
+        # untrained estimators have near-zero watts, useless for a
+        # relative bound)
+        schedules = churn_schedule(3)
+        packed = run_schedule(make_agg(1, model_mode=None), schedules)
+        exact = run_schedule(
+            make_agg(1, model_mode=None, accuracy_mode=True), schedules)
+        for a, b in zip(packed, exact):
+            for name in a.names:
+                pa = a.node_power_uw[a.rows[name]]
+                pb = b.node_power_uw[b.rows[name]]
+                np.testing.assert_allclose(pa, pb, rtol=5e-3, atol=1.0)
+
+
+class TestPipelineDrain:
+    def test_shutdown_drains_in_flight_window(self):
+        agg = make_agg(2)
+        seed_window(agg, churn_schedule(1)[0], 1e9)
+        assert agg.aggregate_once() is None  # in flight, not published
+        assert len(agg._inflight) == 1
+        agg.shutdown()
+        assert not agg._inflight
+        with agg._results_lock:
+            assert agg._results is not None
+        assert agg._stats["attributions_total"] == 1
+
+    def test_empty_fleet_drains_instead_of_rotting(self):
+        agg = make_agg(2, stale_after=10.0, clock=lambda: clock[0])
+        clock = [1e9]
+        seed_window(agg, churn_schedule(1)[0], clock[0])
+        assert agg.aggregate_once() is None
+        clock[0] += 100.0  # everything stale now
+        result = agg.aggregate_once()  # empty fleet → drain
+        assert result is not None
+        assert not agg._inflight
+        assert agg._stats["attributions_total"] == 1
+
+    def test_run_loop_exit_drains(self):
+        from kepler_tpu.service.lifecycle import CancelContext
+
+        agg = make_agg(2, interval=0.01)
+        seed_window(agg, churn_schedule(1)[0], 1e9)
+        ctx = CancelContext()
+        import threading
+
+        t = threading.Thread(target=agg.run, args=(ctx,))
+        t.start()
+        import time as _t
+
+        deadline = _t.monotonic() + 10
+        while (agg._stats["attributions_total"] == 0
+               and _t.monotonic() < deadline):
+            _t.sleep(0.02)
+        ctx.cancel()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert not agg._inflight
+
+    def test_published_results_at_most_one_interval_stale(self):
+        agg = make_agg(2)
+        schedules = churn_schedule(4)
+        stamps = []
+        for sched in schedules:
+            agg.test_clock[0] += 5.0
+            seed_window(agg, sched, agg.test_clock[0])
+            res = agg.aggregate_once()
+            stamps.append((agg.test_clock[0],
+                           None if res is None else res.timestamp))
+        for dispatched_at, published_ts in stamps[1:]:
+            assert published_ts == dispatched_at - 5.0  # exactly 1 behind
+
+
+class TestMidPipelineChurn:
+    def test_drop_join_never_mixes_stale_rows(self):
+        agg = make_agg(2)
+        now = 1e9
+        win1 = {f"n{i}": (i, ZONES, i % 2, 1, "r1") for i in range(4)}
+        seed_window(agg, win1, now)
+        agg.aggregate_once()
+        # n2 drops; n5 joins — dispatched while window 1 is in flight
+        # (fresh data seeds: the re-reports carry NEW values)
+        win2 = {name: (seed + 10, z, m, 2, r)
+                for name, (seed, z, m, _s, r) in win1.items()
+                if name != "n2"}
+        win2["n5"] = (50, ZONES, MODE_RATIO, 1, "r1")
+        now += 5.0
+        seed_window(agg, win2, now)
+        first = agg.aggregate_once()  # publishes window 1
+        assert set(first.names) == set(win1)
+        second = agg._drain_pipeline()  # publishes window 2
+        assert set(second.names) == set(win2)
+        assert "n2" not in second.rows
+        assert "n5" in second.rows
+        # fresh node's watts actually computed (not a stale zero row)
+        n5 = second.render_node("n5")
+        assert any(np.asarray(w["power_uw"]).sum() != 0.0
+                   for w in n5["workloads"])
+        # n0's re-report (new seed → new data) actually refreshed
+        assert not np.array_equal(
+            first.node_power_uw[first.rows["n0"]],
+            second.node_power_uw[second.rows["n0"]])
+
+    def test_returning_node_gets_fresh_row_not_old_buffer_contents(self):
+        # absent for one window (row cleared), back with NEW data: the
+        # published watts must match a from-scratch aggregator fed the
+        # same final window — old resident contents must never leak
+        schedules = [
+            {f"n{i}": (i, ZONES, MODE_RATIO, 1, "r1") for i in range(3)},
+            {f"n{i}": (10 + i, ZONES, MODE_RATIO, 2, "r1")
+             for i in range(2)},  # n2 absent
+            {f"n{i}": (20 + i, ZONES, MODE_RATIO, 3, "r1")
+             for i in range(3)},  # n2 back, new data
+        ]
+        published = run_schedule(make_agg(2, model_mode=None), schedules)
+        fresh = run_schedule(make_agg(1, model_mode=None), [schedules[-1]])
+        got = published[-1].render_node("n2")
+        want = fresh[-1].render_node("n2")
+        assert got["node_power_uw"] == want["node_power_uw"]
+        assert [w["power_uw"] for w in got["workloads"]] == \
+            [w["power_uw"] for w in want["workloads"]]
+
+
+class TestBucketLadder:
+    def test_grow_is_immediate_and_geometric(self):
+        ladder = BucketLadder(8, shrink_after=3)
+        assert ladder.fit(5) == 8
+        assert ladder.fit(9) == 16
+        assert ladder.fit(100) == 128
+
+    def test_align_rounds_base_and_survives_growth(self):
+        ladder = BucketLadder(6, shrink_after=3, align=4)
+        assert ladder.base == 8
+        assert ladder.fit(9) % 4 == 0
+
+    def test_shrink_needs_consecutive_underhalf_windows(self):
+        ladder = BucketLadder(8, shrink_after=3)
+        ladder.fit(100)  # → 128
+        assert ladder.fit(10) == 128  # under half #1
+        assert ladder.fit(10) == 128  # under half #2
+        assert ladder.fit(100) == 128  # back over half: streak resets
+        assert ladder.fit(10) == 128
+        assert ladder.fit(10) == 128
+        assert ladder.fit(10) == 64  # third consecutive → one step down
+        assert ladder.fit(10) == 64  # streak restarts after a shrink
+
+    def test_never_shrinks_below_base(self):
+        ladder = BucketLadder(8, shrink_after=1)
+        ladder.fit(8)
+        for _ in range(10):
+            ladder.fit(1)
+        assert ladder.bucket == 8
+
+
+class TestDeltaAccounting:
+    def test_unchanged_fleet_uploads_zero_rows(self):
+        agg = make_agg(1)
+        sched = {f"n{i}": (i, ZONES, i % 2, 1, "r1") for i in range(5)}
+        now = 1e9
+        seed_window(agg, sched, now)
+        agg.aggregate_once()
+        assert agg._stats["last_h2d_rows"] == 5  # rebuild packs all
+        # same (run, seq) → nothing re-uploaded, on every ring buffer
+        for _ in range(3):
+            agg.aggregate_once()
+            assert agg._stats["last_h2d_rows"] == 0
+        # one change → staged once per ring buffer it must reach, then 0
+        sched["n3"] = (99, ZONES, 1, 2, "r1")
+        seed_window(agg, sched, now)
+        staged = []
+        for _ in range(4):
+            agg.aggregate_once()
+            staged.append(agg._stats["last_h2d_rows"])
+        assert staged[0] == 1 and staged[-1] == 0
+        assert sum(staged) == len(agg._engine._buffers)
+        # the first delta compiled the scatter-update once; further
+        # same-sized deltas never recompile
+        compiles = agg._stats["window_compiles_total"]
+        sched["n3"] = (123, ZONES, 1, 3, "r1")
+        seed_window(agg, sched, now)
+        agg.aggregate_once()
+        agg.aggregate_once()
+        assert agg._stats["window_compiles_total"] == compiles
+
+    def test_pre_nonce_rows_always_reupload(self):
+        agg = make_agg(1)
+        sched = {"n0": (1, ZONES, 0, 0, "")}  # no run nonce, seq 0
+        seed_window(agg, sched, 1e9)
+        agg.aggregate_once()
+        agg.aggregate_once()
+        assert agg._stats["last_h2d_rows"] == 1
+
+    def test_fleet_growth_compiles_once_per_rung(self):
+        agg = make_agg(1, node_bucket=8)
+        now = 1e9
+        sched = {f"n{i}": (i, ZONES, 0, 1, "r1") for i in range(5)}
+        seed_window(agg, sched, now)
+        agg.aggregate_once()
+        base_compiles = agg._stats["window_compiles_total"]
+        # grow past the node bucket: one new program + one new update
+        sched.update({f"m{i}": (i, ZONES, 0, 1, "r1") for i in range(8)})
+        seed_window(agg, sched, now)
+        agg.aggregate_once()
+        grown = agg._stats["window_compiles_total"]
+        assert grown > base_compiles
+        # repeat windows at the new rung: no further compiles
+        agg.aggregate_once()
+        agg.aggregate_once()
+        assert agg._stats["window_compiles_total"] == grown
