@@ -219,8 +219,14 @@ def run_node_path_scenario(n_procs: int) -> dict:
 # still fails 3×+.
 AGG_HOST_BUDGET_MS = float(os.environ.get(
     "KEPLER_AGG_HOST_BUDGET_MS", "30.0"))
+# Round 7 recalibration: host_p99 is now a REAL nearest-rank percentile
+# over ≥100 samples (it was max-of-5, which under-sampled the tail).
+# Measured on the 2-core capture host: ~57 ms quiet, ~120 ms under
+# concurrent load — scheduler jitter, not code. 150 = measured-busy +
+# margin; the guarded regression class (O(nodes×workloads) Python per
+# window) measures 100 ms+ at p50 and still fails BOTH budgets.
 AGG_HOST_P99_BUDGET_MS = float(os.environ.get(
-    "KEPLER_AGG_HOST_P99_BUDGET_MS", "60.0"))
+    "KEPLER_AGG_HOST_P99_BUDGET_MS", "150.0"))
 # the ISSUE-5 tentpole gate: steady-state pipelined cadence (packed-f16
 # resident default, depth 2) must come in at ≤ this fraction of the
 # serial einsum-f32 window p50 (the retained accuracy-mode path, depth
@@ -228,6 +234,19 @@ AGG_HOST_P99_BUDGET_MS = float(os.environ.get(
 # the same host, so it gates on CPU CI machines too.
 AGG_PIPELINE_RATIO_BUDGET = float(os.environ.get(
     "KEPLER_AGG_PIPELINE_RATIO_BUDGET", "0.7"))
+
+
+def _pctl(sorted_vals: list, q: float) -> float:
+    """Percentile of an ASCENDING-sorted sample (nearest-rank): the
+    ceil(q·n)-th value. With n < 1/(1−q) samples this is just the max —
+    callers must size their sample counts so the rank is interior
+    (host_p99 used to be exactly that bug: max-of-10 labelled p99)."""
+    import math
+
+    if not sorted_vals:
+        return float("nan")
+    rank = min(len(sorted_vals), max(1, math.ceil(q * len(sorted_vals))))
+    return sorted_vals[rank - 1]
 
 
 def _seed_fleet_reports(agg, n_nodes: int, w: int, seq: int,
@@ -315,20 +334,23 @@ def run_aggregator_window_scenario(iters: int) -> dict:
                      workload_bucket=128, stale_after=1e9,
                      pipeline_depth=2)
     agg._mesh = mesh
-    pipe_ms, _, s = _measure_agg(agg, n_nodes, w, iters)
-    if agg._stats["attributions_total"] < iters:  # not assert: -O runs it
+    iters_pipe = max(100, iters)  # ≥100 samples → p99 is interior
+    pipe_ms, _, s = _measure_agg(agg, n_nodes, w, iters_pipe)
+    if agg._stats["attributions_total"] < iters_pipe:  # not assert: -O runs it
         raise RuntimeError("pipelined aggregator lost windows")
 
     # host legs measured at depth 1: with the pipeline overlapping, the
     # host staging shares cores with XLA's compute threads and its WALL
     # time stops measuring host WORK — the serial-packed run keeps the
-    # gate on the code, not on CI core count
+    # gate on the code, not on CI core count. Sample count floored at
+    # 100 so host_p99 is a real interior percentile (nearest-rank p99
+    # needs ≥100 samples before it stops collapsing to the max)
     host_agg = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
                           workload_bucket=128, stale_after=1e9,
                           pipeline_depth=1)
     host_agg._mesh = mesh
     packed_serial_ms, host_ms, _ = _measure_agg(host_agg, n_nodes, w,
-                                                max(3, iters // 2))
+                                                max(100, iters))
 
     serial = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
                         workload_bucket=128, stale_after=1e9,
@@ -345,7 +367,8 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "nodes": n_nodes,
         "pods": n_nodes * w,
         "host_p50_ms": round(host_ms[len(host_ms) // 2], 3),
-        "host_p99_ms": round(host_ms[-1], 3),
+        "host_p99_ms": round(_pctl(host_ms, 0.99), 3),
+        "host_samples": len(host_ms),
         "assembly_ms": round(s["last_assembly_ms"], 3),
         "device_ms": round(s["last_device_ms"], 3),
         "dispatch_ms": round(s["last_dispatch_ms"], 3),
@@ -355,7 +378,8 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "compile_count": int(s["window_compiles_total"]),
         "window_p50_ms": round(pipe_p50, 3),
         "pipeline_p50_ms": round(pipe_p50, 3),
-        "pipeline_p99_ms": round(pipe_ms[-1], 3),
+        "pipeline_p99_ms": round(_pctl(pipe_ms, 0.99), 3),
+        "pipeline_samples": len(pipe_ms),
         "packed_serial_p50_ms": round(
             packed_serial_ms[len(packed_serial_ms) // 2], 3),
         "serial_p50_ms": round(serial_p50, 3),
@@ -366,7 +390,7 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "p99_budget_ms": AGG_HOST_P99_BUDGET_MS,
         "within_budget": (
             host_ms[len(host_ms) // 2] <= AGG_HOST_BUDGET_MS
-            and host_ms[-1] <= AGG_HOST_P99_BUDGET_MS),
+            and _pctl(host_ms, 0.99) <= AGG_HOST_P99_BUDGET_MS),
     }
 
 

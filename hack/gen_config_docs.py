@@ -192,6 +192,22 @@ DESCRIPTIONS = {
                                       "(growth is immediate; hysteresis "
                                       "prevents recompile thrash at a "
                                       "bucket edge).",
+    "aggregator.fallback_enabled": "Aggregator: demote the window's "
+                                   "device leg down the degradation "
+                                   "ladder (packed pipelined → packed "
+                                   "serial → einsum-f32 serial → "
+                                   "pure-NumPy host) on any device "
+                                   "failure instead of crashing the "
+                                   "aggregation loop.",
+    "aggregator.repromote_after": "Aggregator: consecutive clean windows "
+                                  "at a demoted ladder rung before the "
+                                  "rung above is retried (hysteresis, "
+                                  "like the breaker's half-open probe).",
+    "aggregator.dispatch_timeout": "Aggregator: stall watchdog on the "
+                                   "window fetch — a dispatch that "
+                                   "hasn't produced output within this "
+                                   "bound demotes the ladder instead of "
+                                   "wedging the loop (`0` disables).",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -283,6 +299,10 @@ FLAG_OF = {
     "aggregator.dedup_window": "--aggregator.dedup-window",
     "aggregator.pipeline_depth": "--aggregator.pipeline-depth",
     "aggregator.bucket_shrink_after": "--aggregator.bucket-shrink-after",
+    "aggregator.fallback_enabled":
+        "--aggregator.fallback-enabled / --no-aggregator.fallback-enabled",
+    "aggregator.repromote_after": "--aggregator.repromote-after",
+    "aggregator.dispatch_timeout": "--aggregator.dispatch-timeout",
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
@@ -298,6 +318,7 @@ _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
                    "aggregator.backoff_initial", "aggregator.backoff_max",
                    "aggregator.breaker_cooldown", "aggregator.flush_timeout",
                    "aggregator.skew_tolerance", "aggregator.degraded_ttl",
+                   "aggregator.dispatch_timeout",
                    "service.restart_backoff_initial",
                    "service.restart_backoff_max"}
 

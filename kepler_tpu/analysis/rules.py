@@ -458,7 +458,7 @@ _METRIC_NAME = re.compile(r"^kepler_[a-z][a-z0-9_]*$")
 # approved final name tokens: units first, then semantic/count forms
 _UNIT_TOKENS = frozenset({
     "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
-    "celsius", "info", "healthy",
+    "celsius", "info", "healthy", "degraded",
 })
 _COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows"})
 # reference-parity names grandfathered in (match the upstream exporter)

@@ -75,6 +75,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         delivery_buckets=cfg.telemetry.delivery_buckets or None,
         pipeline_depth=cfg.aggregator.pipeline_depth,
         bucket_shrink_after=cfg.aggregator.bucket_shrink_after,
+        fallback_enabled=cfg.aggregator.fallback_enabled,
+        repromote_after=cfg.aggregator.repromote_after,
+        dispatch_timeout=cfg.aggregator.dispatch_timeout,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

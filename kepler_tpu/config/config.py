@@ -343,6 +343,19 @@ class AggregatorConfig:
     # under half occupancy — a fleet hovering at a bucket edge never
     # recompile-thrashes
     bucket_shrink_after: int = 16
+    # -- device-plane fault tolerance (resilience.md "Device-plane
+    # faults"): any device-leg failure (dispatch error, compile failure,
+    # OOM on a bucket-growth recompile, hung fetch) demotes the window
+    # one ladder rung — packed pipelined → packed serial → einsum-f32
+    # serial → pure-NumPy host — instead of crashing the loop
+    fallback_enabled: bool = True
+    # consecutive clean windows at a demoted rung before the rung above
+    # is retried (hysteresis, mirroring the breaker's half-open probe)
+    repromote_after: int = 8
+    # stall watchdog on the window fetch: a dispatch that hasn't
+    # produced its output within this bound demotes instead of wedging
+    # the aggregation loop (0 disables the watchdog)
+    dispatch_timeout: float = 30.0
 
 
 @dataclass
@@ -443,6 +456,11 @@ class Config:
             errs.append("aggregator.pipelineDepth must be in [1, 8]")
         if self.aggregator.bucket_shrink_after < 1:
             errs.append("aggregator.bucketShrinkAfter must be >= 1")
+        if self.aggregator.repromote_after < 1:
+            errs.append("aggregator.repromoteAfter must be >= 1")
+        if self.aggregator.dispatch_timeout < 0:
+            errs.append("aggregator.dispatchTimeout must be >= 0 "
+                        "(0 disables the stall watchdog)")
         if self.monitor.state_max_age < 0:
             errs.append("monitor.stateMaxAge must be >= 0")
         spool = self.agent.spool
@@ -535,6 +553,9 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "dedupWindow": "dedup_window",
     "pipelineDepth": "pipeline_depth",
     "bucketShrinkAfter": "bucket_shrink_after",
+    "fallbackEnabled": "fallback_enabled",
+    "repromoteAfter": "repromote_after",
+    "dispatchTimeout": "dispatch_timeout",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
@@ -558,7 +579,7 @@ _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "backoff_initial", "backoff_max", "breaker_cooldown",
                     "flush_timeout", "skew_tolerance", "degraded_ttl",
                     "restart_backoff_initial", "restart_backoff_max",
-                    "state_max_age", "fsync_interval"}
+                    "state_max_age", "fsync_interval", "dispatch_timeout"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -684,6 +705,18 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.bucket-shrink-after",
         dest="aggregator_bucket_shrink_after", default=None, type=int,
         help="consecutive under-half windows before a batch bucket shrinks")
+    add("--aggregator.fallback-enabled", dest="aggregator_fallback_enabled",
+        default=None, action=argparse.BooleanOptionalAction,
+        help="degrade the window device leg down a fallback ladder on "
+             "failure instead of crashing the aggregation loop")
+    add("--aggregator.repromote-after", dest="aggregator_repromote_after",
+        default=None, type=int,
+        help="consecutive clean windows at a demoted rung before the "
+             "rung above is retried")
+    add("--aggregator.dispatch-timeout", dest="aggregator_dispatch_timeout",
+        default=None,
+        help="stall watchdog bound on the window fetch, e.g. 30s "
+             "(0 disables)")
     add("--agent.spool-dir", dest="agent_spool_dir", default=None,
         help="crash-safe report spool directory (empty disables)")
     add("--tpu.platform", dest="tpu_platform", default=None,
@@ -742,6 +775,11 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "pipeline_depth"), args.aggregator_pipeline_depth)
     set_if(("aggregator", "bucket_shrink_after"),
            args.aggregator_bucket_shrink_after)
+    set_if(("aggregator", "fallback_enabled"),
+           args.aggregator_fallback_enabled)
+    set_if(("aggregator", "repromote_after"), args.aggregator_repromote_after)
+    set_if(("aggregator", "dispatch_timeout"),
+           args.aggregator_dispatch_timeout, _parse_duration)
     if args.agent_spool_dir is not None:
         cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)

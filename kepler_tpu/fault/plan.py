@@ -49,6 +49,12 @@ KNOWN_SITES = (
     "disk.fsync_error",     # spool: fsync fails (record stays in page cache)
     "disk.torn_tail",       # spool: partial frame written, append "dies"
     "telemetry.drop",       # telemetry: a completed cycle trace is dropped
+    # device-plane window leg (aggregator degradation ladder,
+    # docs/developer/resilience.md "Device-plane faults")
+    "device.dispatch_error",  # window: the XLA dispatch raises
+    "device.compile_error",   # window: a cold program/update compile fails
+    "device.oom_on_grow",     # window: a bucket-growth recompile OOMs
+    "device.stall",           # window: the fetch hangs `arg` seconds
 )
 
 

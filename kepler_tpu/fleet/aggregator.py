@@ -36,6 +36,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import queue
 import threading
 import time as _time
 from dataclasses import dataclass
@@ -43,10 +44,11 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-from kepler_tpu import telemetry
+from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
-from kepler_tpu.fleet.window import (PackedWindowEngine, RowInput,
-                                     WindowMeta, align_zone_matrices)
+from kepler_tpu.fleet.window import (DeviceWindowError, PackedWindowEngine,
+                                     RowInput, WindowMeta,
+                                     align_zone_matrices)
 from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.telemetry import DEFAULT_DELIVERY_BUCKETS, Histogram
 from kepler_tpu.parallel.aggregator_core import (
@@ -67,6 +69,20 @@ log = logging.getLogger("kepler.fleet.aggregator")
 # workloads ≈ 50 KiB of arrays + ids) — enforced by the server before the
 # body is buffered
 MAX_REPORT_BYTES = 64 << 20
+
+# degradation-ladder rungs for the window's device leg
+# (docs/developer/resilience.md "Device-plane faults"): every device
+# failure demotes ONE rung; `repromote_after` consecutive clean windows
+# at a lower rung retry the rung above (hysteresis, like the breaker's
+# half-open probe and the bucket ladder's shrink window). The bottom
+# rung touches no jax API at all, so the aggregator keeps publishing
+# with the device plane completely dead.
+RUNG_PIPELINED = 0  # packed-f16 resident batch, pipelineDepth in flight
+RUNG_PACKED_SERIAL = 1  # packed-f16 resident batch, depth 1
+RUNG_EINSUM = 2  # serial einsum-f32 (full assemble + dense dispatch)
+RUNG_NUMPY = 3  # pure-NumPy host fallback (no device, no jax)
+RUNG_NAMES = ("packed-pipelined", "packed-serial", "einsum-serial",
+              "numpy-host")
 
 # per-mode checkpoint layout: required keys, and which key's last axis is
 # the zone count Z. Temporal params serve through the dedicated history
@@ -117,6 +133,50 @@ class _Pending:
     zone_names: list | None = None
     feat_hist: object = None
     t_valid: object = None
+
+
+class _FetchWorker:
+    """One persistent daemon thread running window fetches, so the
+    dispatch-timeout watchdog can bound them without spawning a thread
+    per window (the healthy hot path publishes every interval forever).
+    A fetch that exceeds its timeout abandons the WORKER — it stays
+    parked in native code on the hung handle, which the ladder's ring
+    re-seed guarantees nothing else reads — and the aggregator lazily
+    replaces it on the next fetch."""
+
+    __slots__ = ("_requests", "_thread")
+
+    def __init__(self) -> None:
+        self._requests: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kepler-window-fetch")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn, out = self._requests.get()
+            if fn is None:
+                return
+            try:
+                out.put(("value", fn()))
+            except BaseException as err:  # relayed to the caller thread
+                out.put(("error", err))
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._requests.put((None, None))
+
+    def run(self, fn, timeout: float):
+        """→ ("value", result) | ("error", exc) | None on timeout (the
+        worker is then permanently occupied — abandon it)."""
+        out: queue.Queue = queue.Queue(maxsize=1)
+        self._requests.put((fn, out))
+        try:
+            return out.get(timeout=timeout)
+        except queue.Empty:
+            return None
 
 
 class _SeqTracker:
@@ -293,6 +353,9 @@ class Aggregator:
         delivery_buckets: Sequence[float] | None = None,
         pipeline_depth: int = 1,
         bucket_shrink_after: int = 16,
+        fallback_enabled: bool = True,
+        repromote_after: int = 8,
+        dispatch_timeout: float = 30.0,
         clock=None,
         mesh=None,
     ) -> None:
@@ -391,7 +454,11 @@ class Aggregator:
                        "last_dispatch_ms": 0.0,
                        "last_wait_ms": 0.0,
                        "last_h2d_rows": 0,
-                       "window_compiles_total": 0}
+                       "window_compiles_total": 0,
+                       # degradation ladder (0 = healthy full path)
+                       "window_rung": 0,
+                       "window_demotions_total": 0,
+                       "window_repromotions_total": 0}
         # cumulative per-node energy for _total counters: a shared dense
         # RowStore (the same machinery as the monitor's per-workload
         # accumulators) whose columns follow the canonical zone axis and
@@ -419,6 +486,29 @@ class Aggregator:
         self._pipeline_lock = threading.Lock()
         self._inflight: collections.deque[_Pending] = collections.deque()  # keplint: guarded-by=_pipeline_lock
         self._engine: PackedWindowEngine | None = None
+        # -- device-plane degradation ladder (fleet.window faults) ---------
+        # state is written only by the aggregation loop; reads from the
+        # probe/metrics threads snapshot under _results_lock
+        self._fallback_enabled = bool(fallback_enabled)
+        self._repromote_after = max(1, int(repromote_after))
+        self._dispatch_timeout = max(0.0, float(dispatch_timeout))
+        self._rung = RUNG_PIPELINED  # keplint: guarded-by=_results_lock
+        self._clean_windows = 0  # consecutive clean at the current rung
+        self._windows_since_failure = 0
+        # failed-probe backoff (the breaker's doubling cooldown, ladder-
+        # shaped): a demotion that lands before a just-promoted rung
+        # proves itself doubles the clean-window threshold for the next
+        # probe (capped), so probing a permanently wedged device — each
+        # stall probe abandons one fetch worker — has a DECAYING cadence,
+        # not a constant leak rate. Reset on reaching full health.
+        self._probe_penalty = 1
+        self._probe_penalty_cap = 64
+        self._just_promoted = False
+        self._last_window_failure = ""
+        self._demotions_by_reason: dict[str, int] = {}  # keplint: guarded-by=_results_lock
+        # lazy, replaced after a stall abandons it; used only by the
+        # publish path (serialized by _pipeline_lock)
+        self._fetch_worker: _FetchWorker | None = None
 
     def name(self) -> str:
         return "fleet-aggregator"
@@ -452,6 +542,7 @@ class Aggregator:
         health = getattr(self._server, "health", None)
         if health is not None:
             health.register_probe("fleet-aggregator", self.health)
+            health.register_probe("fleet-window", self.window_health)
             # ready once init completed: endpoints registered, mesh built,
             # params validated — an empty fleet is still a ready aggregator
             health.register_readiness("fleet-aggregator",
@@ -479,6 +570,9 @@ class Aggregator:
         # idempotent with the run()-exit drain (the deque is empty then);
         # covers direct aggregate_once() users who never ran the loop
         self._drain_pipeline()
+        worker, self._fetch_worker = self._fetch_worker, None
+        if worker is not None:
+            worker.stop()
 
     # -- ingest ------------------------------------------------------------
 
@@ -758,6 +852,138 @@ class Aggregator:
             out["last_window_age_s"] = round(self._clock() - last, 3)
         return out
 
+    def window_health(self) -> dict:
+        """``fleet-window`` probe for /healthz: degraded while the device
+        window leg runs below the full packed-pipelined rung. Names the
+        rung, so operators see WHAT degraded service they are getting
+        (einsum-serial = slower but exact; numpy-host = device fully
+        dead, ratio attribution still correct)."""
+        with self._results_lock:
+            out = {
+                "ok": self._rung == RUNG_PIPELINED,
+                "rung": self._rung,
+                "rung_name": RUNG_NAMES[self._rung],
+                "demotions_total": self._stats["window_demotions_total"],
+                "repromotions_total":
+                    self._stats["window_repromotions_total"],
+                "windows_since_last_failure": self._windows_since_failure,
+                "fallback_enabled": self._fallback_enabled,
+                "probe_backoff": self._probe_penalty,
+            }
+            if self._last_window_failure:
+                out["last_failure"] = self._last_window_failure
+        return out
+
+    # -- degradation ladder ------------------------------------------------
+
+    def _handle_device_failure(self, err: Exception) -> None:
+        """One device-leg failure: abandon every in-flight window (their
+        handles may be poisoned — a donated buffer consumed by a failed
+        dispatch can never be read or rebound), re-seed the resident ring
+        and host staging from scratch, and demote one rung. The caller
+        recomputes the CURRENT window at the new rung, so the interval
+        still publishes."""
+        reason = (err.reason if isinstance(err, DeviceWindowError)
+                  else "runtime_error")
+        with self._pipeline_lock:
+            abandoned = len(self._inflight)
+            self._inflight.clear()
+        if self._engine is not None:
+            self._engine.reset()
+        self._program = None  # a failed serial program recompiles fresh
+        with self._results_lock:
+            prev = self._rung
+            self._rung = min(prev + 1, RUNG_NUMPY)
+            rung = self._rung
+            self._clean_windows = 0
+            self._windows_since_failure = 0
+            if self._just_promoted:
+                # a failed PROBE (the promoted rung died before proving
+                # itself): back off the next probe exponentially
+                self._probe_penalty = min(self._probe_penalty * 2,
+                                          self._probe_penalty_cap)
+                self._just_promoted = False
+            self._demotions_by_reason[reason] = \
+                self._demotions_by_reason.get(reason, 0) + 1
+            self._stats["window_demotions_total"] += 1
+            self._stats["window_rung"] = rung
+            self._last_window_failure = f"{reason}: {err}"[:240]
+        log.error("fleet window device leg failed (%s) at rung %s; "
+                  "demoting to %s, %d in-flight window(s) abandoned, "
+                  "resident ring re-seeded: %s", reason, RUNG_NAMES[prev],
+                  RUNG_NAMES[rung], abandoned, err)
+
+    def _ladder_window_ok(self) -> None:
+        """One window published without a device failure. At a demoted
+        rung, ``repromote_after`` consecutive clean windows retry the
+        rung above (one step at a time — the breaker's half-open probe,
+        ladder-shaped). A failure during the retried rung demotes right
+        back and restarts the count."""
+        promoted = None
+        with self._results_lock:
+            self._windows_since_failure += 1
+            if self._just_promoted:
+                self._just_promoted = False  # the rung proved itself
+                if self._rung == RUNG_PIPELINED:
+                    # reset only AFTER the healthy rung publishes a clean
+                    # window — resetting at promotion time would let a
+                    # rung-0-specific failure probe at a constant ~2×
+                    # cadence forever instead of decaying to the cap
+                    self._probe_penalty = 1
+            if self._rung != RUNG_PIPELINED:
+                self._clean_windows += 1
+                needed = self._repromote_after * self._probe_penalty
+                if self._clean_windows >= needed:
+                    self._rung -= 1
+                    self._clean_windows = 0
+                    self._just_promoted = True
+                    self._stats["window_repromotions_total"] += 1
+                    self._stats["window_rung"] = self._rung
+                    promoted = self._rung
+        if promoted is not None:
+            log.info("fleet window ladder: clean-window threshold met — "
+                     "re-promoted to rung %d (%s)", promoted,
+                     RUNG_NAMES[promoted])
+
+    def _fetch_device(self, fn):
+        """Blocking device fetch with MonitorWatchdog-style stall
+        detection: the fetch runs on the persistent ``_FetchWorker``
+        thread bounded by ``dispatch_timeout`` — a hung dispatch (wedged
+        tunnel, dead device runtime) DEMOTES instead of wedging the
+        aggregation loop forever. On a stall the worker is abandoned
+        (parked in native code on a handle the ring re-seed guarantees
+        nothing else reads) and replaced lazily. ``device.stall``
+        injects a deterministic hang of ``arg`` seconds ahead of the
+        real fetch."""
+        spec = fault.fire("device.stall")
+
+        def work():
+            if spec is not None and spec.arg:
+                _time.sleep(float(spec.arg))
+            return fn()
+
+        timeout = self._dispatch_timeout
+        if timeout <= 0:
+            return work()
+        worker = self._fetch_worker
+        if worker is None or not worker.alive():
+            worker = self._fetch_worker = _FetchWorker()
+        outcome = worker.run(work, timeout)
+        if outcome is None:
+            # abandon the occupied worker, but queue its stop sentinel:
+            # a TRANSIENTLY stuck fetch that eventually completes lets
+            # the thread exit instead of parking forever; a truly wedged
+            # one is no worse off
+            self._fetch_worker = None
+            worker.stop()
+            raise DeviceWindowError(
+                "stall", f"window fetch exceeded aggregator."
+                f"dispatchTimeout {timeout:g}s")
+        kind, value = outcome
+        if kind == "error":
+            raise value
+        return value
+
     # -- aggregation -------------------------------------------------------
 
     def aggregate_once(self) -> "FleetResults | None":
@@ -802,24 +1028,53 @@ class Aggregator:
                                    key=lambda s: s.report.node_name)
             zone_names = sorted(
                 {z for s in stored_sorted for z in s.zone_names})
-            if self._use_packed():
-                pending = self._dispatch_packed(stored_sorted, zone_names,
-                                                now, t_win)
-            else:
-                pending = self._dispatch_legacy(stored_sorted, zone_names,
-                                                now, t_win)
-            with self._pipeline_lock:
-                self._inflight.append(pending)
-                # prune cumulative totals while the device computes —
-                # host work needing no outputs overlaps the window
-                for name, seen in list(self._cum_last_seen.items()):
-                    if now - seen > self._cum_retention:
-                        del self._cum_last_seen[name]
-                        self._cum.pop(name)
-                published = None
-                while len(self._inflight) >= self._pipeline_depth:
-                    published = self._publish(self._inflight.popleft())
-                return published
+            # degradation-ladder retry loop: a device-leg failure demotes
+            # one rung and RECOMPUTES this interval's window there, so a
+            # dead device costs latency, never a publish. Bounded: the
+            # rung strictly increases per retry and the bottom rung's
+            # failures re-raise (a NumPy bug is a bug, not degradation).
+            while True:
+                try:
+                    return self._window_step(stored_sorted, zone_names,
+                                             now, t_win)
+                except Exception as err:
+                    if (not self._fallback_enabled
+                            or self._rung >= RUNG_NUMPY):
+                        raise
+                    self._handle_device_failure(err)
+
+    def _window_step(self, stored_sorted: list, zone_names: list[str],
+                     now: float, t_win: float) -> "FleetResults | None":
+        """One dispatch+publish pass at the CURRENT ladder rung."""
+        rung = self._rung
+        if rung >= RUNG_NUMPY:
+            pending = self._dispatch_numpy(stored_sorted, zone_names,
+                                           now, t_win)
+        elif rung >= RUNG_EINSUM or not self._use_packed():
+            pending = self._dispatch_legacy(stored_sorted, zone_names,
+                                            now, t_win)
+        else:
+            pending = self._dispatch_packed(stored_sorted, zone_names,
+                                            now, t_win)
+        # every demoted rung drains each window (no in-flight handle
+        # outlives its own interval); only the healthy rung pipelines —
+        # the legacy path included (temporal/accuracy modes pipeline at
+        # rung 0 exactly as before the ladder existed)
+        depth = self._pipeline_depth if rung == RUNG_PIPELINED else 1
+        with self._pipeline_lock:
+            self._inflight.append(pending)
+            # prune cumulative totals while the device computes —
+            # host work needing no outputs overlaps the window
+            for name, seen in list(self._cum_last_seen.items()):
+                if now - seen > self._cum_retention:
+                    del self._cum_last_seen[name]
+                    self._cum.pop(name)
+            published = None
+            while len(self._inflight) >= depth:
+                published = self._publish(self._inflight.popleft())
+        if published is not None:
+            self._ladder_window_ok()
+        return published
 
     def _use_packed(self) -> bool:
         """Packed-f16 resident path is the default; the serial einsum-f32
@@ -831,9 +1086,21 @@ class Aggregator:
 
     def _drain_pipeline(self) -> "FleetResults | None":
         published = None
+        failure: Exception | None = None
         with self._pipeline_lock:
             while self._inflight:
-                published = self._publish(self._inflight.popleft())
+                try:
+                    published = self._publish(self._inflight.popleft())
+                except Exception as err:
+                    # a drain has no current window to recompute (empty
+                    # fleet or shutdown) — abandon what's left, demote,
+                    # and let the next live window run at the lower rung
+                    failure = err
+                    break
+        if failure is not None:
+            if not self._fallback_enabled:
+                raise failure
+            self._handle_device_failure(failure)
         return published
 
     # -- dispatch half ------------------------------------------------------
@@ -862,6 +1129,13 @@ class Aggregator:
         with telemetry.span("window.h2d_delta"):
             plan = self._engine.plan_window(rows, zone_names, params)
         t_planned = _time.perf_counter()
+        # consulted AFTER the donated ring update ran: a dispatch that
+        # dies here leaves a consumed donated buffer behind — exactly the
+        # poisoned-ring state the ladder's reset() re-seed exists for
+        if fault.fire("device.dispatch_error") is not None:
+            raise DeviceWindowError(
+                "dispatch_error",
+                "injected dispatch failure (packed window program)")
         if plan.cold:
             # first dispatch of this (buckets, zones, mode) key: the call
             # blocks on trace+XLA-compile; execution itself stays async
@@ -893,6 +1167,10 @@ class Aggregator:
             zone_deltas_mat=zd_mat, zone_valid_mat=zv_mat)
         cold = self._program is None
         if cold:
+            if fault.fire("device.compile_error") is not None:
+                raise DeviceWindowError(
+                    "compile_error",
+                    "injected compile failure (serial fleet program)")
             if self._model_mode == "temporal":
                 self._program = make_temporal_fleet_program(
                     self._mesh, backend=self._backend,
@@ -908,6 +1186,10 @@ class Aggregator:
         if self._model_mode == "temporal":
             feat_hist, t_valid = self._history_windows(batch)
         t_assembled = _time.perf_counter()
+        if fault.fire("device.dispatch_error") is not None:
+            raise DeviceWindowError(
+                "dispatch_error",
+                "injected dispatch failure (serial fleet program)")
         # ASYNC dispatch: jax returns device futures immediately; the D2H
         # copies start NOW (they queue behind the compute on the device
         # stream) instead of at the np.asarray fetch in _publish. The
@@ -936,6 +1218,57 @@ class Aggregator:
             batch=batch, aligned=aligned, zone_names=zone_names,
             feat_hist=feat_hist, t_valid=t_valid)
 
+    def _dispatch_numpy(self, stored_sorted: list, zone_names: list[str],
+                        now: float, t_win: float) -> _Pending:
+        """Bottom ladder rung: the whole window in host NumPy — no jax,
+        no device, no compile. Ratio attribution is exact; model rows are
+        served for the NumPy-mirrored estimators (linear, mlp) when the
+        trained params fit this window's zone axis, and publish zero
+        watts otherwise (``parallel.packed.numpy_fleet_window``). Output
+        reuses the packed scatter path, so publication is identical to
+        the device rungs' minus the f16 wire quantization."""
+        from kepler_tpu.parallel.packed import (numpy_fleet_window,
+                                                pack_fleet_inputs)
+
+        aligned = [s.report for s in stored_sorted]
+        n_zones = len(zone_names)
+        zd_mat, zv_mat = align_zone_matrices(
+            aligned, [s.zone_names for s in stored_sorted], zone_names)
+        batch = assemble_fleet_batch(
+            aligned, n_zones=n_zones, node_bucket=self._node_bucket,
+            workload_bucket=self._workload_bucket,
+            zone_deltas_mat=zd_mat, zone_valid_mat=zv_mat)
+        packed = pack_fleet_inputs(batch)
+        t_assembled = _time.perf_counter()
+        params = None
+        if (self._model_mode in ("linear", "mlp")
+                and self._params is not None
+                and self._model_out_dim() == n_zones):
+            params = self._params
+        watts = numpy_fleet_window(packed, batch.cpu_deltas.shape[1],
+                                   n_zones, params, self._model_mode)
+        t_done = _time.perf_counter()
+        n_real = batch.n_nodes
+        names = list(batch.node_names[:n_real])
+        meta = WindowMeta(
+            zones=list(zone_names),
+            names=names,
+            rows={name: i for i, name in enumerate(names)},
+            mode=np.asarray(batch.mode, np.int32),
+            dt=np.asarray(batch.dt_s, np.float32),
+            counts=list(batch.workload_counts),
+            ids=list(batch.workload_ids),
+            kinds=([a.workload_kinds for a in aligned]
+                   + [None] * (watts.shape[0] - n_real)),
+            n_live=n_real,
+            n_rows=watts.shape[0],
+        )
+        return _Pending(
+            kind="numpy", out=watts, meta=meta, now=now,
+            assembly_ms=(t_assembled - t_win) * 1e3,
+            dispatch_ms=(t_done - t_assembled) * 1e3,
+            h2d_rows=0, compiled=False)
+
     # -- publish half -------------------------------------------------------
 
     # keplint: requires-lock=_pipeline_lock
@@ -948,16 +1281,24 @@ class Aggregator:
         t0 = _time.perf_counter()
         if p.kind == "packed":
             with telemetry.span("window.pipeline_wait"):
-                packed = np.asarray(p.out)
+                packed = self._fetch_device(lambda: np.asarray(p.out))
             t_fetched = _time.perf_counter()
             results = self._scatter_packed(p, packed)
+        elif p.kind == "numpy":
+            # host rung: the "fetch" is a no-op — p.out is already a host
+            # array (and consulting the stall site would be a lie: there
+            # is no device leg to hang)
+            t_fetched = _time.perf_counter()
+            results = self._scatter_packed(p, p.out)
         else:
             result = p.out
             with telemetry.span("window.pipeline_wait"):
-                node_power = np.asarray(result.node_power_uw)
-                node_energy = np.asarray(result.node_energy_uj)
-                wl_power = np.asarray(result.workload_power_uw)
-                wl_energy = np.asarray(result.workload_energy_uj)
+                fetched = self._fetch_device(lambda: (
+                    np.asarray(result.node_power_uw),
+                    np.asarray(result.node_energy_uj),
+                    np.asarray(result.workload_power_uw),
+                    np.asarray(result.workload_energy_uj)))
+            node_power, node_energy, wl_power, wl_energy = fetched
             t_fetched = _time.perf_counter()
             results = self._scatter_legacy(p, node_power, node_energy,
                                            wl_power, wl_energy)
@@ -1278,6 +1619,7 @@ class Aggregator:
         with self._results_lock:
             results = self._results
             stats = dict(self._stats)
+            demotions_snap = sorted(self._demotions_by_reason.items())
         nodes = GaugeMetricFamily(
             "kepler_fleet_nodes", "Nodes in the last fleet batch")
         nodes.add_metric([], stats["last_batch_nodes"])
@@ -1316,6 +1658,26 @@ class Aggregator:
             "growth is geometric, shrink is hysteretic)")
         compiles.add_metric([], stats["window_compiles_total"])
         yield compiles
+        rung = GaugeMetricFamily(
+            "kepler_fleet_window_degraded",
+            "Degradation-ladder rung of the window's device leg "
+            "(0 = packed-f16 pipelined [healthy], 1 = packed serial, "
+            "2 = einsum-f32 serial, 3 = pure-NumPy host fallback)")
+        rung.add_metric([], stats["window_rung"])
+        yield rung
+        demotions = CounterMetricFamily(
+            "kepler_fleet_window_demotions_total",
+            "Window device-leg ladder demotions, by failure reason",
+            labels=["reason"])
+        for reason, count in demotions_snap:
+            demotions.add_metric([reason], count)
+        yield demotions
+        repromotions = CounterMetricFamily(
+            "kepler_fleet_window_repromotions_total",
+            "Window ladder re-promotions (repromoteAfter consecutive "
+            "clean windows at a demoted rung retried the rung above)")
+        repromotions.add_metric([], stats["window_repromotions_total"])
+        yield repromotions
         total = CounterMetricFamily(
             "kepler_fleet_attributions_total", "Completed fleet attributions")
         total.add_metric([], stats["attributions_total"])

@@ -40,17 +40,30 @@ from typing import Any, Callable, NamedTuple, Sequence
 
 import numpy as np
 
+from kepler_tpu import fault
 from kepler_tpu.parallel.fleet import (MODE_MODEL, NodeReport,
                                        assemble_fleet_batch)
 
 __all__ = [
     "BucketLadder",
+    "DeviceWindowError",
     "PackedWindowEngine",
     "RowInput",
     "WindowMeta",
     "WindowPlan",
     "align_zone_matrices",
 ]
+
+
+class DeviceWindowError(RuntimeError):
+    """A device-leg failure inside the fleet window (dispatch, compile,
+    bucket-growth recompile, stall). ``reason`` is the bounded label the
+    degradation ladder counts demotions under
+    (``kepler_fleet_window_demotions_total{reason}``)."""
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.reason = reason
 
 # per-buffer row-content sentinels: _EMPTY = the device row is the packed
 # empty row (cleared / never filled); _DIRTY = unknown content, must be
@@ -273,6 +286,13 @@ class PackedWindowEngine:
         key = (nb, wb, z, self._model_mode or "", mb)
         entry = self._programs.get(key)
         if entry is None:
+            # fired BEFORE the entry caches: a failed compile leaves no
+            # poisoned cache entry behind, so the retry (at a lower rung,
+            # or after the fault window closes) compiles for real
+            if fault.fire("device.compile_error") is not None:
+                raise DeviceWindowError(
+                    "compile_error",
+                    f"injected compile failure for program key {key}")
             from kepler_tpu.parallel.packed import make_packed_fleet_program
 
             program = make_packed_fleet_program(
@@ -290,6 +310,10 @@ class PackedWindowEngine:
         key = (n, width, db)
         entry = self._updates.get(key)
         if entry is None:
+            if fault.fire("device.compile_error") is not None:
+                raise DeviceWindowError(
+                    "compile_error",
+                    f"injected compile failure for update key {key}")
             jax = self._jax
 
             def scatter_rows(resident, rows, idx):
@@ -317,8 +341,18 @@ class PackedWindowEngine:
         zones_t = tuple(zone_names)
         z = len(zones_t)
         need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
+        prev_nb, prev_wb = self._ladder_n.bucket, self._ladder_w.bucket
         wb = self._ladder_w.fit(need_w)
         nb = self._ladder_n.fit(len(rows))
+        if self._buffers and (nb > prev_nb or wb > prev_wb):
+            # a bucket GREW mid-run: the next dispatch allocates a larger
+            # resident batch + compiles a new rung — the realistic OOM
+            # point on a memory-tight device
+            if fault.fire("device.oom_on_grow") is not None:
+                raise DeviceWindowError(
+                    "oom_on_grow",
+                    f"injected OOM growing buckets ({prev_nb}, {prev_wb})"
+                    f" → ({nb}, {wb})")
         key = (nb, wb, zones_t)
         if key != self._key or not self._buffers:
             h2d_rows = self._rebuild(rows, nb, wb, zones_t)
@@ -359,6 +393,36 @@ class PackedWindowEngine:
         entry[1] = False
         return WindowPlan(program=program, args=args, cold=cold, meta=meta,
                           h2d_rows=h2d_rows)
+
+    # -- failure recovery --------------------------------------------------
+
+    def reset(self) -> None:
+        """Abandon the resident ring and host staging wholesale.
+
+        Called by the aggregator's degradation ladder after ANY device-leg
+        failure: a donated buffer consumed by a failed dispatch can never
+        be read or rebound, and a buffer whose update raised mid-scatter
+        holds unknown bytes — so per-buffer ``(run, seq)`` identity is
+        invalidated across the board and the next :meth:`plan_window`
+        performs a full re-pack (``_rebuild``) from the report store.
+        Program/update caches survive (a compiled executable is not
+        poisoned by a failed dispatch); the bucket ladders keep their
+        sizes so recovery doesn't recompile every rung from base.
+        """
+        self._key = None
+        self._buffers = []
+        self._content = []
+        self._buf_i = 0
+        self._names = []
+        self._row_of = {}
+        self._mode = []
+        self._dt = []
+        self._counts = []
+        self._ids = []
+        self._kinds = []
+        self._free = []
+        self._stage_i = 0
+        self._stages = [np.zeros((0, 0), np.float32) for _ in self._stages]
 
     # -- resident maintenance ----------------------------------------------
 

@@ -41,7 +41,7 @@ from kepler_tpu.parallel.aggregator_core import (
     resolve_attribute_fn,
     shard_by_node,
 )
-from kepler_tpu.parallel.fleet import FleetBatch
+from kepler_tpu.parallel.fleet import MODE_MODEL, FleetBatch
 from kepler_tpu.parallel.mesh import NODE_AXIS
 from kepler_tpu.models.estimator import predictor
 
@@ -217,6 +217,127 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
                       NamedSharding(mesh, P(NODE_AXIS, None))),
         out_shardings=NamedSharding(mesh, P(NODE_AXIS)),
     )
+
+
+def _numpy_gelu(x: np.ndarray) -> np.ndarray:
+    """jax.nn.gelu's default (tanh-approximate) formulation in NumPy."""
+    c = np.float32(np.sqrt(2.0 / np.pi))
+    return np.float32(0.5) * x * (
+        np.float32(1.0) + np.tanh(c * (x + np.float32(0.044715) * x ** 3)))
+
+
+def _numpy_features(cpu: np.ndarray, valid: np.ndarray, denom: np.ndarray,
+                    ratio: np.ndarray, dt: np.ndarray) -> np.ndarray:
+    """NumPy mirror of models.features.build_features → f32 [N, W, F]."""
+    deltas = np.where(valid, cpu, 0.0).astype(np.float32)
+    d = denom[:, None]
+    share = np.where(d > 0.0, deltas / np.maximum(d, 1e-30), 0.0)
+    dtc = dt[:, None]
+    rate = np.where(dtc > 0.0, deltas / np.maximum(dtc, 1e-30), 0.0)
+    w_shape = deltas.shape
+    node_log = np.log1p(np.maximum(denom, 0.0))
+    feats = np.stack([
+        deltas,
+        share,
+        np.broadcast_to(ratio[:, None], w_shape),
+        np.broadcast_to(dt[:, None], w_shape),
+        rate,
+        np.ones_like(deltas),
+        np.broadcast_to(node_log[:, None], w_shape),
+    ], axis=-1).astype(np.float32)
+    return np.where(valid[..., None], feats, 0.0)
+
+
+def _numpy_model_watts(model_mode: str, params, feats: np.ndarray,
+                       valid: np.ndarray) -> np.ndarray | None:
+    """NumPy forward for the estimators the host rung can serve (linear,
+    mlp — the shipped default). → watts f32 [N, W, Z], or None when the
+    mode has no NumPy mirror (moe/deep; temporal never takes the packed
+    path at all)."""
+    if params is None:
+        return None
+    try:
+        p = {k: np.asarray(v, np.float32) for k, v in dict(params).items()}
+    except Exception:
+        return None
+    if model_mode == "linear":
+        if "weight" not in p or "bias" not in p:
+            return None
+        watts = feats @ p["weight"] + p["bias"]
+    elif model_mode == "mlp":
+        if any(k not in p for k in ("w0", "b0", "w1", "b1", "w2", "b2",
+                                    "w_skip")):
+            return None
+        h = _numpy_gelu(feats @ p["w0"] + p["b0"])
+        h = _numpy_gelu(h @ p["w1"] + p["b1"])
+        watts = h @ p["w2"] + feats @ p["w_skip"] + p["b2"]
+    else:
+        return None
+    watts = np.maximum(watts.astype(np.float32), 0.0)
+    return np.where(valid[..., None], watts, 0.0)
+
+
+def numpy_fleet_window(packed: np.ndarray, n_workloads: int, n_zones: int,
+                       params=None,
+                       model_mode: str | None = None) -> np.ndarray:
+    """Pure-NumPy mirror of the packed fleet program — the aggregator's
+    host-fallback rung (docs/developer/resilience.md "Device-plane
+    faults"): same packed input layout in, same ``[N, W+2, Z]`` watts
+    layout out (f32, not f16 — there is no wire-format quantizer to
+    satisfy on host), touching no jax API at all so it keeps publishing
+    with the device plane completely dead.
+
+    Ratio-node attribution is exact (the same masked outer product the
+    device program runs). Model rows are served for the NumPy-mirrored
+    estimators (linear, mlp); modes without a host mirror (moe, deep)
+    publish zero watts for their model rows — absence, not fabrication,
+    and the ladder's health probe names the degraded rung.
+    """
+    w, z = n_workloads, n_zones
+    cpu_nan = packed[:, :w]
+    valid = ~np.isnan(cpu_nan)
+    cpu = np.where(valid, cpu_nan, 0.0).astype(np.float32)
+    zone = packed[:, w: w + z]
+    zone_valid = packed[:, w + z: w + 2 * z] > 0.5
+    ratio = packed[:, w + 2 * z + 0]
+    denom = packed[:, w + 2 * z + 1]
+    dt = packed[:, w + 2 * z + 2]
+    mode = packed[:, w + 2 * z + 3].astype(np.int32)
+
+    # node split (ops.attribution._node_split, NumPy)
+    deltas = np.where(zone_valid, zone, 0.0).astype(np.float32)
+    r = np.clip(ratio, 0.0, 1.0)[:, None]
+    active = deltas * r
+    dtc = dt[:, None]
+    safe_dt = np.where(dtc > 0.0, dtc, 1.0)
+    total_power_uw = np.where(dtc > 0.0, deltas / safe_dt, 0.0)
+    active_power_uw = np.where(dtc > 0.0, active / safe_dt, 0.0)
+    # workload ratios + the [W] ⊗ [Z] outer product, batched
+    d = denom[:, None]
+    ratios = np.where(d > 0.0,
+                      cpu / np.maximum(d, 1e-30), 0.0).astype(np.float32)
+    wl_power_uw = np.einsum("nw,nz->nwz", ratios, active_power_uw)
+
+    node_active_w = active_power_uw * 1e-6  # µW → W (packed wire unit)
+    node_total_w = total_power_uw * 1e-6
+    wl_watts = wl_power_uw * 1e-6
+
+    model_rows = np.flatnonzero(mode == MODE_MODEL)
+    if model_rows.size and model_mode:
+        feats = _numpy_features(cpu[model_rows], valid[model_rows],
+                                denom[model_rows], ratio[model_rows],
+                                dt[model_rows])
+        watts = _numpy_model_watts(model_mode, params, feats,
+                                   valid[model_rows])
+        if watts is None:
+            watts = np.zeros((model_rows.size, w, z), np.float32)
+        wl_watts[model_rows] = watts
+        est_node = watts.sum(axis=1)
+        node_active_w[model_rows] = est_node
+        node_total_w[model_rows] = est_node
+    return np.concatenate(
+        [wl_watts, node_active_w[:, None, :], node_total_w[:, None, :]],
+        axis=1).astype(np.float32)
 
 
 def unpack_fleet_watts(packed_watts: np.ndarray) -> tuple[np.ndarray,

@@ -1,0 +1,162 @@
+"""The bench evidence contract (ROADMAP item 5, ISSUE 6 satellite).
+
+The driver captures only a bounded TAIL of bench stdout (~2000 chars);
+rounds 4 and 5 lost the whole TPU measurement because the detail row
+outgrew it (BENCH_r04 rc=1, BENCH_r05 ``parsed: null``). The contract
+pinned here:
+
+* ``bench.py``'s LAST stdout line is a compact single-line JSON headline
+  (metric, platform, ``cpu_fallback``, gate booleans) that stays ≤ 1000
+  chars no matter how fat the detail row gets, so it survives any
+  ~2000-char tail truncation;
+* the full detail row goes to a file (``BENCH_DETAIL.json``), referenced
+  from the headline;
+* an errored bench leg FAILS its gate in the headline (ADVICE r5: a leg
+  that raised is a failure, never a silent skip).
+
+These tests exercise the builder/gate functions directly — no device
+work, no subprocesses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def fat_result(**overrides) -> dict:
+    """A detail row far beyond any tail window: every real key bench
+    emits plus pathological bulk."""
+    row = {
+        "metric": "attribution_program_p99_ms_10k_pods",
+        "value": 0.123456,
+        "unit": "ms",
+        "vs_baseline": 8.1,
+        "platform": "tpu",
+        "backend": "einsum",
+        "cpu_fallback": False,
+        "accuracy_ok": True,
+        "e2e_pipeline_ok": True,
+        "soak_ok": True,
+        "aggwin_within_budget": True,
+        "aggwin_pipeline_ok": True,
+        "aggwin_host_p50_ms": 21.4,
+        "aggwin_host_p99_ms": 55.2,
+        "aggwin_pipeline_p50_ms": 101.2,
+        "aggwin_pipeline_ratio": 0.41,
+        "e2e_pipelined_p99_ms": 7.1,
+        "sync_floor_p50_ms": 66.0,
+        # pathological bulk: thousands of chars of per-leg detail
+        **{f"leg_{i}_detail_ms": i * 0.001 for i in range(400)},
+        "notes": "x" * 3000,
+    }
+    row.update(overrides)
+    return row
+
+
+class TestHeadline:
+    def test_single_line_bounded_and_parseable(self):
+        line = bench.build_headline(fat_result(ok=True), "BENCH_DETAIL.json")
+        assert "\n" not in line
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["metric"] == "attribution_program_p99_ms_10k_pods"
+        assert head["platform"] == "tpu"
+        assert head["cpu_fallback"] is False
+        assert head["ok"] is True
+        assert head["detail_file"] == "BENCH_DETAIL.json"
+        for gate in ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
+                     "aggwin_within_budget", "aggwin_pipeline_ok"):
+            assert head[gate] is True
+
+    def test_survives_tail_window_truncation(self):
+        """The exact failure mode of rounds 4-5: the driver keeps only
+        the last ~2000 chars of stdout. The headline is printed LAST, so
+        the tail's last line must still parse as the headline row."""
+        result = fat_result(ok=True)
+        detail_row = json.dumps(result)
+        assert len(detail_row) > 2000  # the detail row alone would be lost
+        headline = bench.build_headline(result, "BENCH_DETAIL.json")
+        stdout = detail_row + "\n" + headline + "\n"
+        tail = stdout[-2000:]
+        last_line = tail.strip().splitlines()[-1]
+        head = json.loads(last_line)
+        assert head["metric"] == "attribution_program_p99_ms_10k_pods"
+        assert "detail_file" in head
+
+    def test_total_failure_row_is_headline_shaped(self):
+        line = bench.build_headline(
+            {"metric": "attribution_program_p99_ms_10k_pods",
+             "value": None, "unit": "ms", "ok": False,
+             "error": "both bench attempts failed (last rc=1)",
+             "platform": "none"}, "")
+        head = json.loads(line)
+        assert head["ok"] is False
+        assert head["value"] is None
+        assert "error" in head
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+
+    def test_pathological_field_clamps_to_core(self):
+        """A pathological env-provided detail path is the one field that
+        can actually outgrow the cap: the clamp must fire (not just
+        exist) and the clamped line must still honor the size contract.
+        The path is dropped from the headline — the file still exists on
+        disk — rather than silently breaking tail survival."""
+        long_path = "/tmp/" + "d" * 1500 + "/BENCH_DETAIL.json"
+        line = bench.build_headline(fat_result(ok=True), long_path)
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["metric"] == "attribution_program_p99_ms_10k_pods"
+        assert head["detail_file"] == ""  # dropped, not truncated garbage
+
+    def test_long_error_field_is_truncated_inline(self):
+        """error strings are bounded to 200 chars up front, so a fat
+        error never needs the clamp and the detail path survives."""
+        result = fat_result(ok=False, error="e" * 5000)
+        line = bench.build_headline(result, "BENCH_DETAIL.json")
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert len(head["error"]) == 200
+        assert head["detail_file"] == "BENCH_DETAIL.json"
+
+
+class TestErroredLegGates:
+    @pytest.mark.parametrize("err_key,gates", sorted(
+        bench.LEG_ERROR_GATES.items()))
+    def test_errored_leg_fails_its_gate(self, err_key, gates):
+        result = fat_result(**{err_key: "TimeoutExpired(900)"})
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        for gate in gates:
+            assert result[gate] is False
+        # exactly ONE message, naming the errored leg — never a second,
+        # fabricated "budget violated" diagnostic for a measurement that
+        # never ran
+        assert len(messages) == 1
+        assert err_key in messages[0]
+        result["ok"] = not failed
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert head["ok"] is False
+        assert err_key in head["leg_errors"]
+        for gate in gates:
+            assert head[gate] is False
+
+    def test_clean_run_passes(self):
+        result = fat_result()
+        failed, messages = bench.evaluate_gates(result, on_tpu=True)
+        assert not failed
+        assert messages == []
+        assert result["node_scrape_ok"] is True
+
+    def test_soak_slo_violation_still_gates(self):
+        result = fat_result(soak_ok=False)
+        failed, _ = bench.evaluate_gates(result, on_tpu=False)
+        assert failed

@@ -380,6 +380,16 @@ FIELD_MATRIX = [
     FieldCase("aggregator.bucket_shrink_after",
               "aggregator: {bucketShrinkAfter: 4}", 4,
               ["--aggregator.bucket-shrink-after", "8"], 8),
+    # device-plane fault tolerance (ISSUE 6)
+    FieldCase("aggregator.fallback_enabled",
+              "aggregator: {fallbackEnabled: false}", False,
+              ["--aggregator.fallback-enabled"], True),
+    FieldCase("aggregator.repromote_after",
+              "aggregator: {repromoteAfter: 4}", 4,
+              ["--aggregator.repromote-after", "3"], 3),
+    FieldCase("aggregator.dispatch_timeout",
+              "aggregator: {dispatchTimeout: 15s}", 15.0,
+              ["--aggregator.dispatch-timeout", "5s"], 5.0),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -498,6 +508,9 @@ class TestYAMLSpellings:
         "dedupWindow": "aggregator",
         "pipelineDepth": "aggregator",
         "bucketShrinkAfter": "aggregator",
+        "fallbackEnabled": "aggregator",
+        "repromoteAfter": "aggregator",
+        "dispatchTimeout": "aggregator",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
@@ -547,6 +560,9 @@ class TestYAMLSpellings:
         "dedupWindow": ("64", 64),
         "pipelineDepth": ("3", 3),
         "bucketShrinkAfter": ("4", 4),
+        "fallbackEnabled": ("false", False),
+        "repromoteAfter": ("4", 4),
+        "dispatchTimeout": ("15s", 15.0),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),
@@ -637,6 +653,12 @@ class TestValidationMatrix:
         ("service.restartBackoffInitial",
          lambda c: setattr(c.service, "restart_backoff_initial", -1),
          "restartBackoffInitial"),
+        ("aggregator.repromoteAfter",
+         lambda c: setattr(c.aggregator, "repromote_after", 0),
+         "repromoteAfter"),
+        ("aggregator.dispatchTimeout",
+         lambda c: setattr(c.aggregator, "dispatch_timeout", -1),
+         "dispatchTimeout"),
         ("fault.specs",
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),

@@ -814,6 +814,45 @@ class TestDonatedBufferRule:
         """, rel=self.REL)
         assert ids(diags) == ["KTL110"]
 
+    def test_failure_path_abandon_and_rebind_is_clean(self, lint):
+        # the degradation-ladder recovery idiom (ISSUE 6): a donating
+        # call that RAISES leaves the handle consumed; the failure path
+        # must abandon the ring and rebind fresh buffers, never read the
+        # dead handle — and that exact shape is lexically provable clean
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows, fresh):
+                try:
+                    resident = update(resident, rows)
+                except RuntimeError:
+                    # abandon ring, rebind fresh buffers (engine.reset())
+                    resident = fresh()
+                return resident.sum()
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_failure_path_reading_dead_handle_flagged(self, lint):
+        # the anti-pattern the idiom exists to prevent: the except
+        # handler "salvages" the donated handle — whose buffer the
+        # failed dispatch may already have consumed
+        diags = lint("""
+            import jax
+
+            update = jax.jit(lambda r, x: r + x, donate_argnums=(0,))
+
+            def step(resident, rows):
+                try:
+                    out = update(resident, rows)
+                except RuntimeError:
+                    out = resident.sum()  # dead buffer
+                return out
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+        assert "resident" in diags[0].message
+
     def test_jit_without_donation_ignored(self, lint):
         diags = lint("""
             import jax
