@@ -44,6 +44,10 @@ bench-scenarios: ## five BASELINE.json scenarios + temporal-fleet; budget GATE (
 dryrun: ## compile-check driver entry points on a virtual 8-device mesh
 	$(PYTHON) __graft_entry__.py
 
+.PHONY: multichip
+multichip: ## node-sharded fleet window dryrun on 8 simulated devices (bit-equal vs single-device)
+	$(PYTHON) -c "from __graft_entry__ import dryrun_fleet_sharded; dryrun_fleet_sharded(8)"
+
 # -- native -------------------------------------------------------------------
 .PHONY: native
 native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)

@@ -76,11 +76,12 @@ DETAIL_PATH = os.environ.get("KEPLER_BENCH_DETAIL_PATH",
 # gate booleans surfaced in the headline (when their leg ran)
 GATE_KEYS = ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
              "aggwin_within_budget", "aggwin_pipeline_ok",
-             "node_scrape_ok")
+             "aggwin_sharded_ok", "node_scrape_ok")
 # an errored leg (subprocess died, no row, timeout) fails these gates
 LEG_ERROR_GATES = {
     "node_scrape_error": ("node_scrape_ok",),
-    "aggwin_error": ("aggwin_within_budget", "aggwin_pipeline_ok"),
+    "aggwin_error": ("aggwin_within_budget", "aggwin_pipeline_ok",
+                     "aggwin_sharded_ok"),
     "soak_error": ("soak_ok",),
 }
 
@@ -130,6 +131,18 @@ def evaluate_gates(result: dict, on_tpu: bool) -> tuple[bool, list]:
             f"{result.get('aggwin_pipeline_ratio')}x the serial "
             f"window {result.get('aggwin_serial_p50_ms')} ms "
             f"(budget {result.get('aggwin_pipeline_ratio_budget')}x)")
+        failed = True
+    if (result.get("aggwin_sharded_ok") is False
+            and "aggwin_sharded_ok" not in forced):
+        messages.append(
+            f"GATE: sharded window device leg "
+            f"{result.get('aggwin_sharded_device_p50_ms')} ms is "
+            f"{result.get('aggwin_sharded_device_ratio')}x the "
+            f"unsharded {result.get('aggwin_unsharded_device_p50_ms')} "
+            f"ms (budget {result.get('aggwin_sharded_ratio_budget')}x "
+            f"on {result.get('aggwin_sharded_devices')} devices) or "
+            f"bit-inconsistent "
+            f"({result.get('aggwin_sharded_bit_consistent')})")
         failed = True
     return failed, messages
 
@@ -401,7 +414,7 @@ def main() -> None:
     acc_fields = run_all(packed_program=program, packed_batch=batch,
                          packed_params=params)
 
-    def host_leg(module, args, timeout, error_key):
+    def host_leg(module, args, timeout, error_key, env_extra=None):
         """Run a CPU-side benchmark module, parse its JSON row. Errors
         never sink the headline — they land in ``error_key`` instead
         (with the child's stderr tail when it produced no row)."""
@@ -410,7 +423,8 @@ def main() -> None:
             cp = subprocess.run(
                 [sys.executable, "-m", module, *args],
                 capture_output=True, timeout=timeout, text=True,
-                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                env={**os.environ, "JAX_PLATFORMS": "cpu",
+                     **(env_extra or {})},
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             return json.loads(cp.stdout.strip().splitlines()[-1])
         except Exception as err:
@@ -430,9 +444,17 @@ def main() -> None:
     # gated on AGG_HOST_BUDGET_MS p50 / AGG_HOST_P99_BUDGET_MS p99 —
     # the ratchet VERDICT r4 item 9 asked for; see the calibration note
     # in benchmarks/scenarios.py) --------------------------------------
+    # simulate 8 host devices so the sharded-window leg (the production
+    # aggregator path) measures + gates on CPU CI hosts too; on real
+    # multi-chip captures the flag is inert (host platform only)
+    aggwin_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in aggwin_flags:
+        aggwin_flags = (aggwin_flags
+                        + " --xla_force_host_platform_device_count=8").strip()
     row = host_leg("benchmarks.scenarios",
                    ["--only", "aggregator-window", "--iters", "20"],
-                   900, "aggwin_error")
+                   900, "aggwin_error",
+                   env_extra={"XLA_FLAGS": aggwin_flags})
     aggwin_fields = {(k if k.startswith("aggwin_") else f"aggwin_{k}"): v
                      for k, v in row.items() if k != "scenario"}
 

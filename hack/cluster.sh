@@ -60,9 +60,19 @@ e2e() {
     # of the reference's k8s-equinix workflow checks). Port-forwards both
     # services and asserts the core series exist.
     need kubectl
-    local pf_pids=()
-    cleanup() { kill "${pf_pids[@]}" 2>/dev/null || true; }
-    trap cleanup RETURN
+    # deliberately NOT `local`: the EXIT trap below outlives this
+    # function's scope (and bash < 4.4 trips set -u expanding an empty
+    # array, hence the length guard)
+    pf_pids=()
+    cleanup() {
+        if [ "${#pf_pids[@]}" -gt 0 ]; then
+            kill "${pf_pids[@]}" 2>/dev/null || true
+        fi
+    }
+    # RETURN covers the normal function exit; EXIT covers the `exit 1`
+    # failure paths below, which bypass RETURN and would otherwise orphan
+    # the background port-forwards holding ports 28282/28283
+    trap cleanup RETURN EXIT
 
     kubectl -n kepler-tpu wait --for=condition=ready pod \
         -l app.kubernetes.io/name=kepler-tpu --timeout=180s

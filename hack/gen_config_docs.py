@@ -208,6 +208,15 @@ DESCRIPTIONS = {
                                    "hasn't produced output within this "
                                    "bound demotes the ladder instead of "
                                    "wedging the loop (`0` disables).",
+    "aggregator.mesh_shape": "Device mesh shape for the fleet window "
+                             "path (`[]` = every device on a 1-D node "
+                             "axis). With > 1 device on a 1-D node "
+                             "mesh the packed window runs SHARDED: "
+                             "per-shard resident rings, per-shard "
+                             "delta H2D, sticky node→shard assignment.",
+    "aggregator.mesh_axes": "Mesh axis names for the fleet window path; "
+                            "must lead with `node` (the axis the fleet "
+                            "batch shards over).",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "

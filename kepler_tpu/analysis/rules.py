@@ -460,7 +460,8 @@ _UNIT_TOKENS = frozenset({
     "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
     "celsius", "info", "healthy", "degraded",
 })
-_COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows"})
+_COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows",
+                           "shards"})
 # reference-parity names grandfathered in (match the upstream exporter)
 _EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
 

@@ -78,6 +78,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         fallback_enabled=cfg.aggregator.fallback_enabled,
         repromote_after=cfg.aggregator.repromote_after,
         dispatch_timeout=cfg.aggregator.dispatch_timeout,
+        mesh_shape=cfg.aggregator.mesh_shape,
+        mesh_axes=cfg.aggregator.mesh_axes,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

@@ -356,6 +356,13 @@ class AggregatorConfig:
     # produced its output within this bound demotes instead of wedging
     # the aggregation loop (0 disables the watchdog)
     dispatch_timeout: float = 30.0
+    # device mesh the packed window path runs on: [] = all devices on a
+    # 1-D node axis — with > 1 device that is the SHARDED window (per-
+    # shard resident rings, per-shard delta H2D, sticky node→shard
+    # assignment). A 2-D [n, m] node×model mesh falls back to the
+    # unsharded engine (batch still NamedSharding-sharded)
+    mesh_shape: list[int] = field(default_factory=list)
+    mesh_axes: list[str] = field(default_factory=lambda: ["node"])
 
 
 @dataclass
@@ -461,6 +468,19 @@ class Config:
         if self.aggregator.dispatch_timeout < 0:
             errs.append("aggregator.dispatchTimeout must be >= 0 "
                         "(0 disables the stall watchdog)")
+        # mesh validity beyond this (device divisibility) is checked by
+        # make_mesh at startup, when the device count is known
+        if not self.aggregator.mesh_axes:
+            errs.append("aggregator.meshAxes must name at least one axis")
+        elif self.aggregator.mesh_axes[0] != "node":
+            errs.append("aggregator.meshAxes must lead with 'node' "
+                        f"(got {self.aggregator.mesh_axes!r}) — the "
+                        "fleet batch shards over the node axis")
+        if self.aggregator.mesh_shape and (
+                len(self.aggregator.mesh_shape)
+                != len(self.aggregator.mesh_axes)):
+            errs.append("aggregator.meshShape and aggregator.meshAxes "
+                        "must have the same rank")
         if self.monitor.state_max_age < 0:
             errs.append("monitor.stateMaxAge must be >= 0")
         spool = self.agent.spool

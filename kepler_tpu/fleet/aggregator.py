@@ -47,8 +47,8 @@ import numpy as np
 from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
 from kepler_tpu.fleet.window import (DeviceWindowError, PackedWindowEngine,
-                                     RowInput, WindowMeta,
-                                     align_zone_matrices)
+                                     RowInput, ShardedWindowEngine,
+                                     WindowMeta, align_zone_matrices)
 from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.telemetry import DEFAULT_DELIVERY_BUCKETS, Histogram
 from kepler_tpu.parallel.aggregator_core import (
@@ -83,6 +83,10 @@ RUNG_EINSUM = 2  # serial einsum-f32 (full assemble + dense dispatch)
 RUNG_NUMPY = 3  # pure-NumPy host fallback (no device, no jax)
 RUNG_NAMES = ("packed-pipelined", "packed-serial", "einsum-serial",
               "numpy-host")
+# rung 0's name when the window is sharded over a multi-device node
+# mesh (ShardedWindowEngine): a single shard's device failure demotes
+# to the single-device rungs above, so only rung 0 has a sharded form
+RUNG_NAME_SHARDED = "packed-sharded-pipelined"
 
 # per-mode checkpoint layout: required keys, and which key's last axis is
 # the zone count Z. Temporal params serve through the dedicated history
@@ -127,6 +131,10 @@ class _Pending:
     dispatch_ms: float
     h2d_rows: int
     compiled: bool
+    # packed path: per-shard H2D breakdown + shard count ((), 1 when the
+    # dispatching engine was unsharded; legacy/numpy paths leave 1)
+    h2d_shards: tuple = ()
+    shards: int = 1
     # legacy path extras (training dump + dense scatter)
     batch: object = None
     aligned: list | None = None
@@ -356,6 +364,8 @@ class Aggregator:
         fallback_enabled: bool = True,
         repromote_after: int = 8,
         dispatch_timeout: float = 30.0,
+        mesh_shape: Sequence[int] | None = None,
+        mesh_axes: Sequence[str] | None = None,
         clock=None,
         mesh=None,
     ) -> None:
@@ -372,6 +382,11 @@ class Aggregator:
         self._accuracy_mode = accuracy_mode
         self._clock = clock or _time.time
         self._mesh = mesh
+        # aggregator.meshShape/meshAxes: the device mesh the packed
+        # window path actually runs on ([] = all devices, 1-D node axis
+        # — the sharded production shape)
+        self._mesh_shape = list(mesh_shape or [])
+        self._mesh_axes = list(mesh_axes or [])
         # temporal mode: per-node feature-history ring buffers, fed on
         # report receipt so the window advances at each node's own cadence.
         # Each node's buffer carries its OWN lock: ingest for node A never
@@ -454,6 +469,11 @@ class Aggregator:
                        "last_dispatch_ms": 0.0,
                        "last_wait_ms": 0.0,
                        "last_h2d_rows": 0,
+                       # sharded window: device shards the last window ran
+                       # over (1 = unsharded engine or demoted rung) and
+                       # the per-shard H2D breakdown
+                       "window_shards": 0,
+                       "last_h2d_shards": [],
                        "window_compiles_total": 0,
                        # degradation ladder (0 = healthy full path)
                        "window_rung": 0,
@@ -485,7 +505,13 @@ class Aggregator:
         self._bucket_shrink_after = max(1, int(bucket_shrink_after))
         self._pipeline_lock = threading.Lock()
         self._inflight: collections.deque[_Pending] = collections.deque()  # keplint: guarded-by=_pipeline_lock
+        # rung-0 engine: ShardedWindowEngine on a multi-device 1-D node
+        # mesh (per-shard rings, sticky assignment), PackedWindowEngine
+        # otherwise; _engine_serial is the single-device demotion engine
+        # the ladder's packed-serial rung uses when rung 0 is sharded
         self._engine: PackedWindowEngine | None = None
+        self._engine_serial: PackedWindowEngine | None = None
+        self._shard_count = 1  # set in init() from the mesh shape
         # -- device-plane degradation ladder (fleet.window faults) ---------
         # state is written only by the aggregation loop; reads from the
         # probe/metrics threads snapshot under _results_lock
@@ -517,12 +543,16 @@ class Aggregator:
 
     def init(self) -> None:
         if self._mesh is None:
-            self._mesh = make_mesh()
+            from kepler_tpu.parallel.mesh import NODE_AXIS
+
+            self._mesh = make_mesh(self._mesh_shape,
+                                   self._mesh_axes or (NODE_AXIS,))
         n_dev = self._mesh.devices.size
         # the node axis shards over the mesh: round the bucket up so padded
         # batches always divide evenly across devices
         if self._node_bucket % n_dev:
             self._node_bucket = ((self._node_bucket // n_dev) + 1) * n_dev
+        self._shard_count = self._mesh_shard_count()
         if self._model_mode:
             if self._model_mode != "temporal":
                 from kepler_tpu.models.estimator import predictor
@@ -550,6 +580,22 @@ class Aggregator:
         log.info("aggregator: mesh=%s devices=%d model=%s interval=%.1fs",
                  dict(self._mesh.shape), n_dev, self._model_mode,
                  self._interval)
+
+    def _mesh_shard_count(self) -> int:
+        """Shards the packed window runs over: the node-axis size when
+        the mesh is 1-D over ``node`` (every device an independent
+        shard with its own resident ring). Single-device and 2-D
+        (node × model) meshes run the unsharded engine — their batch
+        still shards via NamedSharding, but H2D stays whole-batch."""
+        from kepler_tpu.parallel.mesh import NODE_AXIS
+
+        mesh = self._mesh
+        if mesh is None:
+            return 1
+        n_dev = mesh.devices.size
+        if n_dev > 1 and dict(mesh.shape).get(NODE_AXIS, 0) == n_dev:
+            return n_dev
+        return 1
 
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
@@ -852,6 +898,13 @@ class Aggregator:
             out["last_window_age_s"] = round(self._clock() - last, 3)
         return out
 
+    def _rung_display(self, rung: int) -> str:
+        """Operator-facing rung name: rung 0 reads as its sharded form
+        on a multi-device node mesh (only rung 0 has one)."""
+        if rung == RUNG_PIPELINED and self._shard_count > 1:
+            return RUNG_NAME_SHARDED
+        return RUNG_NAMES[rung]
+
     def window_health(self) -> dict:
         """``fleet-window`` probe for /healthz: degraded while the device
         window leg runs below the full packed-pipelined rung. Names the
@@ -862,7 +915,9 @@ class Aggregator:
             out = {
                 "ok": self._rung == RUNG_PIPELINED,
                 "rung": self._rung,
-                "rung_name": RUNG_NAMES[self._rung],
+                "rung_name": self._rung_display(self._rung),
+                "shards": (self._shard_count
+                           if self._rung == RUNG_PIPELINED else 1),
                 "demotions_total": self._stats["window_demotions_total"],
                 "repromotions_total":
                     self._stats["window_repromotions_total"],
@@ -888,8 +943,13 @@ class Aggregator:
         with self._pipeline_lock:
             abandoned = len(self._inflight)
             self._inflight.clear()
+        # both packed engines re-seed: the failed rung's ring is poisoned
+        # and the OTHER engine's buffers may alias handles a drained
+        # window read — re-entering either rung starts from a full re-pack
         if self._engine is not None:
             self._engine.reset()
+        if self._engine_serial is not None:
+            self._engine_serial.reset()
         self._program = None  # a failed serial program recompiles fresh
         with self._results_lock:
             prev = self._rung
@@ -910,8 +970,9 @@ class Aggregator:
             self._last_window_failure = f"{reason}: {err}"[:240]
         log.error("fleet window device leg failed (%s) at rung %s; "
                   "demoting to %s, %d in-flight window(s) abandoned, "
-                  "resident ring re-seeded: %s", reason, RUNG_NAMES[prev],
-                  RUNG_NAMES[rung], abandoned, err)
+                  "resident ring re-seeded: %s", reason,
+                  self._rung_display(prev), self._rung_display(rung),
+                  abandoned, err)
 
     def _ladder_window_ok(self) -> None:
         """One window published without a device failure. At a demoted
@@ -943,7 +1004,7 @@ class Aggregator:
         if promoted is not None:
             log.info("fleet window ladder: clean-window threshold met — "
                      "re-promoted to rung %d (%s)", promoted,
-                     RUNG_NAMES[promoted])
+                     self._rung_display(promoted))
 
     def _fetch_device(self, fn):
         """Blocking device fetch with MonitorWatchdog-style stall
@@ -1055,7 +1116,7 @@ class Aggregator:
                                             now, t_win)
         else:
             pending = self._dispatch_packed(stored_sorted, zone_names,
-                                            now, t_win)
+                                            now, t_win, rung)
         # every demoted rung drains each window (no in-flight handle
         # outlives its own interval); only the healthy rung pipelines —
         # the legacy path included (temporal/accuracy modes pipeline at
@@ -1105,18 +1166,44 @@ class Aggregator:
 
     # -- dispatch half ------------------------------------------------------
 
-    def _dispatch_packed(self, stored_sorted: list, zone_names: list[str],
-                         now: float, t_win: float) -> _Pending:
-        """Sync the device-resident packed batch (delta H2D) and dispatch
-        the packed-f16 program asynchronously."""
+    def _packed_engine(self, rung: int) -> PackedWindowEngine:
+        """The packed engine for ``rung``: the sharded engine owns rung 0
+        on a multi-device node mesh; the packed-serial rung then demotes
+        to a SINGLE-device engine pinned to the mesh's first device, so
+        a demoted window no longer touches the other shards' devices.
+        (Which shard failed is unknowable from a mesh-wide SPMD error —
+        if the pinned device is itself the dead one, this rung fails too
+        and the ladder walks on to einsum and then the device-free NumPy
+        rung; every interval still publishes.)"""
         if self._engine is None:
-            self._engine = PackedWindowEngine(
+            self._shard_count = self._mesh_shard_count()
+            cls = (ShardedWindowEngine if self._shard_count > 1
+                   else PackedWindowEngine)
+            self._engine = cls(
                 self._mesh, backend=self._backend,
                 model_mode=self._model_mode,
                 node_bucket=self._node_bucket,
                 workload_bucket=self._workload_bucket,
                 shrink_after=self._bucket_shrink_after,
                 staging_slots=self._pipeline_depth + 1)
+        if rung == RUNG_PIPELINED or self._shard_count == 1:
+            return self._engine
+        if self._engine_serial is None:
+            self._engine_serial = PackedWindowEngine(
+                make_mesh([1], devices=[self._mesh.devices.flat[0]]),
+                backend=self._backend, model_mode=self._model_mode,
+                node_bucket=self._node_bucket,
+                workload_bucket=self._workload_bucket,
+                shrink_after=self._bucket_shrink_after,
+                staging_slots=self._pipeline_depth + 1)
+        return self._engine_serial
+
+    def _dispatch_packed(self, stored_sorted: list, zone_names: list[str],
+                         now: float, t_win: float,
+                         rung: int = RUNG_PIPELINED) -> _Pending:
+        """Sync the device-resident packed batch (delta H2D) and dispatch
+        the packed-f16 program asynchronously."""
+        engine = self._packed_engine(rung)
         rows = [
             RowInput(name=s.report.node_name, report=s.report,
                      zone_names=s.zone_names,
@@ -1127,7 +1214,7 @@ class Aggregator:
         if params is None:
             params = np.zeros((), np.float32)  # ratio-only: unused leaf
         with telemetry.span("window.h2d_delta"):
-            plan = self._engine.plan_window(rows, zone_names, params)
+            plan = engine.plan_window(rows, zone_names, params)
         t_planned = _time.perf_counter()
         # consulted AFTER the donated ring update ran: a dispatch that
         # dies here leaves a consumed donated buffer behind — exactly the
@@ -1151,7 +1238,8 @@ class Aggregator:
             kind="packed", out=out, meta=plan.meta, now=now,
             assembly_ms=(t_planned - t_win) * 1e3,
             dispatch_ms=(t_dispatched - t_planned) * 1e3,
-            h2d_rows=plan.h2d_rows, compiled=plan.cold)
+            h2d_rows=plan.h2d_rows, compiled=plan.cold,
+            h2d_shards=plan.h2d_shards, shards=plan.n_shards)
 
     def _dispatch_legacy(self, stored_sorted: list, zone_names: list[str],
                          now: float, t_win: float) -> _Pending:
@@ -1320,9 +1408,13 @@ class Aggregator:
             self._stats["last_attribution_ms"] = (
                 p.assembly_ms + p.dispatch_ms + wait_ms + scatter_ms)
             self._stats["last_h2d_rows"] = p.h2d_rows
+            self._stats["window_shards"] = p.shards
+            self._stats["last_h2d_shards"] = list(p.h2d_shards)
             if self._engine is not None:
-                self._stats["window_compiles_total"] = \
-                    self._engine.compile_count
+                self._stats["window_compiles_total"] = sum(
+                    e.compile_count
+                    for e in (self._engine, self._engine_serial)
+                    if e is not None)
         log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms "
                   "(h2d rows %d)", len(results.names), n_workloads,
                   self._stats["last_attribution_ms"], p.h2d_rows)
@@ -1651,6 +1743,13 @@ class Aggregator:
             "— 0 when the resident device batch was already current")
         h2d_rows.add_metric([], stats["last_h2d_rows"])
         yield h2d_rows
+        shards = GaugeMetricFamily(
+            "kepler_fleet_window_shards",
+            "Device shards the last fleet window ran over (node-axis "
+            "mesh size on the sharded packed path; 1 = unsharded engine "
+            "or a demoted single-device ladder rung)")
+        shards.add_metric([], stats["window_shards"])
+        yield shards
         compiles = CounterMetricFamily(
             "kepler_fleet_window_compiles_total",
             "Fleet-window program-cache misses — attribution programs "

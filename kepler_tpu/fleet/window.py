@@ -49,6 +49,7 @@ __all__ = [
     "DeviceWindowError",
     "PackedWindowEngine",
     "RowInput",
+    "ShardedWindowEngine",
     "WindowMeta",
     "WindowPlan",
     "align_zone_matrices",
@@ -156,6 +157,10 @@ class WindowPlan:
     cold: bool  # True → dispatching compiles (time it as window.compile)
     meta: WindowMeta
     h2d_rows: int  # rows staged + uploaded this window (delta or full)
+    # sharded engine only: rows uploaded per shard (index = shard), and
+    # the shard count — (h2d_rows,) / 1 on the single-device engine
+    h2d_shards: tuple[int, ...] = ()
+    n_shards: int = 1
 
 
 def align_zone_matrices(reports: Sequence[NodeReport],
@@ -216,6 +221,10 @@ class PackedWindowEngine:
     # program-cache bound: ladder moves retire old shapes; keep a few
     # around for oscillation, evict the oldest beyond this
     _CACHE_CAP = 32
+
+    # sparse model-row indices are GLOBAL and replicated on this engine;
+    # the sharded subclass flips this to compile the shard-local variant
+    _LOCAL_SPARSE = False
 
     def __init__(self, mesh, backend: str = "einsum",
                  model_mode: str | None = None,
@@ -298,13 +307,22 @@ class PackedWindowEngine:
             program = make_packed_fleet_program(
                 self._mesh, n_workloads=wb, n_zones=z,
                 model_mode=self._model_mode, backend=self._backend,
-                model_bucket=mb)
+                model_bucket=mb, local_model_rows=self._LOCAL_SPARSE)
             entry = [program, True]
             self._programs[key] = entry
             self.compile_count += 1
             while len(self._programs) > self._CACHE_CAP:
                 self._programs.pop(next(iter(self._programs)))
         return entry
+
+    def _jit_scatter(self, scatter_rows):
+        """jit the donated scatter-update with the mesh shardings (the
+        sharded engine overrides this — its per-shard operands carry
+        placement themselves)."""
+        return self._jax.jit(
+            scatter_rows, donate_argnums=(0,),
+            in_shardings=(self._sh_batch, self._sh_repl, self._sh_repl),
+            out_shardings=self._sh_batch)
 
     def _update_for(self, n: int, width: int, db: int) -> list:
         key = (n, width, db)
@@ -314,17 +332,12 @@ class PackedWindowEngine:
                 raise DeviceWindowError(
                     "compile_error",
                     f"injected compile failure for update key {key}")
-            jax = self._jax
 
             def scatter_rows(resident, rows, idx):
                 # index n (the pad value) is out of bounds → dropped
                 return resident.at[idx].set(rows, mode="drop")
 
-            fn = jax.jit(
-                scatter_rows, donate_argnums=(0,),
-                in_shardings=(self._sh_batch, self._sh_repl, self._sh_repl),
-                out_shardings=self._sh_batch)
-            entry = [fn, True]
+            entry = [self._jit_scatter(scatter_rows), True]
             self._updates[key] = entry
             self.compile_count += 1
             while len(self._updates) > self._CACHE_CAP:
@@ -392,7 +405,8 @@ class PackedWindowEngine:
         program, cold = entry
         entry[1] = False
         return WindowPlan(program=program, args=args, cold=cold, meta=meta,
-                          h2d_rows=h2d_rows)
+                          h2d_rows=h2d_rows, h2d_shards=(h2d_rows,),
+                          n_shards=1)
 
     # -- failure recovery --------------------------------------------------
 
@@ -571,3 +585,395 @@ class PackedWindowEngine:
             resident = update(resident, rows_dev, idx_dev)
         self._buffers[self._buf_i] = resident
         return n_stage
+
+
+class ShardedWindowEngine(PackedWindowEngine):
+    """Packed resident batch SHARDED over the mesh's node axis — the
+    production aggregator path for multi-device hosts (ROADMAP item 1:
+    10k nodes / 1M pods per aggregator with near-linear device scaling).
+
+    Layout: the global padded batch is ``n_shards × shard_bucket`` rows;
+    shard ``k``'s slice lives as its OWN ring of single-device buffers
+    committed to device ``k``. Per window:
+
+    * **Sticky node→shard assignment.** A node keeps its shard for life
+      (joiners go to the emptiest shard); a join or report change stages
+      rows ONLY to the owning shard — the other shards see zero H2D, no
+      recompiles, and their resident buffers are untouched. The whole
+      fleet is rebalanced (round-robin over sorted names) only when the
+      shard bucket itself moves: overflow growth (no shard has a free
+      row), hysteretic shrink, or a workload/zone-axis shape change.
+    * **Per-shard delta H2D + shard-local scatter.** Each shard's
+      changed rows are packed into that shard's host staging slot and
+      uploaded to that device alone, then scatter-updated in place
+      through a donated single-device program (the same ping-pong /
+      rebind discipline as the base engine, per shard; keplint KTL110
+      covers the rebind lexically).
+    * **One sharded dispatch.** The per-shard buffers are assembled
+      zero-copy into one global array (``NamedSharding`` over ``node``)
+      and the packed program runs SPMD across the mesh; with a model
+      mode set the sparse MODE_MODEL gather stays shard-local
+      (``shard_map`` — see ``parallel.packed``). The only cross-shard
+      step in the whole window is the caller's result fetch at publish.
+
+    Requires a 1-D mesh over the node axis (every device an independent
+    shard); the aggregator falls back to :class:`PackedWindowEngine` for
+    single-device and 2-D (node × model) meshes, and demotes to it on
+    any shard's device failure (the ladder's single-device rungs).
+    """
+
+    _LOCAL_SPARSE = True
+
+    def __init__(self, mesh, backend: str = "einsum",
+                 model_mode: str | None = None,
+                 node_bucket: int = 8, workload_bucket: int = 256,
+                 shrink_after: int = 16, staging_slots: int = 2) -> None:
+        from kepler_tpu.parallel.mesh import NODE_AXIS
+
+        n_dev = mesh.devices.size
+        if dict(mesh.shape).get(NODE_AXIS, 0) != n_dev or n_dev < 2:
+            raise ValueError(
+                "ShardedWindowEngine needs a 1-D mesh over the node axis "
+                f"with ≥ 2 devices; got shape {dict(mesh.shape)}")
+        super().__init__(mesh, backend=backend, model_mode=model_mode,
+                         node_bucket=node_bucket,
+                         workload_bucket=workload_bucket,
+                         shrink_after=shrink_after,
+                         staging_slots=staging_slots)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.n_shards = n_dev
+        self._devices = list(mesh.devices.flat)
+        # the node ladder sizes the PER-SHARD bucket here (global rows =
+        # n_shards × bucket, evenly shardable by construction)
+        self._ladder_n = BucketLadder(max(1, node_bucket // n_dev),
+                                      shrink_after)
+        # per-shard delta-staging ladders: shard 3's churn burst must not
+        # inflate shard 0's staging shape (and recompile its update)
+        self._ladder_ds = [BucketLadder(8, shrink_after)
+                           for _ in range(n_dev)]
+        self._sh_rows = NamedSharding(mesh, P(NODE_AXIS))
+        self._n_slots = max(2, staging_slots)
+        # slot-major ring: _buffers[slot][shard] (len(_buffers) stays the
+        # ring depth, as on the base engine); _content mirrors it with
+        # per-shard per-row identity, _stages with host staging arrays
+        self._buffers = []  # type: ignore[assignment]
+        self._content = []  # type: ignore[assignment]
+        self._stages = []  # type: ignore[assignment]
+        self._shard_of: dict[str, int] = {}
+        self._free_by_shard: list[list[int]] = [[] for _ in range(n_dev)]
+        self._width = 0
+
+    # -- failure recovery --------------------------------------------------
+
+    def reset(self) -> None:
+        """Abandon every shard's ring + staging (see base docstring): a
+        single shard's failed dispatch poisons the assembled global view,
+        so all shard rings re-seed together on the next plan."""
+        super().reset()
+        self._shard_of = {}
+        self._free_by_shard = [[] for _ in range(self.n_shards)]
+        self._width = 0
+
+    # -- per-shard update programs -----------------------------------------
+
+    def _jit_scatter(self, scatter_rows):
+        """Shard-local donated scatter: jitted WITHOUT mesh shardings —
+        placement follows the committed per-shard operands, so one cache
+        entry serves every shard (jax re-specializes per device)."""
+        return self._jax.jit(scatter_rows, donate_argnums=(0,))
+
+    # -- window planning ---------------------------------------------------
+
+    def plan_window(self, rows: Sequence[RowInput],
+                    zone_names: Sequence[str], params: Any) -> WindowPlan:
+        zones_t = tuple(zone_names)
+        z = len(zones_t)
+        k_sh = self.n_shards
+        need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
+        prev_sb, prev_wb = self._ladder_n.bucket, self._ladder_w.bucket
+        wb = self._ladder_w.fit(need_w)
+
+        overflow = False
+        if self._buffers:
+            # release departed nodes' rows, then stick joiners to the
+            # emptiest shard (deterministic: ties break on shard index)
+            live = {r.name for r in rows}
+            for name in [n for n in self._shard_of if n not in live]:
+                k = self._shard_of.pop(name)
+                i = self._row_of.pop(name)
+                self._names[i] = None
+                self._mode[i] = 0
+                self._dt[i] = 0.0
+                self._counts[i] = 0
+                self._ids[i] = []
+                self._kinds[i] = None
+                self._free_by_shard[k].append(i - k * prev_sb)
+            headroom = [len(f) for f in self._free_by_shard]
+            joiners = sorted((r for r in rows
+                              if r.name not in self._shard_of),
+                             key=lambda r: r.name)
+            model_load: list[int] | None = None
+            if joiners and any(r.report.mode == MODE_MODEL
+                               for r in joiners):
+                # per-shard MODE_MODEL occupancy, so model joiners land
+                # on the estimator-lightest shard (the sparse bucket is
+                # sized by the fullest shard — see _rebuild_shards)
+                model_load = [0] * k_sh
+                for name, q in self._shard_of.items():
+                    i = self._row_of.get(name)
+                    if i is not None and self._mode[i] == MODE_MODEL:
+                        model_load[q] += 1
+            for r in joiners:
+                open_shards = [q for q in range(k_sh) if headroom[q] > 0]
+                if not open_shards:
+                    overflow = True  # no shard has a free row: rebalance
+                    break
+                if r.report.mode == MODE_MODEL:
+                    k = min(open_shards,
+                            key=lambda q: (model_load[q], -headroom[q], q))
+                else:
+                    k = max(open_shards, key=lambda q: (headroom[q], -q))
+                headroom[k] -= 1
+                if model_load is not None and r.report.mode == MODE_MODEL:
+                    model_load[k] += 1
+                self._shard_of[r.name] = k
+        if overflow or not self._buffers:
+            need_s = -(-len(rows) // k_sh)  # ceil: rebalanced occupancy
+        else:
+            occupancy = [0] * k_sh
+            for k in self._shard_of.values():
+                occupancy[k] += 1
+            need_s = max(1, max(occupancy, default=1))
+        sb = self._ladder_n.fit(need_s)
+        if self._buffers and (sb > prev_sb or wb > prev_wb):
+            if fault.fire("device.oom_on_grow") is not None:
+                raise DeviceWindowError(
+                    "oom_on_grow",
+                    f"injected OOM growing shard buckets ({prev_sb}, "
+                    f"{prev_wb}) → ({sb}, {wb})")
+        key = (sb, wb, zones_t)
+        if key != self._key or not self._buffers or overflow:
+            h2d_shards = self._rebuild_shards(rows, sb, wb, zones_t)
+        else:
+            self._buf_i = (self._buf_i + 1) % len(self._buffers)
+            h2d_shards = self._delta_sync_shards(rows, zones_t)
+        nb = k_sh * sb
+        meta = WindowMeta(
+            zones=list(zones_t),
+            names=[r.name for r in rows],
+            rows=dict(self._row_of),
+            mode=np.asarray(self._mode, np.int32),
+            dt=np.asarray(self._dt, np.float32),
+            counts=list(self._counts),
+            ids=list(self._ids),
+            kinds=list(self._kinds),
+            n_live=len(rows),
+            n_rows=nb,
+        )
+        jax = self._jax
+        resident = jax.make_array_from_single_device_arrays(
+            (nb, self._width), self._sh_batch,
+            list(self._buffers[self._buf_i]))
+        args: tuple
+        mb: int | None = None
+        if self._sparse:
+            mode_arr = np.asarray(self._mode, np.int32)
+            local_rows = [np.flatnonzero(
+                mode_arr[k * sb:(k + 1) * sb] == MODE_MODEL)
+                for k in range(k_sh)]
+            mb = self._ladder_m.fit(
+                max(1, max(len(lk) for lk in local_rows)))
+            # shard-local indices, one mb-sized segment per shard; pad sb
+            # is past the shard's rows → gather-clamped, scatter-dropped
+            idx = np.full(k_sh * mb, sb, np.int32)
+            for k, lk in enumerate(local_rows):
+                idx[k * mb:k * mb + len(lk)] = lk
+            args = (params, resident,
+                    jax.device_put(idx, self._sh_rows))
+        else:
+            args = (params, resident)
+        entry = self._program_for(nb, wb, z, mb)
+        program, cold = entry
+        entry[1] = False
+        return WindowPlan(program=program, args=args, cold=cold, meta=meta,
+                          h2d_rows=sum(h2d_shards),
+                          h2d_shards=tuple(h2d_shards),
+                          n_shards=k_sh)
+
+    # -- resident maintenance ----------------------------------------------
+
+    def _rebuild_shards(self, rows: Sequence[RowInput], sb: int, wb: int,
+                 zones_t: tuple[str, ...]) -> list[int]:
+        """Full re-pack + REBALANCE: deal MODE_MODEL nodes first, then
+        ratio nodes, round-robin over shards — per-shard occupancy stays
+        within one row of even AND so does the per-shard estimator load
+        (the sparse model bucket is sized by the FULLEST shard's model
+        rows, so clustering model nodes on a shard subset would multiply
+        the whole mesh's estimator FLOPs by the imbalance). Only bucket/
+        zone moves land here — a steady fleet never migrates a node."""
+        from kepler_tpu.parallel.packed import pack_fleet_inputs, packed_width
+
+        jax = self._jax
+        k_sh = self.n_shards
+        z = len(zones_t)
+        width = packed_width(wb, z)
+        by_name = sorted(rows, key=lambda r: r.name)
+        ordered = ([r for r in by_name if r.report.mode == MODE_MODEL]
+                   + [r for r in by_name if r.report.mode != MODE_MODEL])
+        self._shard_of = {}
+        self._row_of = {}
+        self._names = [None] * (k_sh * sb)
+        self._mode = [0] * (k_sh * sb)
+        self._dt = [0.0] * (k_sh * sb)
+        self._counts = [0] * (k_sh * sb)
+        self._ids = [[] for _ in range(k_sh * sb)]
+        self._kinds = [None] * (k_sh * sb)
+        shard_packed: list[np.ndarray] = []
+        shard_idents: list[list] = []
+        h2d_shards: list[int] = []
+        for k in range(k_sh):
+            members = ordered[k::k_sh]
+            n_real = len(members)
+            if n_real:
+                reports = [r.report for r in members]
+                zd, zv = align_zone_matrices(
+                    reports, [r.zone_names for r in members], zones_t)
+                batch = assemble_fleet_batch(
+                    reports, n_zones=z, node_bucket=sb,
+                    workload_bucket=wb, zone_deltas_mat=zd,
+                    zone_valid_mat=zv)
+                packed = pack_fleet_inputs(batch)
+                if packed.shape != (sb, width):
+                    raise AssertionError(
+                        f"shard {k} packed shape {packed.shape} != "
+                        f"({sb}, {width})")
+                base = k * sb
+                self._mode[base:base + sb] = batch.mode.tolist()
+                self._dt[base:base + sb] = batch.dt_s.tolist()
+                self._counts[base:base + sb] = list(batch.workload_counts)
+                self._ids[base:base + sb] = list(batch.workload_ids)
+                self._kinds[base:base + n_real] = [r.workload_kinds
+                                                   for r in reports]
+                for j, r in enumerate(members):
+                    self._shard_of[r.name] = k
+                    self._row_of[r.name] = base + j
+                    self._names[base + j] = r.name
+            else:
+                packed = np.zeros((sb, width), np.float32)
+                packed[:, :wb] = np.nan  # the packed empty row
+            shard_packed.append(packed)
+            shard_idents.append([r.ident for r in members]
+                                + [_EMPTY] * (sb - n_real))
+            self._free_by_shard[k] = list(range(sb - 1, n_real - 1, -1))
+            h2d_shards.append(n_real)
+        self._buffers = [
+            [jax.device_put(shard_packed[k], self._devices[k])
+             for k in range(k_sh)]
+            for _ in range(self._n_slots)]
+        self._content = [[list(shard_idents[k]) for k in range(k_sh)]
+                         for _ in range(self._n_slots)]
+        self._stages = [[np.zeros((0, width), np.float32)
+                         for _ in range(k_sh)]
+                        for _ in range(self._n_slots)]
+        self._buf_i = 0
+        self._stage_i = 0
+        self._key = (sb, wb, zones_t)
+        self._width = width
+        self._empty_row = np.zeros(width, np.float32)
+        self._empty_row[:wb] = np.nan
+        return h2d_shards
+
+    def _delta_sync_shards(self, rows: Sequence[RowInput],
+                           zones_t: tuple[str, ...]) -> list[int]:
+        """Per-shard delta: stage each shard's changed/joined/cleared
+        rows into ITS host slot, upload to ITS device alone, donated
+        shard-local scatter. Shards with nothing changed are untouched
+        — no H2D, no dispatch, no staging writes."""
+        from kepler_tpu import telemetry
+        from kepler_tpu.parallel.packed import pack_reports_into
+
+        sb, wb, _ = self._key  # type: ignore[misc]
+        jax = self._jax
+        k_sh = self.n_shards
+        width = self._width
+        content_slot = self._content[self._buf_i]
+        changed_by: list[list[tuple[int, RowInput]]] = [
+            [] for _ in range(k_sh)]
+        for r in rows:
+            k = self._shard_of[r.name]
+            content = content_slot[k]
+            i = self._row_of.get(r.name)
+            if i is None:
+                local = self._free_by_shard[k].pop()
+                i = k * sb + local
+                self._row_of[r.name] = i
+                self._names[i] = r.name
+                # other ring slots may still hold another node's data in
+                # this row — restage on their next turn
+                for slot, slot_content in enumerate(self._content):
+                    if slot != self._buf_i:
+                        slot_content[k][local] = _DIRTY
+            else:
+                local = i - k * sb
+                if (r.ident is not None and content[local] is not _EMPTY
+                        and content[local] is not _DIRTY
+                        and content[local] == r.ident):
+                    continue  # this shard's slot row is current
+            self._mode[i] = r.report.mode
+            self._dt[i] = r.report.dt_s
+            self._counts[i] = len(r.report.cpu_deltas)
+            self._ids[i] = r.report.workload_ids
+            self._kinds[i] = r.report.workload_kinds
+            content[local] = r.ident
+            changed_by[k].append((local, r))
+        h2d_shards = [0] * k_sh
+        self._stage_i = (self._stage_i + 1) % len(self._stages)
+        stage_slot = self._stages[self._stage_i]
+        for k in range(k_sh):
+            content = content_slot[k]
+            changed = changed_by[k]
+            changed_locals = {local for local, _ in changed}
+            base = k * sb
+            cleared = [local for local in range(sb)
+                       if self._names[base + local] is None
+                       and content[local] is not _EMPTY
+                       and local not in changed_locals]
+            for local in cleared:
+                content[local] = _EMPTY
+            n_stage = len(changed) + len(cleared)
+            h2d_shards[k] = n_stage
+            if n_stage == 0:
+                continue
+            with telemetry.span(f"window.h2d_delta.s{k}"):
+                db = min(self._ladder_ds[k].fit(n_stage), sb)
+                if stage_slot[k].shape != (db, width):
+                    stage_slot[k] = np.zeros((db, width), np.float32)
+                stage = stage_slot[k]
+                idx = np.full(db, sb, np.int32)
+                if changed:
+                    reports = [r.report for _, r in changed]
+                    zd, zv = align_zone_matrices(
+                        reports, [r.zone_names for _, r in changed],
+                        zones_t)
+                    pack_reports_into(stage, reports, zd, zv, wb)
+                    idx[:len(changed)] = [local for local, _ in changed]
+                for j, local in enumerate(cleared):
+                    stage[len(changed) + j] = self._empty_row
+                    idx[len(changed) + j] = local
+                dev = self._devices[k]
+                entry = self._update_for(sb, width, db)
+                update = entry[0]  # keplint: donates=0
+                update_cold, entry[1] = entry[1], False
+                rows_dev = jax.device_put(stage, dev)
+                idx_dev = jax.device_put(idx, dev)
+                # the donated handle dies inside the call; rebind and
+                # store back immediately (KTL110 tracks `resident`)
+                resident = self._buffers[self._buf_i][k]
+                if update_cold:
+                    with telemetry.span("window.compile"):
+                        resident = update(resident, rows_dev, idx_dev)
+                else:
+                    resident = update(resident, rows_dev, idx_dev)
+                self._buffers[self._buf_i][k] = resident
+        return h2d_shards

@@ -140,7 +140,8 @@ def _pack_watts_f16(res) -> jax.Array:
 def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
                               model_mode: str | None = None,
                               backend: str = "einsum",
-                              model_bucket: int | None = None):
+                              model_bucket: int | None = None,
+                              local_model_rows: bool = False):
     """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+2, Z]``.
 
     W and Z are static (they define the packing layout); N stays dynamic
@@ -151,6 +152,17 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
     evaluates the estimator ONLY on those rows (sparse mixed-fleet
     evaluation; see module docstring). Entries ≥ N are padding: the
     gather clamps them to a real row whose scatter-back is then dropped.
+
+    ``local_model_rows``: SHARDED sparse evaluation for multi-device
+    meshes. The replicated-``model_rows`` gather above has no shard
+    story — GSPMD would all-gather the whole packed batch to satisfy
+    arbitrary global indices. With ``local_model_rows`` the program runs
+    under ``shard_map`` over the node axis: ``model_rows`` is int32
+    [n_shards × model_bucket] sharded over ``node``, each shard's
+    segment holding SHARD-LOCAL row indices (pad = the shard's local row
+    count, gather-clamped / scatter-dropped per shard). The estimator
+    gather, forward, and scatter-back all stay shard-local; the only
+    cross-shard step left in a window is the caller's result fetch.
     """
     predict_fn = predictor(model_mode) if model_mode else None
     if predict_fn is not None and model_mode != "linear" \
@@ -200,6 +212,23 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
         return _pack_watts_f16(mix_model_watts(ratio_res, model_watts,
                                                mode, dt))
 
+    if sparse and local_model_rows:
+        from jax.experimental.shard_map import shard_map
+
+        # per-shard body: every array is the shard's LOCAL block, so the
+        # pad/clamp/drop index space is the local row count and no
+        # collective is ever emitted — XLA runs K independent partitions
+        local = shard_map(
+            unpack_and_attribute_sparse, mesh=mesh,
+            in_specs=(P(), P(NODE_AXIS, None), P(NODE_AXIS)),
+            out_specs=P(NODE_AXIS, None, None))
+        return jax.jit(
+            local,
+            in_shardings=(NamedSharding(mesh, P()),
+                          NamedSharding(mesh, P(NODE_AXIS, None)),
+                          NamedSharding(mesh, P(NODE_AXIS))),
+            out_shardings=NamedSharding(mesh, P(NODE_AXIS)),
+        )
     if sparse:
         return jax.jit(
             unpack_and_attribute_sparse,

@@ -102,11 +102,59 @@ def _check_password(password: str, hashed: str) -> bool:
     return shacrypt.verify(password, hashed)
 
 
+def _make_dummy_hash(users: Mapping[str, str]) -> str:
+    """A fixed dummy hash for unknown-user verifies, PRECOMPUTED once at
+    authenticator build time from an unguessable password.
+
+    The previous equalizer verified against ``next(iter(users.values()))``
+    — an arbitrary REAL user's hash. With mixed bcrypt/SHA-crypt configs
+    that pins the unknown-user cost to whichever scheme happens to sit
+    first in dict order, so the timing difference against a probe of a
+    known user under the OTHER scheme leaked username existence (and it
+    ran a real credential check against a real hash with attacker-chosen
+    input). The dummy is its own hash: bcrypt when any configured user is
+    bcrypt (the costlier scheme), SHA-512-crypt otherwise — at the MAX
+    cost parameter configured for that scheme, so within a scheme an
+    unknown-user verify is never cheaper than a real one (a lower-cost
+    dummy would leak existence by being faster than the costliest user).
+    Users configured with differing costs remain distinguishable from
+    each other by timing regardless of what the dummy does — per-user
+    cost divergence is a config smell, not something a dummy can mask.
+    """
+    import re
+    import secrets
+
+    password = secrets.token_hex(16)
+    bcrypt_hashes = [h for h in users.values()
+                     if h.startswith(("$2a$", "$2b$", "$2y$"))]
+    if bcrypt_hashes:
+        import bcrypt  # load_web_config verified availability
+
+        costs = [int(m.group(1)) for h in bcrypt_hashes
+                 if (m := re.match(r"\$2[aby]\$(\d{2})\$", h))]
+        salt = bcrypt.gensalt(rounds=max(costs)) if costs \
+            else bcrypt.gensalt()
+        return bcrypt.hashpw(password.encode(), salt).decode()
+    from kepler_tpu.server import shacrypt
+
+    # a rounds-less $5/$6 hash runs at the scheme default — it must
+    # count toward the max or default-cost users would out-cost the dummy
+    rounds = [int(m.group(1))
+              if (m := re.match(r"\$[56]\$rounds=(\d+)\$", h))
+              else shacrypt._ROUNDS_DEFAULT
+              for h in users.values()]
+    return shacrypt.mksha512crypt(password,
+                                  rounds=max(rounds) if rounds else None)
+
+
 def make_authenticator(users: Mapping[str, str]
                        ) -> Callable[[str | None], bool] | None:
     """→ fn(Authorization header) -> allowed, or None when auth is off."""
     if not users:
         return None
+    # unknown-user timing equalizer: a fixed constant-cost dummy hash,
+    # never a configured user's real hash (see _make_dummy_hash)
+    dummy_hash = _make_dummy_hash(users)
 
     def check(header: str | None) -> bool:
         if not header or not header.startswith("Basic "):
@@ -119,10 +167,9 @@ def make_authenticator(users: Mapping[str, str]
         hashed = users.get(user)
         try:
             if hashed is None:
-                # burn the same work as a real verify (one of the configured
-                # hashes, same scheme/cost) so a timing probe can't
-                # enumerate usernames
-                _check_password(password, next(iter(users.values())))
+                # burn the dummy verify so a timing probe can't
+                # enumerate usernames; the result is discarded
+                _check_password(password, dummy_hash)
                 return False
             return _check_password(password, hashed)
         except Exception:

@@ -48,10 +48,17 @@ def fat_result(**overrides) -> dict:
         "soak_ok": True,
         "aggwin_within_budget": True,
         "aggwin_pipeline_ok": True,
+        "aggwin_sharded_ok": True,
         "aggwin_host_p50_ms": 21.4,
         "aggwin_host_p99_ms": 55.2,
         "aggwin_pipeline_p50_ms": 101.2,
         "aggwin_pipeline_ratio": 0.41,
+        "aggwin_sharded_devices": 8,
+        "aggwin_sharded_device_p50_ms": 31.3,
+        "aggwin_unsharded_device_p50_ms": 62.5,
+        "aggwin_sharded_device_ratio": 0.5,
+        "aggwin_sharded_ratio_budget": 0.6,
+        "aggwin_sharded_bit_consistent": True,
         "e2e_pipelined_p99_ms": 7.1,
         "sync_floor_p50_ms": 66.0,
         # pathological bulk: thousands of chars of per-leg detail
@@ -74,7 +81,8 @@ class TestHeadline:
         assert head["ok"] is True
         assert head["detail_file"] == "BENCH_DETAIL.json"
         for gate in ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
-                     "aggwin_within_budget", "aggwin_pipeline_ok"):
+                     "aggwin_within_budget", "aggwin_pipeline_ok",
+                     "aggwin_sharded_ok"):
             assert head[gate] is True
 
     def test_survives_tail_window_truncation(self):
@@ -160,3 +168,34 @@ class TestErroredLegGates:
         result = fat_result(soak_ok=False)
         failed, _ = bench.evaluate_gates(result, on_tpu=False)
         assert failed
+
+    def test_sharded_window_violation_gates_and_survives_headline(self):
+        """The ISSUE-7 sharded-window gate: a measured violation fails
+        the run with a scaling/bit-consistency message, lands False in
+        the headline, and the headline still honors the size contract."""
+        result = fat_result(aggwin_sharded_ok=False,
+                            aggwin_sharded_device_ratio=0.91,
+                            aggwin_sharded_bit_consistent=True)
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert any("sharded" in m for m in messages)
+        result["ok"] = not failed
+        line = bench.build_headline(result, "BENCH_DETAIL.json")
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["aggwin_sharded_ok"] is False
+        assert head["ok"] is False
+
+    def test_absent_sharded_leg_does_not_gate(self):
+        """A single-device host (standalone scenarios run) emits no
+        sharded fields at all — the gate must not fire on absence."""
+        result = fat_result()
+        for key in list(result):
+            if key.startswith("aggwin_sharded") or \
+                    key.startswith("aggwin_unsharded"):
+                del result[key]
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert not failed
+        assert messages == []
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert "aggwin_sharded_ok" not in head

@@ -390,6 +390,11 @@ FIELD_MATRIX = [
     FieldCase("aggregator.dispatch_timeout",
               "aggregator: {dispatchTimeout: 15s}", 15.0,
               ["--aggregator.dispatch-timeout", "5s"], 5.0),
+    # sharded fleet window mesh (ISSUE 7)
+    FieldCase("aggregator.mesh_shape",
+              "aggregator: {meshShape: [8]}", [8]),
+    FieldCase("aggregator.mesh_axes",
+              "aggregator: {meshAxes: [node, model]}", ["node", "model"]),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -659,6 +664,15 @@ class TestValidationMatrix:
         ("aggregator.dispatchTimeout",
          lambda c: setattr(c.aggregator, "dispatch_timeout", -1),
          "dispatchTimeout"),
+        ("aggregator.meshAxes.empty",
+         lambda c: setattr(c.aggregator, "mesh_axes", []),
+         "meshAxes must name at least one axis"),
+        ("aggregator.meshAxes.leading",
+         lambda c: setattr(c.aggregator, "mesh_axes", ["model", "node"]),
+         "must lead with 'node'"),
+        ("aggregator.meshShape.rank",
+         lambda c: setattr(c.aggregator, "mesh_shape", [4, 2]),
+         "same rank"),
         ("fault.specs",
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),

@@ -405,3 +405,114 @@ class TestLadderMetrics:
         totals = [s.value for s in repromote.samples
                   if s.name.endswith("_total")]
         assert totals == [1.0]
+
+
+class TestShardedChaos:
+    """ISSUE 7: the sharded-window rung composes with the ladder — one
+    shard's device failure demotes to the existing SINGLE-device rungs
+    (the demoted window drops the mesh-wide dispatch), `reset()`
+    re-seeds every shard ring, and recovery re-promotes back to the
+    sharded rung bit-equal."""
+
+    def test_rung0_is_sharded_and_demotes_to_single_device(self):
+        import jax
+
+        from kepler_tpu.fleet.window import (PackedWindowEngine,
+                                             ShardedWindowEngine)
+
+        n_dev = len(jax.devices())
+        assert n_dev >= 4  # conftest forces 8 simulated devices
+        agg = make_agg(depth=2)
+        plan = FaultPlan([FaultSpec(site="device.dispatch_error",
+                                    skip=2, count=1)])
+        with fault.installed(plan):
+            published = run_windows(agg, 2)
+            assert isinstance(agg._engine, ShardedWindowEngine)
+            assert agg.window_health()["rung_name"] == \
+                "packed-sharded-pipelined"
+            assert agg._stats["window_shards"] == n_dev
+            # window 2 hits the armed fault: the shard failure demotes to
+            # the packed-serial rung on ONE device and still publishes
+            published += run_windows(agg, 1, start=2)
+            assert published[-1] is not None
+            assert agg._rung == RUNG_PACKED_SERIAL
+            serial_engine = agg._engine_serial
+            assert type(serial_engine) is PackedWindowEngine
+            assert serial_engine._mesh.devices.size == 1
+            assert agg._stats["window_shards"] == 1
+            health = agg.window_health()
+            assert health["rung_name"] == "packed-serial"
+            assert health["shards"] == 1
+            # sharded ring was re-seeded wholesale
+            assert agg._engine._buffers == []
+            assert agg._engine._shard_of == {}
+        agg.shutdown()
+
+    def test_shard_failure_demotes_and_repromotes_bit_equal(self):
+        """Acceptance: dispatch error on the sharded rung mid-pipeline →
+        demote through the ladder, re-promote back to the SHARDED rung,
+        and every published window stays bit-consistent with a fault-free
+        single-device serial packed reference."""
+        import jax
+
+        n_win, fail_at = 10, 4
+        ref = make_agg(depth=1)
+        ref._mesh = make_mesh([1], devices=jax.devices()[:1])
+        reference = run_windows(ref, n_win)
+        ref.shutdown()
+        assert all(r is not None for r in reference)
+
+        agg = make_agg(depth=2)
+        plan = FaultPlan([FaultSpec(site="device.dispatch_error",
+                                    skip=fail_at, count=1)])
+        with fault.installed(plan):
+            published = run_windows(agg, n_win)
+            tail = agg._drain_pipeline()
+        assert plan.fired("device.dispatch_error") == 1
+        assert agg._stats["window_demotions_total"] == 1
+        assert agg._stats["window_repromotions_total"] == 1
+        # back on the sharded rung, pipeline refilled
+        assert agg._rung == RUNG_PIPELINED
+        assert agg.window_health()["rung_name"] == \
+            "packed-sharded-pipelined"
+        assert agg._stats["window_shards"] == len(jax.devices())
+
+        base = 1e9
+        all_published = [r for r in published if r is not None]
+        if tail is not None:
+            all_published.append(tail)
+        for result in all_published:
+            win = int(round((result.timestamp - base) / 5.0)) - 1
+            assert_windows_equal(result, reference[win])
+        agg.shutdown()
+
+    def test_shard_oom_on_grow_demotes_then_sharded_regrows(self):
+        """Bucket growth on the sharded rung hits device.oom_on_grow:
+        the ladder absorbs it at a single-device rung, the interval
+        publishes, and the re-promoted sharded engine re-packs the grown
+        fleet bit-equal to a clean single-device reference."""
+        import jax
+
+        agg = make_agg(depth=2, repromote_after=2)
+        plan = FaultPlan([FaultSpec(site="device.oom_on_grow", count=1)])
+        with fault.installed(plan):
+            run_windows(agg, 3, n_nodes=5, w=4)
+            published = run_windows(agg, 6, start=3, n_nodes=5, w=12)
+            tail = agg._drain_pipeline()
+        assert plan.fired("device.oom_on_grow") == 1
+        assert agg._demotions_by_reason == {"oom_on_grow": 1}
+        assert published[0] is not None  # the growth window published
+        assert agg._rung == RUNG_PIPELINED  # recovered to sharded
+
+        ref = make_agg(depth=1)
+        ref._mesh = make_mesh([1], devices=jax.devices()[:1])
+        ref_published = run_windows(ref, 3, n_nodes=5, w=4)
+        ref_published += run_windows(ref, 6, start=3, n_nodes=5, w=12)
+        ref.shutdown()
+        ref_by_ts = {r.timestamp: r for r in ref_published if r is not None}
+        final = [r for r in published if r is not None][-2:]
+        if tail is not None:
+            final.append(tail)
+        for result in final:
+            assert_windows_equal(result, ref_by_ts[result.timestamp])
+        agg.shutdown()

@@ -880,6 +880,36 @@ class TestDonatedBufferRule:
             diags = lint(source, rel=rel)
             assert ids(diags) == ["KTL110"], rel
 
+    def test_per_shard_ring_rebind_is_clean(self, lint):
+        # the sharded-window idiom (ISSUE 7): each shard's donated
+        # handle is pulled out of the nested ring, rebound through the
+        # per-shard scatter-update, and stored straight back — the
+        # local name is never read between donation and rebind
+        diags = lint("""
+            def sync(self, shards):
+                update = self._entry[0]  # keplint: donates=0
+                for k in shards:
+                    resident = self._buffers[self._buf_i][k]
+                    resident = update(resident, self._stage[k])
+                    self._buffers[self._buf_i][k] = resident
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_per_shard_dead_handle_read_flagged(self, lint):
+        # same loop, but a shard "reuses" the pre-donation handle it
+        # kept around — exactly the stale read the per-shard rings
+        # must never perform
+        diags = lint("""
+            def sync(self, shards):
+                update = self._entry[0]  # keplint: donates=0
+                for k in shards:
+                    resident = self._buffers[self._buf_i][k]
+                    update(resident, self._stage[k])
+                    self._buffers[self._buf_i][k] = resident  # dead
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL110"]
+        assert "resident" in diags[0].message
+
 
 class TestBaselineRatchet:
     SOURCE = """

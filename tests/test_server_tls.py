@@ -201,6 +201,47 @@ class TestAuthenticator:
         assert not check("Bearer xyz")
         assert not check("Basic !!!not-base64!!!")
 
+    def test_unknown_user_dummy_matches_max_cost(self):
+        """The unknown-user timing equalizer precomputes a dummy hash at
+        the MAX cost parameter configured for the scheme — never a real
+        user's hash, and never cheaper than the costliest verify."""
+        from kepler_tpu.server.webconfig import _make_dummy_hash
+
+        from kepler_tpu.server.shacrypt import sha_crypt
+
+        users = {
+            "alice": crypt_hash("pw"),  # $5$rounds=1000$
+            "bob": sha_crypt("pw2", "$6$rounds=20000$somesalt"),
+        }
+        dummy = _make_dummy_hash(users)
+        assert dummy not in users.values()
+        assert dummy.startswith("$6$rounds=20000$")
+
+    def test_unknown_user_dummy_default_rounds(self):
+        from kepler_tpu.server.shacrypt import sha_crypt
+        from kepler_tpu.server.webconfig import _make_dummy_hash
+
+        no_rounds = sha_crypt("pw", "$6$plainsaltonly")
+        assert "rounds=" not in no_rounds
+        dummy = _make_dummy_hash({"alice": no_rounds})
+        # no explicit rounds configured → dummy at the scheme default cost
+        assert dummy.startswith("$6$rounds=5000$")
+
+    def test_unknown_user_dummy_counts_implicit_default_rounds(self):
+        """A rounds-less $5/$6 hash verifies at the scheme DEFAULT
+        (5000): it must contribute that to the max, or a config mixing
+        it with an explicit low-rounds user would build a dummy cheaper
+        than the default-cost user's verify — timing leak again."""
+        from kepler_tpu.server.shacrypt import sha_crypt
+        from kepler_tpu.server.webconfig import _make_dummy_hash
+
+        users = {
+            "cheap": sha_crypt("pw", "$6$rounds=1000$somesalt"),
+            "default": sha_crypt("pw2", "$6$plainsaltonly"),
+        }
+        dummy = _make_dummy_hash(users)
+        assert dummy.startswith("$6$rounds=5000$")
+
 
 class TestTLSServer:
     def test_https_scrape(self, certpair):

@@ -375,3 +375,180 @@ class TestDeltaAccounting:
         agg.aggregate_once()
         agg.aggregate_once()
         assert agg._stats["window_compiles_total"] == grown
+
+
+class TestShardedWindow:
+    """ISSUE 7: the packed window sharded over the device mesh
+    (ShardedWindowEngine — per-shard rings, sticky node→shard
+    assignment, per-shard delta H2D, one sharded dispatch)."""
+
+    def test_rung0_engine_is_sharded_on_multidevice_mesh(self):
+        import jax
+
+        from kepler_tpu.fleet.window import ShardedWindowEngine
+
+        agg = make_agg(1)
+        seed_window(agg, churn_schedule(1)[0], 1e9)
+        agg.aggregate_once()
+        assert isinstance(agg._engine, ShardedWindowEngine)
+        assert agg._engine.n_shards == len(jax.devices())
+        assert agg._stats["window_shards"] == len(jax.devices())
+        assert len(agg._stats["last_h2d_shards"]) == len(jax.devices())
+        health = agg.window_health()
+        assert health["rung_name"] == "packed-sharded-pipelined"
+        assert health["shards"] == len(jax.devices())
+        families = {f.name: f for f in agg.collect()}
+        shards = families["kepler_fleet_window_shards"]
+        assert shards.samples[0].value == len(jax.devices())
+        agg.shutdown()
+
+    def test_2d_mesh_falls_back_to_unsharded_engine(self):
+        from kepler_tpu.fleet.window import (PackedWindowEngine,
+                                             ShardedWindowEngine)
+
+        agg = make_agg(1)
+        agg._mesh = make_mesh([4, 2], ["node", "model"])
+        seed_window(agg, churn_schedule(1)[0], 1e9)
+        agg.aggregate_once()
+        assert type(agg._engine) is PackedWindowEngine
+        assert not isinstance(agg._engine, ShardedWindowEngine)
+        assert agg._stats["window_shards"] == 1
+        assert agg.window_health()["rung_name"] == "packed-pipelined"
+        agg.shutdown()
+
+    @pytest.mark.parametrize("depth", [1, 2])
+    def test_sharded_matches_single_device_bit_exact_under_churn(
+            self, depth):
+        import jax
+
+        schedules = churn_schedule(9)
+        sharded = run_schedule(make_agg(depth), schedules)
+        single = make_agg(1)
+        single._mesh = make_mesh([1], devices=jax.devices()[:1])
+        reference = run_schedule(single, schedules)
+        assert len(sharded) == len(reference) == len(schedules)
+        for a, b in zip(reference, sharded):
+            assert a.timestamp == b.timestamp
+            assert_windows_equal(a, b)
+
+    def test_sticky_assignment_join_drop_rejoin_touch_one_shard(self):
+        """A join (and a drop, and a rejoin) stages rows ONLY to the
+        owning shard: every other shard sees zero H2D and no engine
+        compiles — surviving nodes never migrate."""
+        agg = make_agg(1, node_bucket=32)  # shard bucket 4 on 8 devices
+        base = {f"n{i:02d}": (i, ZONES, i % 2, 1, "r1") for i in range(10)}
+        now = 1e9
+        seed_window(agg, base, now)
+        agg.aggregate_once()
+        engine = agg._engine
+        slots = len(engine._buffers)
+        # warm the delta path (every shard stages once, the scatter-
+        # update compiles its one shared key), then settle to zero H2D
+        warm = {name: (seed + 1000, z, m, 2, r)
+                for name, (seed, z, m, _s, r) in base.items()}
+        seed_window(agg, warm, now)
+        for _ in range(slots):
+            agg.aggregate_once()
+        agg.aggregate_once()
+        assert agg._stats["last_h2d_rows"] == 0
+        base = warm
+        home = dict(engine._shard_of)
+        compiles = agg._stats["window_compiles_total"]
+
+        joined = dict(base)
+        joined["n99"] = (99, ZONES, MODE_RATIO, 1, "r1")
+        seed_window(agg, joined, now)
+        touched = set()
+        for _ in range(slots + 1):
+            agg.aggregate_once()
+            staged = agg._stats["last_h2d_shards"]
+            touched |= {k for k, n in enumerate(staged) if n}
+        # the join staged on exactly its shard (once per ring slot),
+        # nothing recompiled, and nobody else moved or restaged
+        assert touched == {engine._shard_of["n99"]}
+        assert agg._stats["window_compiles_total"] == compiles
+        assert {n: k for n, k in engine._shard_of.items()
+                if n != "n99"} == home
+
+        n99_shard = engine._shard_of["n99"]
+        seed_window(agg, base, now)  # n99 drops: its shard clears the row
+        touched = set()
+        for _ in range(slots + 1):
+            agg.aggregate_once()
+            staged = agg._stats["last_h2d_shards"]
+            touched |= {k for k, n in enumerate(staged) if n}
+        assert touched == {n99_shard}  # only the freed row's shard cleared
+        assert agg._stats["window_compiles_total"] == compiles
+        assert dict(engine._shard_of) == home
+
+        joined["n99"] = (123, ZONES, MODE_RATIO, 2, "r1")  # rejoin, new data
+        seed_window(agg, joined, now)
+        result = agg.aggregate_once()
+        staged = agg._stats["last_h2d_shards"]
+        assert sum(1 for n in staged if n) == 1
+        assert agg._stats["window_compiles_total"] == compiles
+        assert {n: k for n, k in engine._shard_of.items()
+                if n != "n99"} == home
+        # the rejoined node's published row is the FRESH report (old
+        # resident contents never leak; joules/timestamp are cumulative
+        # and legitimately differ between the two aggregators)
+        fresh = make_agg(1)
+        fresh_result = run_schedule(fresh, [joined])[-1]
+        got = result.render_node("n99")
+        want = fresh_result.render_node("n99")
+        for key in ("mode", "node_power_uw", "node_energy_uj", "workloads"):
+            assert got[key] == want[key], key
+        agg.shutdown()
+        fresh.shutdown()
+
+    def test_changed_row_stages_only_on_owning_shard(self):
+        agg = make_agg(1, node_bucket=32)
+        sched = {f"n{i:02d}": (i, ZONES, i % 2, 1, "r1") for i in range(10)}
+        seed_window(agg, sched, 1e9)
+        agg.aggregate_once()
+        engine = agg._engine
+        for _ in range(len(engine._buffers)):
+            agg.aggregate_once()
+        sched["n04"] = (321, ZONES, 0, 2, "r1")
+        seed_window(agg, sched, 1e9)
+        agg.aggregate_once()
+        staged = agg._stats["last_h2d_shards"]
+        owner = engine._shard_of["n04"]
+        assert staged[owner] == 1
+        assert sum(staged) == 1
+        agg.shutdown()
+
+    def test_bucket_overflow_rebalances_all_shards(self):
+        """Only overflow (no shard has a free row) migrates nodes: the
+        rebuild restages every shard at the grown bucket and balances
+        MODE_MODEL rows across shards within one row."""
+        import jax
+
+        from kepler_tpu.parallel.fleet import MODE_MODEL as MM
+
+        n_dev = len(jax.devices())
+        agg = make_agg(1, node_bucket=n_dev)  # shard bucket 1: 8 rows
+        sched = {f"n{i:02d}": (i, ZONES, i % 2, 1, "r1")
+                 for i in range(n_dev)}
+        seed_window(agg, sched, 1e9)
+        agg.aggregate_once()
+        engine = agg._engine
+        compiles = agg._stats["window_compiles_total"]
+        sched.update({f"m{i:02d}": (50 + i, ZONES, i % 2, 1, "r1")
+                      for i in range(4)})  # 12 nodes > 8 rows: overflow
+        seed_window(agg, sched, 1e9)
+        agg.aggregate_once()
+        staged = agg._stats["last_h2d_shards"]
+        assert all(n > 0 for n in staged)  # full rebalance restage
+        assert agg._stats["window_compiles_total"] > compiles
+        mode_arr = list(engine._mode)
+        sb = engine._ladder_n.bucket
+        per_shard_model = [
+            sum(1 for r in range(k * sb, (k + 1) * sb)
+                if mode_arr[r] == MM) for k in range(engine.n_shards)]
+        assert max(per_shard_model) - min(per_shard_model) <= 1
+        # steady again afterwards
+        agg.aggregate_once()
+        agg.aggregate_once()
+        assert agg._stats["window_compiles_total"] > compiles
+        agg.shutdown()
