@@ -48,6 +48,10 @@ dryrun: ## compile-check driver entry points on a virtual 8-device mesh
 multichip: ## node-sharded fleet window dryrun on 8 simulated devices (bit-equal vs single-device)
 	$(PYTHON) -c "from __graft_entry__ import dryrun_fleet_sharded; dryrun_fleet_sharded(8)"
 
+.PHONY: introspect
+introspect: ## smoke the introspection plane: /debug/window + /debug/fleet on a local aggregator
+	$(PYTHON) hack/introspect_smoke.py
+
 # -- native -------------------------------------------------------------------
 .PHONY: native
 native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)

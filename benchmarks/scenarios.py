@@ -446,6 +446,17 @@ def run_aggregator_window_scenario(iters: int) -> dict:
     shard_fields = _sharded_window_fields(iters, n_nodes, w, dev_ms,
                                           host_s, host_last)
 
+    # introspection evidence (detail row only — headline stays core):
+    # compiled window-program cost, sticky-map skew, and ladder-timeline
+    # length, so future perf PRs can correlate device-leg ratios with
+    # compiled cost instead of re-deriving it
+    program_flops = 0.0
+    engine = host_agg._engine
+    if engine is not None:
+        program_flops = max(
+            (c.get("flops", 0.0) for c in engine.cost_stats().values()
+             if c["label"].startswith("prog_")), default=0.0)
+
     pipe_p50 = pipe_ms[len(pipe_ms) // 2]
     serial_p50 = serial_ms[len(serial_ms) // 2]
     ratio = pipe_p50 / max(serial_p50, 1e-9)
@@ -463,6 +474,9 @@ def run_aggregator_window_scenario(iters: int) -> dict:
         "scatter_ms": round(s["last_scatter_ms"], 3),
         "h2d_delta_rows": int(s["last_h2d_rows"]),
         "compile_count": int(s["window_compiles_total"]),
+        "program_flops": program_flops,
+        "shard_skew": float(host_s.get("shard_skew", 0.0)),
+        "rung_timeline_len": len(host_agg._rung_timeline),
         "window_p50_ms": round(pipe_p50, 3),
         "pipeline_p50_ms": round(pipe_p50, 3),
         "pipeline_p99_ms": round(_pctl(pipe_ms, 0.99), 3),

@@ -217,6 +217,15 @@ DESCRIPTIONS = {
     "aggregator.mesh_axes": "Mesh axis names for the fleet window path; "
                             "must lead with `node` (the axis the fleet "
                             "batch shards over).",
+    "aggregator.scoreboard_cap": "Fleet scoreboard LRU cap: per-node "
+                                 "health rows kept (bounds memory AND "
+                                 "`kepler_fleet_node_state` "
+                                 "cardinality; least-recently-updated "
+                                 "node evicted beyond it).",
+    "aggregator.anomaly_z": "Rolling z-score threshold flagging a "
+                            "node's self-reported power as anomalous "
+                            "on the scoreboard (`0` disables the "
+                            "flag).",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -312,6 +321,8 @@ FLAG_OF = {
         "--aggregator.fallback-enabled / --no-aggregator.fallback-enabled",
     "aggregator.repromote_after": "--aggregator.repromote-after",
     "aggregator.dispatch_timeout": "--aggregator.dispatch-timeout",
+    "aggregator.scoreboard_cap": "--aggregator.scoreboard-cap",
+    "aggregator.anomaly_z": "--aggregator.anomaly-z",
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",

@@ -458,10 +458,10 @@ _METRIC_NAME = re.compile(r"^kepler_[a-z][a-z0-9_]*$")
 # approved final name tokens: units first, then semantic/count forms
 _UNIT_TOKENS = frozenset({
     "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
-    "celsius", "info", "healthy", "degraded",
+    "celsius", "info", "healthy", "degraded", "flops", "state",
 })
 _COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows",
-                           "shards"})
+                           "shards", "windows"})
 # reference-parity names grandfathered in (match the upstream exporter)
 _EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
 

@@ -80,6 +80,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         dispatch_timeout=cfg.aggregator.dispatch_timeout,
         mesh_shape=cfg.aggregator.mesh_shape,
         mesh_axes=cfg.aggregator.mesh_axes,
+        scoreboard_cap=cfg.aggregator.scoreboard_cap,
+        anomaly_z=cfg.aggregator.anomaly_z,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

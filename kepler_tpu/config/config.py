@@ -363,6 +363,13 @@ class AggregatorConfig:
     # unsharded engine (batch still NamedSharding-sharded)
     mesh_shape: list[int] = field(default_factory=list)
     mesh_axes: list[str] = field(default_factory=lambda: ["node"])
+    # -- fleet scoreboard (docs/developer/observability.md "Fleet
+    # scoreboard"): per-node health table served at /debug/fleet and as
+    # kepler_fleet_node_state — LRU-capped (bounds memory AND metric
+    # cardinality), with a rolling z-score anomaly flag on each node's
+    # self-reported power (0 disables the anomaly flag)
+    scoreboard_cap: int = 1024
+    anomaly_z: float = 4.0
 
 
 @dataclass
@@ -465,6 +472,11 @@ class Config:
             errs.append("aggregator.bucketShrinkAfter must be >= 1")
         if self.aggregator.repromote_after < 1:
             errs.append("aggregator.repromoteAfter must be >= 1")
+        if self.aggregator.scoreboard_cap < 1:
+            errs.append("aggregator.scoreboardCap must be >= 1")
+        if self.aggregator.anomaly_z < 0:
+            errs.append("aggregator.anomalyZ must be >= 0 (0 disables "
+                        "the anomaly flag)")
         if self.aggregator.dispatch_timeout < 0:
             errs.append("aggregator.dispatchTimeout must be >= 0 "
                         "(0 disables the stall watchdog)")
@@ -576,6 +588,8 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "fallbackEnabled": "fallback_enabled",
     "repromoteAfter": "repromote_after",
     "dispatchTimeout": "dispatch_timeout",
+    "scoreboardCap": "scoreboard_cap",
+    "anomalyZ": "anomaly_z",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
@@ -737,6 +751,14 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         default=None,
         help="stall watchdog bound on the window fetch, e.g. 30s "
              "(0 disables)")
+    add("--aggregator.scoreboard-cap", dest="aggregator_scoreboard_cap",
+        default=None, type=int,
+        help="fleet scoreboard LRU cap (bounds memory and "
+             "kepler_fleet_node_state cardinality)")
+    add("--aggregator.anomaly-z", dest="aggregator_anomaly_z",
+        default=None, type=float,
+        help="rolling z-score threshold flagging a node's reported "
+             "power as anomalous (0 disables)")
     add("--agent.spool-dir", dest="agent_spool_dir", default=None,
         help="crash-safe report spool directory (empty disables)")
     add("--tpu.platform", dest="tpu_platform", default=None,
@@ -800,6 +822,8 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "repromote_after"), args.aggregator_repromote_after)
     set_if(("aggregator", "dispatch_timeout"),
            args.aggregator_dispatch_timeout, _parse_duration)
+    set_if(("aggregator", "scoreboard_cap"), args.aggregator_scoreboard_cap)
+    set_if(("aggregator", "anomaly_z"), args.aggregator_anomaly_z)
     if args.agent_spool_dir is not None:
         cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)

@@ -9,6 +9,7 @@ back per node.
 
 from kepler_tpu.fleet.agent import FleetAgent
 from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.scoreboard import FleetScoreboard
 from kepler_tpu.fleet.spool import Spool
 from kepler_tpu.fleet.wire import (
     WireError,
@@ -19,6 +20,7 @@ from kepler_tpu.fleet.wire import (
 __all__ = [
     "Aggregator",
     "FleetAgent",
+    "FleetScoreboard",
     "Spool",
     "WireError",
     "decode_report",

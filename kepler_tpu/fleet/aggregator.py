@@ -46,6 +46,7 @@ import numpy as np
 
 from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.wire import WireError, decode_report, peek_node_name
+from kepler_tpu.fleet.scoreboard import STATE_NAMES, FleetScoreboard
 from kepler_tpu.fleet.window import (DeviceWindowError, PackedWindowEngine,
                                      RowInput, ShardedWindowEngine,
                                      WindowMeta, align_zone_matrices)
@@ -111,6 +112,37 @@ class _Stored:
     received: float
     seq: int
     run: str = ""  # agent-run nonce (empty for pre-nonce agents)
+
+
+def _primary_introspect(snap: Mapping[str, dict]) -> dict | None:
+    """The engine snapshot the shard/staleness/skew metrics should read:
+    the one actively holding resident rows. After a demotion both
+    engines were reset and the DEMOTED rung's engine re-packs — the
+    rung-0 engine reads empty until re-promotion, so preferring it
+    unconditionally would blank the flight recorder exactly while the
+    plane is degraded."""
+    pipelined = snap.get("pipelined")
+    serial = snap.get("serial")
+    if pipelined and pipelined["resident"]["rows"]:
+        return pipelined
+    if serial and serial["resident"]["rows"]:
+        return serial
+    return pipelined or serial
+
+
+def _report_power_w(report: NodeReport) -> float:
+    """The node's self-reported power this window (valid zone energy
+    over the window interval), the scoreboard's anomaly signal. Returns
+    NaN when the report carries no usable window (the scoreboard skips
+    non-finite magnitudes)."""
+    dt = float(report.dt_s)
+    if dt <= 0.0:
+        return float("nan")
+    valid = np.asarray(report.zone_valid, bool)
+    deltas = np.asarray(report.zone_deltas_uj, np.float64)
+    if valid.shape != deltas.shape or not valid.any():
+        return float("nan")
+    return float(deltas[valid].sum()) / dt / 1e6
 
 
 @dataclass
@@ -366,6 +398,8 @@ class Aggregator:
         dispatch_timeout: float = 30.0,
         mesh_shape: Sequence[int] | None = None,
         mesh_axes: Sequence[str] | None = None,
+        scoreboard_cap: int = 1024,
+        anomaly_z: float = 4.0,
         clock=None,
         mesh=None,
     ) -> None:
@@ -448,6 +482,12 @@ class Aggregator:
         self._tracker_cap = 512
         self._lost_by_node: dict[str, int] = {}  # keplint: guarded-by=_lock
         self._lost_node_cap = 256
+        # fleet scoreboard: one synthesized health row per node (state
+        # machine + rolling power z-score), LRU-capped, updated at ingest
+        # and served via /debug/fleet + kepler_fleet_node_state
+        self._scoreboard = FleetScoreboard(  # keplint: guarded-by=_lock
+            cap=scoreboard_cap, anomaly_z=anomaly_z,
+            flag_ttl=degraded_ttl)
         self._results_lock = threading.Lock()
         self._results: FleetResults | None = None  # keplint: guarded-by=_results_lock
         self._last_window_at: float | None = None
@@ -474,6 +514,9 @@ class Aggregator:
                        # the per-shard H2D breakdown
                        "window_shards": 0,
                        "last_h2d_shards": [],
+                       # sticky-map load skew: max/mean per-shard row
+                       # occupancy (1.0 = balanced, 0 = no rows yet)
+                       "shard_skew": 0.0,
                        "window_compiles_total": 0,
                        # degradation ladder (0 = healthy full path)
                        "window_rung": 0,
@@ -521,6 +564,17 @@ class Aggregator:
         self._rung = RUNG_PIPELINED  # keplint: guarded-by=_results_lock
         self._clean_windows = 0  # consecutive clean at the current rung
         self._windows_since_failure = 0
+        # rung timeline: a bounded ring of ladder transitions (rung,
+        # reason, monotonic + wall time, windows spent at the previous
+        # rung) behind the ladder — the flight recorder's "when did we
+        # degrade, why, and for how long" answer, served by the probe
+        # and /debug/window. Published windows tick _windows_at_rung.
+        self._rung_timeline: collections.deque[dict] = collections.deque(  # keplint: guarded-by=_results_lock
+            maxlen=64)
+        self._windows_at_rung = 0
+        # per-window engine introspection snapshot (computed by the
+        # publish path, read by /debug/window + collect off-thread)
+        self._introspect_cache: dict = {}  # keplint: guarded-by=_results_lock
         # failed-probe backoff (the breaker's doubling cooldown, ladder-
         # shaped): a demotion that lands before a just-promoted rung
         # proves itself doubles the clean-window threshold for the next
@@ -569,6 +623,14 @@ class Aggregator:
                               max_body=MAX_REPORT_BYTES)
         self._server.register("/v1/results", "Fleet results",
                               "attributed watts per node", self._handle_results)
+        self._server.register("/debug/window", "Window introspection",
+                              "device-plane engine state: rung + "
+                              "timeline, shards, bucket ladders, "
+                              "compile-cache cost stats",
+                              self._handle_window_debug)
+        self._server.register("/debug/fleet", "Fleet scoreboard",
+                              "per-node health state table",
+                              self._handle_fleet_debug)
         health = getattr(self._server, "health", None)
         if health is not None:
             health.register_probe("fleet-aggregator", self.health)
@@ -688,6 +750,9 @@ class Aggregator:
                          received=received,
                          seq=seq_raw,
                          run=run_raw)
+        # scoreboard input, computed OFF the store lock: the node's
+        # self-reported power this window (valid zone energy over dt)
+        report_power_w = _report_power_w(report)
         with telemetry.span("aggregator.merge"), self._lock:
             prev = self._reports.get(report.node_name)
             # When BOTH sides carry a run nonce the cases are unambiguous:
@@ -717,6 +782,7 @@ class Aggregator:
             # sequencing" (encode_report's default): real agents number
             # from 1, and deduping a stream of constant zeros would
             # freeze the node's data on its first window forever.
+            lost_windows = 0
             if stored.run and stored.seq > 0:
                 tracker = self._seq_trackers.get(report.node_name)
                 if tracker is None or tracker.run != stored.run:
@@ -748,8 +814,11 @@ class Aggregator:
                         prev.received = received
                     self._stats["duplicates_total"] += 1
                     self._stats["reports_total"] += 1
+                    self._scoreboard.observe_duplicate(report.node_name,
+                                                       received)
                     return 204, {}, b""
                 if lost:
+                    lost_windows = lost
                     self._stats["windows_lost_total"] += lost
                     # pop-and-reinsert keeps dict order = recency of last
                     # loss, so cap eviction drops the node that stopped
@@ -789,6 +858,9 @@ class Aggregator:
                         and (prev is None or restarted
                              or stored.seq != prev.seq)):
                     self._push_history(report)
+            self._scoreboard.observe_report(report.node_name, received,
+                                            report_power_w,
+                                            lost=lost_windows)
             self._observe_delivery_locked(report.node_name, header,
                                           received)
             self._stats["reports_total"] += 1
@@ -823,6 +895,10 @@ class Aggregator:
                 basis = appended
         latency = max(0.0, received - basis)
         self._delivery_hist[path].observe(latency)
+        if path == "fresh":
+            # the scoreboard's per-node EWMA tracks network health, so
+            # replay latency (outage age, not delivery speed) stays out
+            self._scoreboard.observe_delivery(node, latency)
         trace = header.get("trace")
         if trace:
             log.debug("delivery trace %s closed: node=%s path=%s "
@@ -871,6 +947,7 @@ class Aggregator:
         entry[reason] += 1
         entry["last_error"] = detail
         entry["last_at"] = self._clock()
+        self._scoreboard.observe_quarantine(node, entry["last_at"], reason)
         log.warning("quarantined %s report from node %s: %s",
                     reason, node, detail)
 
@@ -924,12 +1001,36 @@ class Aggregator:
                 "windows_since_last_failure": self._windows_since_failure,
                 "fallback_enabled": self._fallback_enabled,
                 "probe_backoff": self._probe_penalty,
+                "windows_at_rung": self._windows_at_rung,
+                "timeline_len": len(self._rung_timeline),
+                # the last few transitions inline (full ring on
+                # /debug/window) — enough for "what just happened"
+                "timeline": list(self._rung_timeline)[-5:],
             }
             if self._last_window_failure:
                 out["last_failure"] = self._last_window_failure
         return out
 
     # -- degradation ladder ------------------------------------------------
+
+    # keplint: requires-lock=_results_lock
+    def _record_rung_transition_locked(self, prev: int, rung: int,
+                                       reason: str) -> None:
+        """Append one ladder transition to the bounded rung timeline
+        (the flight recorder's demote/re-promote history). Monotonic
+        time orders transitions across wall-clock steps; wall time
+        anchors them for humans."""
+        self._rung_timeline.append({
+            "rung": rung,
+            "rung_name": self._rung_display(rung),
+            "from_rung": prev,
+            "from_rung_name": self._rung_display(prev),
+            "reason": reason,
+            "wall_time": self._clock(),
+            "monotonic_s": _time.monotonic(),
+            "windows_at_prev_rung": self._windows_at_rung,
+        })
+        self._windows_at_rung = 0
 
     def _handle_device_failure(self, err: Exception) -> None:
         """One device-leg failure: abandon every in-flight window (their
@@ -968,6 +1069,7 @@ class Aggregator:
             self._stats["window_demotions_total"] += 1
             self._stats["window_rung"] = rung
             self._last_window_failure = f"{reason}: {err}"[:240]
+            self._record_rung_transition_locked(prev, rung, reason)
         log.error("fleet window device leg failed (%s) at rung %s; "
                   "demoting to %s, %d in-flight window(s) abandoned, "
                   "resident ring re-seeded: %s", reason,
@@ -983,6 +1085,7 @@ class Aggregator:
         promoted = None
         with self._results_lock:
             self._windows_since_failure += 1
+            self._windows_at_rung += 1
             if self._just_promoted:
                 self._just_promoted = False  # the rung proved itself
                 if self._rung == RUNG_PIPELINED:
@@ -1001,6 +1104,8 @@ class Aggregator:
                     self._stats["window_repromotions_total"] += 1
                     self._stats["window_rung"] = self._rung
                     promoted = self._rung
+                    self._record_rung_transition_locked(
+                        self._rung + 1, self._rung, "repromoted")
         if promoted is not None:
             log.info("fleet window ladder: clean-window threshold met — "
                      "re-promoted to rung %d (%s)", promoted,
@@ -1415,6 +1520,23 @@ class Aggregator:
                     e.compile_count
                     for e in (self._engine, self._engine_serial)
                     if e is not None)
+            # per-window engine introspection snapshot: computed HERE
+            # (the only thread that owns engine state) so /debug/window
+            # and collect() read a coherent copy off-thread without
+            # touching live engine internals
+            engines: dict[str, dict] = {}
+            for label, eng in (("pipelined", self._engine),
+                               ("serial", self._engine_serial)):
+                if eng is not None:
+                    engines[label] = eng.introspect()
+            primary = _primary_introspect(engines)
+            skew = 0.0
+            if primary is not None:
+                occupied = [s["rows"] for s in primary["shards"]]
+                if any(occupied):
+                    skew = max(occupied) / (sum(occupied) / len(occupied))
+            self._stats["shard_skew"] = round(skew, 4)
+            self._introspect_cache = engines
         log.debug("fleet attribution: %d nodes, %d workloads, %.2f ms "
                   "(h2d rows %d)", len(results.names), n_workloads,
                   self._stats["last_attribution_ms"], p.h2d_rows)
@@ -1700,6 +1822,50 @@ class Aggregator:
         return (200, {"Content-Type": "application/json"},
                 json.dumps(payload).encode())
 
+    def _handle_window_debug(self, request) -> tuple[int, dict[str, str],
+                                                     bytes]:
+        """``GET /debug/window``: the device plane's flight-recorder
+        dump — rung + transition timeline, shard layout, bucket
+        ladders, compile-cache keys with their cost stats, last H2D per
+        shard, sticky-map skew. Engine state comes from the per-window
+        introspection snapshot (coherent, no live engine access)."""
+        with self._results_lock:
+            payload: dict = {
+                "rung": self._rung,
+                "rung_name": self._rung_display(self._rung),
+                "shards": (self._shard_count
+                           if self._rung == RUNG_PIPELINED else 1),
+                "windows_at_rung": self._windows_at_rung,
+                "windows_since_last_failure": self._windows_since_failure,
+                "fallback_enabled": self._fallback_enabled,
+                "probe_backoff": self._probe_penalty,
+                "timeline": list(self._rung_timeline),
+                "demotions_by_reason": dict(self._demotions_by_reason),
+                "engines": self._introspect_cache,
+                "stats": {k: self._stats[k] for k in (
+                    "last_assembly_ms", "last_dispatch_ms",
+                    "last_wait_ms", "last_scatter_ms",
+                    "last_attribution_ms", "last_h2d_rows",
+                    "last_h2d_shards", "window_shards", "shard_skew",
+                    "window_compiles_total", "window_rung",
+                    "window_demotions_total",
+                    "window_repromotions_total", "last_batch_nodes",
+                    "last_batch_workloads")},
+            }
+            if self._last_window_failure:
+                payload["last_failure"] = self._last_window_failure
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(payload).encode())
+
+    def _handle_fleet_debug(self, request) -> tuple[int, dict[str, str],
+                                                    bytes]:
+        """``GET /debug/fleet``: the per-node scoreboard table."""
+        now = self._clock()
+        with self._lock:
+            snap = self._scoreboard.snapshot(now, self._stale_after)
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(snap).encode())
+
     # -- prometheus (cluster-level families) -------------------------------
 
     def collect(self):
@@ -1712,6 +1878,9 @@ class Aggregator:
             results = self._results
             stats = dict(self._stats)
             demotions_snap = sorted(self._demotions_by_reason.items())
+            # replaced wholesale per published window; nested dicts are
+            # never mutated after construction, so reading out is safe
+            introspect_snap = self._introspect_cache
         nodes = GaugeMetricFamily(
             "kepler_fleet_nodes", "Nodes in the last fleet batch")
         nodes.add_metric([], stats["last_batch_nodes"])
@@ -1750,6 +1919,85 @@ class Aggregator:
             "or a demoted single-device ladder rung)")
         shards.add_metric([], stats["window_shards"])
         yield shards
+        primary = _primary_introspect(introspect_snap)
+        skew = GaugeMetricFamily(
+            "kepler_fleet_window_shard_skew_ratio",
+            "Sticky-map load skew: max/mean per-shard resident-row "
+            "occupancy (1.0 = balanced; the sparse model bucket — and "
+            "so the whole mesh's estimator FLOPs — is sized by the "
+            "fullest shard)")
+        skew.add_metric([], stats["shard_skew"])
+        yield skew
+        shard_rows = GaugeMetricFamily(
+            "kepler_fleet_window_shard_rows",
+            "Resident-row occupancy per device shard, split by row "
+            "mode (shard-count-bounded cardinality)",
+            labels=["shard", "mode"])
+        if primary is not None:
+            for k, occ in enumerate(primary["shards"]):
+                shard_rows.add_metric([str(k), "model"],
+                                      occ["model_rows"])
+                shard_rows.add_metric([str(k), "ratio"],
+                                      occ["rows"] - occ["model_rows"])
+        yield shard_rows
+        h2d_by_shard = GaugeMetricFamily(
+            "kepler_fleet_window_shard_h2d_rows",
+            "Rows staged + uploaded per device shard for the last "
+            "fleet window (delta H2D; a hot shard here means churn is "
+            "landing unevenly)",
+            labels=["shard"])
+        for k, n in enumerate(stats["last_h2d_shards"]):
+            h2d_by_shard.add_metric([str(k)], n)
+        yield h2d_by_shard
+        staleness = GaugeMetricFamily(
+            "kepler_fleet_window_buffer_staleness_windows",
+            "Windows since each ping-pong ring slot last served (0 = "
+            "served the latest window; a slot stuck high means the "
+            "donation rotation is wedged)",
+            labels=["slot"])
+        if primary is not None:
+            for slot, age in enumerate(
+                    primary["resident"]["staleness_windows"]):
+                staleness.add_metric([str(slot)], age)
+        yield staleness
+        prog_flops = GaugeMetricFamily(
+            "kepler_fleet_window_program_flops",
+            "XLA cost_analysis FLOPs of each cached fleet-window "
+            "program (captured at cold compile; label cardinality "
+            "bounded by the compile-cache cap)",
+            labels=["program"])
+        prog_bytes = GaugeMetricFamily(
+            "kepler_fleet_window_program_bytes",
+            "XLA cost_analysis bytes accessed per execution of each "
+            "cached fleet-window program",
+            labels=["program"])
+        prog_mem = GaugeMetricFamily(
+            "kepler_fleet_window_program_device_memory_bytes",
+            "XLA memory_analysis device footprint (arguments + outputs "
+            "+ temps + generated code) of each cached fleet-window "
+            "program",
+            labels=["program"])
+        if introspect_snap:
+            seen_programs: set[str] = set()
+            for eng in introspect_snap.values():
+                for kind in ("programs", "updates"):
+                    for prog in eng.get(kind, ()):
+                        cost = prog.get("cost")
+                        if not cost or "flops" not in cost:
+                            continue
+                        label = cost["label"]
+                        if label in seen_programs:
+                            continue  # serial engine mirrors a key
+                        seen_programs.add(label)
+                        prog_flops.add_metric([label], cost["flops"])
+                        prog_bytes.add_metric([label],
+                                              cost["bytes_accessed"])
+                        if "device_memory_bytes" in cost:
+                            prog_mem.add_metric(
+                                [label], cost["device_memory_bytes"])
+        yield prog_flops
+        yield prog_bytes
+        yield prog_mem
         compiles = CounterMetricFamily(
             "kepler_fleet_window_compiles_total",
             "Fleet-window program-cache misses — attribution programs "
@@ -1801,11 +2049,13 @@ class Aggregator:
             "Redelivered (run, seq) reports absorbed by the dedup window")
         duplicates.add_metric([], stats["duplicates_total"])
         yield duplicates
+        now = self._clock()
         with self._lock:
             lost_by_node = dict(self._lost_by_node)
             delivery_snap = [
                 (path, h.cumulative(), h.sum)
                 for path, h in sorted(self._delivery_hist.items())]
+            node_states = self._scoreboard.states(now, self._stale_after)
         from prometheus_client.core import HistogramMetricFamily
         delivery = HistogramMetricFamily(
             "kepler_fleet_delivery_latency_seconds",
@@ -1829,6 +2079,24 @@ class Aggregator:
             "Nodes whose reports were quarantined within the decay window")
         degraded.add_metric([], len(self.degraded_nodes()))
         yield degraded
+        node_state = GaugeMetricFamily(
+            "kepler_fleet_node_state",
+            "Scoreboard state per node (0 healthy, 1 stale, 2 lossy, "
+            "3 anomalous, 4 quarantined); cardinality bounded by the "
+            "scoreboard LRU cap",
+            labels=["node_name"])
+        state_rollup = {name: 0 for name in STATE_NAMES}
+        for node, code in node_states.items():
+            node_state.add_metric([node], code)
+            state_rollup[STATE_NAMES[code]] += 1
+        yield node_state
+        scoreboard_nodes = GaugeMetricFamily(
+            "kepler_fleet_scoreboard_nodes",
+            "Scoreboard rollup: nodes currently in each health state",
+            labels=["state"])
+        for name in STATE_NAMES:
+            scoreboard_nodes.add_metric([name], state_rollup[name])
+        yield scoreboard_nodes
         node_watts = GaugeMetricFamily(
             "kepler_fleet_node_cpu_watts",
             "Per-node power attributed by the fleet aggregator",

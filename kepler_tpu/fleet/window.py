@@ -35,6 +35,7 @@ depth-2 pipeline lives in ``fleet.aggregator``).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Callable, NamedTuple, Sequence
 
@@ -43,6 +44,8 @@ import numpy as np
 from kepler_tpu import fault
 from kepler_tpu.parallel.fleet import (MODE_MODEL, NodeReport,
                                        assemble_fleet_batch)
+
+log = logging.getLogger("kepler.fleet.window")
 
 __all__ = [
     "BucketLadder",
@@ -226,6 +229,10 @@ class PackedWindowEngine:
     # the sharded subclass flips this to compile the shard-local variant
     _LOCAL_SPARSE = False
 
+    # device shards the resident batch spans (the sharded subclass sets
+    # its instance attribute from the mesh)
+    n_shards = 1
+
     def __init__(self, mesh, backend: str = "einsum",
                  model_mode: str | None = None,
                  node_bucket: int = 8, workload_bucket: int = 256,
@@ -248,8 +255,12 @@ class PackedWindowEngine:
         self._sparse = bool(model_mode) and backend == "einsum"
         self._sh_batch = NamedSharding(mesh, P(NODE_AXIS, None))
         self._sh_repl = NamedSharding(mesh, P())
-        self._programs: dict[tuple, list] = {}  # key → [program, cold]
-        self._updates: dict[tuple, list] = {}  # (n, width, db) → [fn, cold]
+        # cache entries are [program, cold, cost_stats | None, label]:
+        # cost stats (XLA cost_analysis / memory_analysis, keyed by the
+        # bounded label minted with the cache key) are captured once per
+        # entry at the first dispatch-ready plan
+        self._programs: dict[tuple, list] = {}
+        self._updates: dict[tuple, list] = {}  # (n, width, db) key
         self.compile_count = 0  # program-cache misses (attribution + update)
 
         # resident state (invalid until the first plan_window). The
@@ -287,6 +298,12 @@ class PackedWindowEngine:
             np.zeros((0, 0), np.float32)
             for _ in range(max(2, staging_slots))]
         self._stage_i = 0
+        # introspection: monotone window counter + per-ring-slot "last
+        # window this buffer served" (staleness = how many windows a
+        # ping-pong buffer has sat out — a slot that stops serving is a
+        # rotation bug, surfaced instead of silently shipping stale rows)
+        self._window_seq = 0
+        self._buf_served: list[int] = []
 
     # -- program/update caches ---------------------------------------------
 
@@ -308,7 +325,7 @@ class PackedWindowEngine:
                 self._mesh, n_workloads=wb, n_zones=z,
                 model_mode=self._model_mode, backend=self._backend,
                 model_bucket=mb, local_model_rows=self._LOCAL_SPARSE)
-            entry = [program, True]
+            entry = [program, True, None, self._program_label(key)]
             self._programs[key] = entry
             self.compile_count += 1
             while len(self._programs) > self._CACHE_CAP:
@@ -337,12 +354,148 @@ class PackedWindowEngine:
                 # index n (the pad value) is out of bounds → dropped
                 return resident.at[idx].set(rows, mode="drop")
 
-            entry = [self._jit_scatter(scatter_rows), True]
+            entry = [self._jit_scatter(scatter_rows), True, None,
+                     self._update_label(key)]
             self._updates[key] = entry
             self.compile_count += 1
             while len(self._updates) > self._CACHE_CAP:
                 self._updates.pop(next(iter(self._updates)))
         return entry
+
+    # -- cost introspection ------------------------------------------------
+
+    def _program_label(self, key: tuple) -> str:
+        """Bounded metric label for an attribution-program cache key
+        (cardinality ≤ the cache cap by construction). The shard suffix
+        keeps the sharded rung-0 engine's SPMD programs distinct from
+        the serial demotion engine's: after a demotion both engines hold
+        cost stats, and on a multi-device mesh the two can reach the
+        same bucket key for genuinely different executables."""
+        nb, wb, z, mode, mb = key
+        label = f"prog_n{nb}_w{wb}_z{z}_{mode or 'ratio'}"
+        if mb is not None:
+            label += f"_m{mb}"
+        return label + self._label_suffix()
+
+    def _update_label(self, key: tuple) -> str:
+        n, width, db = key
+        return f"upd_n{n}_x{width}_d{db}" + self._label_suffix()
+
+    def _label_suffix(self) -> str:
+        return f"_s{self.n_shards}" if self.n_shards > 1 else ""
+
+    def _capture_cost(self, entry: list, fn, args: tuple) -> None:
+        """Best-effort XLA ``cost_analysis()``/``memory_analysis()`` for a
+        freshly compiled cache entry, stored as ``entry[2]``.
+
+        Runs once per entry, at its first cold plan: an AOT
+        ``lower(...).compile()`` of the same program (jax's jit cache and
+        the AOT path don't share executables, so this is a second
+        compile — bounded by the cache cap, paid only on cold windows).
+        On CPU hosts the numbers describe the HOST program XLA built
+        (useful for relative comparison, not TPU absolutes —
+        docs/developer/observability.md "Device introspection").
+        Introspection must never break a window: any failure records the
+        error string and the window proceeds."""
+        if entry[2] is not None:
+            return
+        label = entry[3]  # minted with the cache key — never diverges
+        stats: dict = {"label": label}
+        try:
+            from kepler_tpu import telemetry
+
+            # surfaced as window.compile: the call sites sit inside the
+            # caller's window.h2d_delta span, and hundreds of ms of XLA
+            # compile must not read as staging/upload time
+            with telemetry.span("window.compile"):
+                compiled = fn.lower(*args).compile()
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            stats["flops"] = float(cost.get("flops", 0.0))
+            stats["bytes_accessed"] = float(
+                cost.get("bytes accessed", 0.0))
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                arg_b = float(getattr(mem, "argument_size_in_bytes", 0))
+                out_b = float(getattr(mem, "output_size_in_bytes", 0))
+                tmp_b = float(getattr(mem, "temp_size_in_bytes", 0))
+                gen_b = float(getattr(
+                    mem, "generated_code_size_in_bytes", 0))
+                stats["argument_bytes"] = arg_b
+                stats["output_bytes"] = out_b
+                stats["temp_bytes"] = tmp_b
+                stats["generated_code_bytes"] = gen_b
+                stats["device_memory_bytes"] = (arg_b + out_b + tmp_b
+                                                + gen_b)
+        except Exception as err:
+            stats["error"] = f"{type(err).__name__}: {err}"[:160]
+            log.debug("cost analysis unavailable for %s: %s", label, err)
+        entry[2] = stats
+
+    def cost_stats(self) -> dict[str, dict]:
+        """label → captured cost stats for every cached program/update
+        that has them (the compile-cache entries' third slot)."""
+        out: dict[str, dict] = {}
+        for entry in self._programs.values():
+            if entry[2] is not None:
+                out[entry[2]["label"]] = entry[2]
+        for entry in self._updates.values():
+            if entry[2] is not None:
+                out[entry[2]["label"]] = entry[2]
+        return out
+
+    def buffer_staleness(self) -> list[int]:
+        """Windows since each ping-pong ring slot last served (0 = the
+        slot that served the latest window)."""
+        return [self._window_seq - s for s in self._buf_served]
+
+    def shard_occupancy(self) -> list[dict]:
+        """Per-shard resident-row occupancy, split by row mode — the
+        load the sticky assignment exists to balance (one shard's model
+        rows size the whole mesh's sparse estimator bucket)."""
+        out = [{"rows": 0, "model_rows": 0} for _ in range(self.n_shards)]
+        if self._key is None:
+            return out
+        per = self._key[0]  # rows per shard (the whole bucket unsharded)
+        for i in self._row_of.values():
+            k = min(i // per, self.n_shards - 1)
+            out[k]["rows"] += 1
+            if self._mode[i] == MODE_MODEL:
+                out[k]["model_rows"] += 1
+        return out
+
+    def introspect(self) -> dict:
+        """Engine state dump for ``/debug/window`` — everything bounded:
+        ladders are scalars, caches are capped, shards follow the mesh."""
+        programs = [{"key": entry[3],
+                     "cold": bool(entry[1]), "cost": entry[2]}
+                    for entry in self._programs.values()]
+        updates = [{"key": entry[3],
+                    "cold": bool(entry[1]), "cost": entry[2]}
+                   for entry in self._updates.values()]
+        return {
+            "engine": type(self).__name__,
+            "n_shards": self.n_shards,
+            "window_seq": self._window_seq,
+            "buckets": {
+                "node": self._ladder_n.bucket,
+                "node_base": self._ladder_n.base,
+                "workload": self._ladder_w.bucket,
+                "model": self._ladder_m.bucket,
+                "delta": self._ladder_d.bucket,
+            },
+            "resident": {
+                "slots": max(len(self._buffers), len(self._stages)),
+                "current_slot": self._buf_i,
+                "rows": len(self._row_of),
+                "staleness_windows": self.buffer_staleness(),
+            },
+            "shards": self.shard_occupancy(),
+            "programs": programs,
+            "updates": updates,
+            "compile_count": self.compile_count,
+        }
 
     # -- window planning ---------------------------------------------------
 
@@ -351,6 +504,7 @@ class PackedWindowEngine:
         """Sync the resident batch to ``rows`` and return the dispatchable
         plan. The donated update (if any) runs HERE; the caller dispatches
         ``plan.program(*plan.args)`` (timing the compile when ``cold``)."""
+        self._window_seq += 1
         zones_t = tuple(zone_names)
         z = len(zones_t)
         need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
@@ -376,6 +530,7 @@ class PackedWindowEngine:
             # scatter neither blocks nor aliases live reads
             self._buf_i = (self._buf_i + 1) % len(self._buffers)
             h2d_rows = self._delta_sync(rows, zones_t)
+        self._buf_served[self._buf_i] = self._window_seq
         meta = WindowMeta(
             zones=list(zones_t),
             names=[r.name for r in rows],
@@ -402,7 +557,9 @@ class PackedWindowEngine:
         else:
             args = (params, resident)
         entry = self._program_for(nb, wb, z, mb)
-        program, cold = entry
+        program, cold = entry[0], entry[1]
+        if cold:
+            self._capture_cost(entry, program, args)
         entry[1] = False
         return WindowPlan(program=program, args=args, cold=cold, meta=meta,
                           h2d_rows=h2d_rows, h2d_shards=(h2d_rows,),
@@ -437,6 +594,8 @@ class PackedWindowEngine:
         self._free = []
         self._stage_i = 0
         self._stages = [np.zeros((0, 0), np.float32) for _ in self._stages]
+        self._buf_served = []  # _window_seq survives: staleness restarts
+        # at zero when the next plan's rebuild re-seeds the ring
 
     # -- resident maintenance ----------------------------------------------
 
@@ -467,6 +626,7 @@ class PackedWindowEngine:
                   + [_EMPTY] * (nb - n_real))
         self._content = [list(idents) for _ in self._buffers]
         self._buf_i = 0
+        self._buf_served = [self._window_seq] * len(self._buffers)
         self._key = (nb, wb, zones_t)
         self._names = [r.name for r in ordered] + [None] * (nb - n_real)
         self._row_of = {r.name: i for i, r in enumerate(ordered)}
@@ -574,6 +734,8 @@ class PackedWindowEngine:
         rows_dev = jax.device_put(stage, self._sh_repl)
         idx_dev = jax.device_put(idx, self._sh_repl)
         if update_cold:
+            self._capture_cost(entry, update,
+                               (resident, rows_dev, idx_dev))
             # a new (n, width, delta-bucket) scatter-update key: the call
             # blocks on trace+compile — surface it as window.compile
             # (nested inside the caller's window.h2d_delta span)
@@ -683,10 +845,23 @@ class ShardedWindowEngine(PackedWindowEngine):
         entry serves every shard (jax re-specializes per device)."""
         return self._jax.jit(scatter_rows, donate_argnums=(0,))
 
+    # -- introspection -----------------------------------------------------
+
+    def introspect(self) -> dict:
+        out = super().introspect()
+        out["sticky"] = {
+            "assigned": len(self._shard_of),
+            "free_rows": [len(f) for f in self._free_by_shard],
+        }
+        out["buckets"]["delta_shards"] = [lad.bucket
+                                          for lad in self._ladder_ds]
+        return out
+
     # -- window planning ---------------------------------------------------
 
     def plan_window(self, rows: Sequence[RowInput],
                     zone_names: Sequence[str], params: Any) -> WindowPlan:
+        self._window_seq += 1
         zones_t = tuple(zone_names)
         z = len(zones_t)
         k_sh = self.n_shards
@@ -758,6 +933,7 @@ class ShardedWindowEngine(PackedWindowEngine):
         else:
             self._buf_i = (self._buf_i + 1) % len(self._buffers)
             h2d_shards = self._delta_sync_shards(rows, zones_t)
+        self._buf_served[self._buf_i] = self._window_seq
         nb = k_sh * sb
         meta = WindowMeta(
             zones=list(zones_t),
@@ -794,7 +970,9 @@ class ShardedWindowEngine(PackedWindowEngine):
         else:
             args = (params, resident)
         entry = self._program_for(nb, wb, z, mb)
-        program, cold = entry
+        program, cold = entry[0], entry[1]
+        if cold:
+            self._capture_cost(entry, program, args)
         entry[1] = False
         return WindowPlan(program=program, args=args, cold=cold, meta=meta,
                           h2d_rows=sum(h2d_shards),
@@ -877,6 +1055,7 @@ class ShardedWindowEngine(PackedWindowEngine):
                          for _ in range(k_sh)]
                         for _ in range(self._n_slots)]
         self._buf_i = 0
+        self._buf_served = [self._window_seq] * self._n_slots
         self._stage_i = 0
         self._key = (sb, wb, zones_t)
         self._width = width
@@ -945,7 +1124,13 @@ class ShardedWindowEngine(PackedWindowEngine):
             h2d_shards[k] = n_stage
             if n_stage == 0:
                 continue
-            with telemetry.span(f"window.h2d_delta.s{k}"):
+            # the span NAME keeps the shard id (trace readability); the
+            # histogram observes one shared per-shard stage — stage-label
+            # cardinality stays independent of mesh size (the outer
+            # window.h2d_delta span in the aggregator keeps measuring the
+            # whole-window staging total)
+            with telemetry.span(f"window.h2d_delta.s{k}",
+                                stage="window.h2d_delta.shard"):
                 db = min(self._ladder_ds[k].fit(n_stage), sb)
                 if stage_slot[k].shape != (db, width):
                     stage_slot[k] = np.zeros((db, width), np.float32)
@@ -971,6 +1156,8 @@ class ShardedWindowEngine(PackedWindowEngine):
                 # store back immediately (KTL110 tracks `resident`)
                 resident = self._buffers[self._buf_i][k]
                 if update_cold:
+                    self._capture_cost(entry, update,
+                                       (resident, rows_dev, idx_dev))
                     with telemetry.span("window.compile"):
                         resident = update(resident, rows_dev, idx_dev)
                 else:

@@ -73,6 +73,15 @@ class DebugService:
             '<li><a href="/debug/pprof/stack">stack</a></li>'
             '<li><a href="/debug/pprof/profile?seconds=5">profile</a></li>'
             '<li><a href="/debug/pprof/jax">jax trace</a></li>'
+            "</ul>"
+            "<h2>other debug surfaces</h2><ul>"
+            '<li><a href="/debug/traces">traces</a> — recent cycle span '
+            "traces (?format=chrome loads in Perfetto)</li>"
+            '<li><a href="/debug/window">window</a> — device-plane '
+            "introspection: rung + timeline, shards, compile-cache cost "
+            "stats (aggregator role)</li>"
+            '<li><a href="/debug/fleet">fleet</a> — per-node scoreboard '
+            "(aggregator role)</li>"
             "</ul></body></html>"
         ).encode()
         return 200, {"Content-Type": "text/html"}, body

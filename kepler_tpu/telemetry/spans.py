@@ -122,6 +122,11 @@ class SpanEvent:
     depth: int  # 0 = the cycle itself
     rel_start_s: float  # seconds after cycle start (monotonic)
     duration_s: float
+    # histogram stage key: None = use `name`; "" = trace-only (the span
+    # shows in /debug/traces but observes no stage histogram). Keeps
+    # per-instance span names (window.h2d_delta.s<k>) from minting one
+    # kepler_self_stage_duration_seconds series per shard/index.
+    stage: str | None = None
 
 
 @dataclass(frozen=True)
@@ -146,7 +151,8 @@ class CycleTrace:
             "spans": [
                 {"name": e.name, "depth": e.depth,
                  "rel_start_s": e.rel_start_s,
-                 "duration_s": e.duration_s}
+                 "duration_s": e.duration_s,
+                 **({"stage": e.stage} if e.stage is not None else {})}
                 for e in self.events
             ],
         }
@@ -175,14 +181,17 @@ class _Span:
     """Live span handle (enabled path). Re-entrant use of one handle is
     not supported — ``span()`` returns a fresh handle per with-block."""
 
-    __slots__ = ("_rec", "_st", "_name", "_budget", "_t0", "_depth")
+    __slots__ = ("_rec", "_st", "_name", "_budget", "_t0", "_depth",
+                 "_stage")
 
     def __init__(self, rec: "SpanRecorder", st: _ThreadState, name: str,
-                 budget_s: float | None) -> None:
+                 budget_s: float | None,
+                 stage: str | None = None) -> None:
         self._rec = rec
         self._st = st
         self._name = name
         self._budget = budget_s
+        self._stage = stage
 
     def __enter__(self) -> "_Span":
         st = self._st
@@ -203,7 +212,8 @@ class _Span:
         st.events.append(SpanEvent(
             name=self._name, depth=self._depth,
             rel_start_s=self._t0 - st.mono_anchor,
-            duration_s=max(0.0, t1 - self._t0)))
+            duration_s=max(0.0, t1 - self._t0),
+            stage=self._stage))
         if not st.stack:
             self._rec._complete_cycle(st, self._budget)
 
@@ -268,13 +278,16 @@ class SpanRecorder:
 
     # -- span API ------------------------------------------------------------
 
-    def span(self, name: str, budget_s: float | None = None):
+    def span(self, name: str, budget_s: float | None = None,
+             stage: str | None = None):
         """Context manager timing one stage. ``budget_s`` is meaningful
         on the OUTERMOST span of a cycle: exceeding it counts one
-        ``kepler_self_cycle_overrun_total{cycle=name}``."""
+        ``kepler_self_cycle_overrun_total{cycle=name}``. ``stage``
+        overrides the histogram key (``""`` = trace-only) — see
+        :class:`SpanEvent`."""
         if not self._enabled:
             return _NOOP
-        return _Span(self, self._state(), name, budget_s)
+        return _Span(self, self._state(), name, budget_s, stage)
 
     def _state(self) -> _ThreadState:
         st = getattr(self._tls, "state", None)
@@ -308,9 +321,12 @@ class SpanRecorder:
         with self._lock:
             self._cycles += 1
             for ev in events:
-                hist = self._hist.get(ev.name)
+                key = ev.name if ev.stage is None else ev.stage
+                if not key:
+                    continue  # trace-only span (stage="")
+                hist = self._hist.get(key)
                 if hist is None:
-                    hist = self._hist[ev.name] = Histogram(
+                    hist = self._hist[key] = Histogram(
                         self._stage_buckets)
                 hist.observe(ev.duration_s)
             if overrun:
@@ -452,13 +468,16 @@ def install(rec: SpanRecorder) -> SpanRecorder:
     return rec
 
 
-def span(name: str, budget_s: float | None = None):
+def span(name: str, budget_s: float | None = None,
+         stage: str | None = None):
     """The instrumentation point. Disabled cost: one global read, one
-    attribute check, a shared no-op context manager."""
+    attribute check, a shared no-op context manager. ``stage``
+    re-keys the stage histogram (``""`` = trace-only), so per-instance
+    span names never mint per-instance metric series."""
     rec = _active
     if not rec._enabled:
         return _NOOP
-    return rec.span(name, budget_s)
+    return rec.span(name, budget_s, stage)
 
 
 def inflight() -> list[dict]:

@@ -395,6 +395,13 @@ FIELD_MATRIX = [
               "aggregator: {meshShape: [8]}", [8]),
     FieldCase("aggregator.mesh_axes",
               "aggregator: {meshAxes: [node, model]}", ["node", "model"]),
+    # fleet scoreboard (ISSUE 8)
+    FieldCase("aggregator.scoreboard_cap",
+              "aggregator: {scoreboardCap: 256}", 256,
+              ["--aggregator.scoreboard-cap", "64"], 64),
+    FieldCase("aggregator.anomaly_z",
+              "aggregator: {anomalyZ: 2.5}", 2.5,
+              ["--aggregator.anomaly-z", "6"], 6.0),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -516,6 +523,8 @@ class TestYAMLSpellings:
         "fallbackEnabled": "aggregator",
         "repromoteAfter": "aggregator",
         "dispatchTimeout": "aggregator",
+        "scoreboardCap": "aggregator",
+        "anomalyZ": "aggregator",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
@@ -568,6 +577,8 @@ class TestYAMLSpellings:
         "fallbackEnabled": ("false", False),
         "repromoteAfter": ("4", 4),
         "dispatchTimeout": ("15s", 15.0),
+        "scoreboardCap": ("128", 128),
+        "anomalyZ": ("2.5", 2.5),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),
@@ -673,6 +684,12 @@ class TestValidationMatrix:
         ("aggregator.meshShape.rank",
          lambda c: setattr(c.aggregator, "mesh_shape", [4, 2]),
          "same rank"),
+        ("aggregator.scoreboardCap",
+         lambda c: setattr(c.aggregator, "scoreboard_cap", 0),
+         "scoreboardCap"),
+        ("aggregator.anomalyZ",
+         lambda c: setattr(c.aggregator, "anomaly_z", -1.0),
+         "anomalyZ"),
         ("fault.specs",
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),

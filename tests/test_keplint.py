@@ -394,8 +394,27 @@ class TestMetricNameRule:
                 # f-string with a literal, checkable unit tail
                 yield CounterMetricFamily(
                     f"kepler_{kind}_cpu_joules_total", "d")
+                # introspection-plane tokens (flops/state/windows)
+                yield GaugeMetricFamily(
+                    "kepler_fleet_window_program_flops", "d")
+                yield GaugeMetricFamily("kepler_fleet_node_state", "d")
+                yield GaugeMetricFamily(
+                    "kepler_fleet_window_buffer_staleness_windows", "d")
         """)
         assert diags == []
+
+    def test_bad_bare_skew_lacks_unit_token(self, lint):
+        """The skew gauge must name its unit (`_skew_ratio`), not end on
+        the bare adjective — `skew` is deliberately NOT a token."""
+        diags = lint("""
+            from prometheus_client.core import GaugeMetricFamily
+
+            def collect():
+                return GaugeMetricFamily(
+                    "kepler_fleet_window_shard_skew", "d")
+        """)
+        assert ids(diags) == ["KTL105"]
+        assert "unit suffix" in diags[0].message
 
     def test_non_kepler_names_out_of_scope(self, lint):
         diags = lint("""
