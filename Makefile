@@ -80,8 +80,8 @@ native-asan: ## AddressSanitizer pass over the native scanner/renderer
 # See docs/developer/static-analysis.md.
 .PHONY: lint
 lint:
-	$(PYTHON) -m compileall -q kepler_tpu tests hack
-	$(PYTHON) -m kepler_tpu.analysis kepler_tpu
+	$(PYTHON) -m compileall -q kepler_tpu tests hack benchmarks
+	$(PYTHON) -m kepler_tpu.analysis kepler_tpu hack benchmarks
 	$(PYTHON) hack/gen_lint_docs.py --check
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check kepler_tpu tests hack; \
@@ -96,7 +96,11 @@ lint:
 
 .PHONY: keplint
 keplint: ## project-native AST invariant checks only
-	$(PYTHON) -m kepler_tpu.analysis kepler_tpu
+	$(PYTHON) -m kepler_tpu.analysis kepler_tpu hack benchmarks
+
+.PHONY: keplint-sarif
+keplint-sarif: ## keplint findings as SARIF 2.1.0 (CI annotation feed; stdout is pipeable JSON)
+	@$(PYTHON) -m kepler_tpu.analysis --format=sarif kepler_tpu hack benchmarks
 
 .PHONY: keplint-baseline
 keplint-baseline: ## refreeze the keplint baseline (after fixing findings)

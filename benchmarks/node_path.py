@@ -25,6 +25,8 @@ line.
 
 from __future__ import annotations
 
+# keplint: monotonic-only — scrape/render timings use perf_counter only
+
 import argparse
 import json
 import os

@@ -35,6 +35,9 @@ of allocating per window.
 
 from __future__ import annotations
 
+# keplint: monotonic-only — soak durations/ramp deadlines are elapsed
+# time; an NTP step mid-soak must not corrupt the gated numbers
+
 import argparse
 import http.client
 import json
@@ -149,7 +152,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
 
     del rng  # each agent thread builds its own generator
     rss_boot = rss_mib()
-    t_start = time.time()
+    t_start = time.monotonic()
     agents = [threading.Thread(target=agent, args=(i,), daemon=True)
               for i in range(n_agents)]
     for t in agents:
@@ -159,21 +162,20 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     # memory and GIL stalls are one-time), so the steady-state baselines
     # — RSS and ingest-latency alike — measure the SERVICE, not startup.
     # The plateau is still reported, as soak_rss_ramp_mib.
-    ramp_deadline = time.time() + min(4 * interval, seconds)
-    while time.time() < ramp_deadline:
+    ramp_deadline = time.monotonic() + min(4 * interval, seconds)
+    while time.monotonic() < ramp_deadline:
         if (agg._stats["attributions_total"] >= 2
-                and time.time() - t_start >= interval):
+                and time.monotonic() - t_start >= interval):
             break
         time.sleep(0.25)
     time.sleep(1.0)  # let compile-peak allocations settle before baselining
     rss_start = rss_mib()
     steady_mono = time.monotonic()
-    t_steady = time.time()
-    time.sleep(max(1.0, seconds - (t_steady - t_start)))
+    time.sleep(max(1.0, seconds - (steady_mono - t_start)))
     stop.set()
     for t in agents:
         t.join(timeout=10)
-    duration = time.time() - t_start
+    duration = time.monotonic() - t_start
     stats = dict(agg._stats)
     ctx.cancel()
     server.shutdown()

@@ -16,6 +16,8 @@ Two rules learned on tunnelled dev chips:
 
 from __future__ import annotations
 
+# keplint: monotonic-only — bench timings use perf_counter only
+
 import math
 import time
 

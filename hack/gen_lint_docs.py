@@ -34,8 +34,9 @@ checks freshness with `--check`).
 The attribution formula is only correct while a handful of code-level
 invariants hold *everywhere*: counter deltas must be wrap-aware, timing
 logic must use monotonic clocks, published snapshots must stay
-immutable, jitted kernels must stay pure. Generic linters cannot see
-those — they are domain invariants — so `keplint`
+immutable, jitted kernels must stay pure, lock and input-hygiene
+contracts must survive helper-function hops. Generic linters cannot
+see those — they are domain invariants — so `keplint`
 (`kepler_tpu/analysis/`) encodes each one as an AST check. `make lint`
 runs keplint, ruff (config committed in `pyproject.toml`), and mypy
 (per-module strictness ratchet, also in `pyproject.toml`).
@@ -43,13 +44,53 @@ runs keplint, ruff (config committed in `pyproject.toml`), and mypy
 ## Running
 
 ```
-python -m kepler_tpu.analysis              # lint kepler_tpu/ (repo root)
+python -m kepler_tpu.analysis              # lint kepler_tpu/, hack/, benchmarks/
 python -m kepler_tpu.analysis path/ file.py
 python -m kepler_tpu.analysis --list-rules
+python -m kepler_tpu.analysis --format=sarif   # SARIF 2.1.0 (make keplint-sarif)
+python -m kepler_tpu.analysis --per-file       # disable cross-module analysis
 ```
 
 Exit codes: `0` clean (baselined findings tolerated), `1` new
-violations, `2` usage errors.
+violations, `2` usage errors. `--format=json|sarif` emits
+machine-readable reports (SARIF 2.1.0 minimal profile, consumable as
+CI annotations).
+
+## Whole-program analysis
+
+KTL101-110 run per file. KTL111-113 run once per lint over a
+`ProjectContext` (`kepler_tpu/analysis/project.py`): every file is
+parsed **once** per run and shared by all rules, then a module-level
+symbol table, light type inference (constructor assignments, parameter
+annotations), and a **call graph** link resolved call sites across
+modules. On top of the graph:
+
+- **Thread roles** propagate from declared roots along call edges:
+  `# keplint: thread-role=<role>` on a `def` or `class` names a root
+  (agent thread, `_FetchWorker`, shutdown paths, HTTP handlers); the
+  `hot-loop` marker roots the `hot-loop` role; and callables passed to
+  a `# keplint: role-registrar=<role>` function (`APIServer.register`)
+  become roots of that role. Propagation stops at `# keplint:
+  role-boundary` seams — the meter/informer/persistence functions that
+  do I/O *by design* and keep their own contracts.
+- **Lock summaries** record which locks each function acquires
+  (directly and through its call closure), feeding the KTL111
+  lock-order graph; lock identity is hoisted to the class that
+  constructs the lock, so cross-module acquisitions alias correctly.
+- **Taint** (KTL112) flows from sources (`# keplint: taint-source`
+  functions like `peek_node_name`; `.headers`/`.path`/`.body` reads in
+  `http-handler`-role functions) through assignments and resolved call
+  edges until a sanitizer launders it: a function marked `# keplint:
+  sanitizes` (the registry: `wire.sanitize_node_name`,
+  `wire.decode_report`, `server.http.printable`) or a built-in
+  coercion (`int`, `float`, …). Sinks: Prometheus label values, keys
+  of object-attached stores, sequence indexes, log-call arguments, and
+  `# keplint: taint-sink` functions.
+
+`--per-file` restricts KTL111-113 to one-file contexts (no cross-module
+call graph) — useful for bisecting which findings are genuinely
+interprocedural; the test suite uses it to prove the call graph is
+load-bearing.
 
 ## Suppressing
 
@@ -58,8 +99,10 @@ a comment line directly above); several ids separate with commas, and a
 bare `disable` suppresses every rule on that line. `# keplint:
 disable-file=KTL1xx` anywhere in the file suppresses a rule file-wide.
 Every suppression should say *why* in the surrounding comment.
+Suppression applies to whole-program rules too: the directive lives in
+the file where the diagnostic lands.
 
-## Scoping markers
+## Annotation vocabulary
 
 Rules that need to know which code is special read declarative markers
 instead of hardcoding module lists:
@@ -67,9 +110,18 @@ instead of hardcoding module lists:
 | Marker | Meaning |
 | --- | --- |
 | `# keplint: monotonic-only` (file-level) | KTL101: this module's timing math must never call the wall clock directly |
-| `# keplint: hot-loop` (above a `def`) | KTL106: this function runs on the monitor refresh path; no sleeps/blocking I/O |
-| `# keplint: guarded-by=_lock` (on an attribute assignment in `__init__`) | KTL108: writes to this attribute require `with self._lock` |
-| `# keplint: requires-lock=_lock` (above a `def`) | KTL108: this function may only be called with the lock held; callers are checked too |
+| `# keplint: hot-loop` (above a `def`) | KTL106/KTL113: this function runs on the monitor refresh path; no sleeps/blocking I/O, lexically or via any call chain |
+| `# keplint: guarded-by=_lock` (on an attribute assignment in `__init__`) | KTL108/KTL111: writes to this attribute require `with self._lock` (KTL111 checks writers in other classes/modules too) |
+| `# keplint: requires-lock=_lock` (above a `def`) | KTL108/KTL111: this function may only be called with the lock held; callers are checked, cross-module included |
+| `# keplint: donates=<positions>` (on a callable binding) | KTL110: calls through this binding consume the arguments at those positions |
+| `# keplint: thread-role=<role>` (above a `def` or `class`) | KTL113: roots the thread role here; it propagates to everything reachable |
+| `# keplint: role-registrar=<role>` (above a `def`) | KTL113: callables passed to this function become roots of `<role>` |
+| `# keplint: role-boundary` (above a `def`) | KTL113: role propagation stops here — the seam keeps its own contract |
+| `# keplint: forbid-role=<role>` (above a `class`) | KTL113: functions running under `<role>` may not call this class's methods |
+| `# keplint: allow-role=<role>` (above a `def`) | KTL113: sanctioned exception to the enclosing class's `forbid-role` |
+| `# keplint: taint-source` (above a `def`) | KTL112: this function's return value is untrusted input |
+| `# keplint: sanitizes` (above a `def`) | KTL112: passing a value through this function launders its taint |
+| `# keplint: taint-sink[=label]` (above a `def`) | KTL112: tainted arguments to this function are findings |
 
 ## Baseline ratchet
 
@@ -78,23 +130,31 @@ per `path::rule`. New violations fail; baselined ones pass; *fixed*
 ones surface as stale entries — regenerate with
 `python -m kepler_tpu.analysis --write-baseline` to ratchet the ceiling
 down. The committed baseline is **empty**: every finding in the shipped
-tree was fixed, not grandfathered (`tests/test_keplint.py` pins this).
+tree was fixed, not grandfathered (`tests/test_keplint.py` pins this —
+including for the whole-program rules).
 
 The same ratchet stance applies to typing: `pyproject.toml` declares a
 strict mypy tier (`config/`, `monitor/snapshot`, `fleet/wire`,
-`fault/`, `analysis/` — fully typed, `disallow_untyped_defs`) and a
-checked tier (`monitor/`, `fleet/`, `service/` —
-`check_untyped_defs`); modules move *up* tiers, never down.
+`fleet/window`, `fleet/scoreboard`, `fleet/aggregator`, `fault/`,
+`analysis/` — fully typed, `disallow_untyped_defs`) and a checked tier
+(`monitor/`, `fleet/`, `service/` — `check_untyped_defs`); modules
+move *up* tiers, never down.
 
 ## Extending
 
-Subclass `kepler_tpu.analysis.Rule`, set `id`/`name`/`severity`/
-`summary`/`rationale`, implement `check(ctx)` over `ctx.tree`
-(a parsed `ast.Module`), and decorate with `@register` in
-`kepler_tpu/analysis/rules.py`. Add a good/bad fixture pair to
-`tests/test_keplint.py` and regenerate this doc. Engine internals
-(directives, baselines, file walking) live in
-`kepler_tpu/analysis/engine.py`.
+Per-file rules subclass `kepler_tpu.analysis.Rule` and implement
+`check(ctx)` over the shared `FileContext` (use `ctx.walk_nodes`, the
+once-per-run node list, instead of re-walking `ctx.tree`).
+Whole-program rules subclass `ProjectRule` and implement
+`check_project(project)` over the `ProjectContext` (symbol table, call
+graph, roles, lock summaries). Either way: set `id`/`name`/`severity`/
+`summary`/`rationale` (and `tree_scope` if the rule polices `hack/` or
+`benchmarks/` too), decorate with `@register` in the matching module
+under `kepler_tpu/analysis/rules/`, add a good/bad fixture pair to
+`tests/test_keplint.py` (cross-module fixtures for project rules), and
+regenerate this doc. Engine internals (directives, baselines, file
+walking, SARIF) live in `kepler_tpu/analysis/engine.py` and
+`__main__.py`.
 
 ## Rule catalog
 """
@@ -106,12 +166,17 @@ def render() -> str:
     if missing:
         raise SystemExit(
             f"gen_lint_docs: rules missing summary/rationale: {missing}")
+    from kepler_tpu.analysis import ProjectRule
+
     lines = [PREAMBLE]
-    lines.append("| Rule | Name | Severity | Invariant |")
-    lines.append("| --- | --- | --- | --- |")
+    lines.append("| Rule | Name | Tier | Scope | Severity | Invariant |")
+    lines.append("| --- | --- | --- | --- | --- | --- |")
     for r in rules:
-        lines.append(f"| `{r.id}` | {r.name} | {r.severity} | "
-                     f"{r.summary} |")
+        tier = ("whole-program" if isinstance(r, ProjectRule)
+                else "per-file")
+        scope = ", ".join(f"`{t}/`" for t in r.tree_scope)
+        lines.append(f"| `{r.id}` | {r.name} | {tier} | {scope} | "
+                     f"{r.severity} | {r.summary} |")
     lines.append("")
     for r in rules:
         lines.append(f"### {r.id} — {r.name}")

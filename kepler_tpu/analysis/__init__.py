@@ -12,6 +12,7 @@ from kepler_tpu.analysis.engine import (
     Diagnostic,
     FileContext,
     LintResult,
+    ProjectRule,
     REGISTRY,
     Rule,
     all_rules,
@@ -26,6 +27,7 @@ __all__ = [
     "Diagnostic",
     "FileContext",
     "LintResult",
+    "ProjectRule",
     "REGISTRY",
     "Rule",
     "all_rules",

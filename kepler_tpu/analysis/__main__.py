@@ -4,24 +4,40 @@ Exit codes: 0 clean (baselined violations and stale-baseline notices do
 not fail), 1 new error-severity findings, 2 usage errors. The default
 baseline is ``.keplint.json`` at the repo root (the directory holding
 pyproject.toml, walked up from the first path).
+
+``--format`` selects the report shape: ``text`` (default, one line per
+finding), ``json`` (machine-readable summary), or ``sarif`` (SARIF
+2.1.0 minimal profile, consumable as CI annotations — see ``make
+keplint-sarif``). ``--per-file`` restricts the whole-program rules
+(KTL111-113) to single-file contexts: cross-module findings disappear,
+which is useful for bisecting whether a finding needs the call graph.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import Sequence
 
 from kepler_tpu.analysis.engine import (
     Baseline,
+    Diagnostic,
     LintResult,
+    SEVERITY_ERROR,
     all_rules,
     find_repo_root,
     lint_paths,
 )
 
 BASELINE_NAME = ".keplint.json"
+# default lint surface: the package plus the tooling/bench trees that
+# the widened-scope rules (KTL101/KTL105) police
+DEFAULT_TREES = ("kepler_tpu", "hack", "benchmarks")
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -30,8 +46,9 @@ def main(argv: Sequence[str] | None = None) -> int:
         description="keplint: AST invariant checks for the attribution "
                     "stack (see docs/developer/static-analysis.md)")
     parser.add_argument("paths", nargs="*", default=None,
-                        help="files/directories to lint "
-                             "(default: kepler_tpu under the repo root)")
+                        help="files/directories to lint (default: "
+                             "kepler_tpu, hack, benchmarks under the "
+                             "repo root)")
     parser.add_argument("--baseline", default=None,
                         help=f"baseline file (default: <root>/"
                              f"{BASELINE_NAME} when present)")
@@ -42,6 +59,13 @@ def main(argv: Sequence[str] | None = None) -> int:
                              "baseline and exit 0")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="report format (default: text)")
+    parser.add_argument("--per-file", action="store_true",
+                        help="restrict whole-program rules (KTL111-113) "
+                             "to single-file contexts — no cross-module "
+                             "call graph")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -51,7 +75,11 @@ def main(argv: Sequence[str] | None = None) -> int:
         return 0
 
     root = find_repo_root(args.paths[0] if args.paths else os.getcwd())
-    paths = args.paths or [os.path.join(root, "kepler_tpu")]
+    if args.paths:
+        paths = args.paths
+    else:
+        paths = [os.path.join(root, tree) for tree in DEFAULT_TREES
+                 if os.path.isdir(os.path.join(root, tree))]
     for path in paths:
         if not os.path.exists(path):
             print(f"keplint: no such path: {path}", file=sys.stderr)
@@ -69,13 +97,20 @@ def main(argv: Sequence[str] | None = None) -> int:
                 return 2
 
     if args.write_baseline:
-        full = lint_paths(paths, root=root)
+        full = lint_paths(paths, root=root, per_file=args.per_file)
         Baseline.from_diagnostics(full.diagnostics).save(baseline_path)
         print(f"keplint: wrote {baseline_path} "
               f"({len(full.diagnostics)} frozen violation(s))")
         return 0
 
-    result: LintResult = lint_paths(paths, root=root, baseline=baseline)
+    result: LintResult = lint_paths(paths, root=root, baseline=baseline,
+                                    per_file=args.per_file)
+    if args.format == "sarif":
+        print(json.dumps(render_sarif(result), indent=2))
+        return 1 if result.failed else 0
+    if args.format == "json":
+        print(json.dumps(render_json(result), indent=2))
+        return 1 if result.failed else 0
     return report(result)
 
 
@@ -98,6 +133,71 @@ def report(result: LintResult) -> int:
              if result.baselined else "")
     print(f"keplint: clean{extra}")
     return 0
+
+
+def render_json(result: LintResult) -> dict:
+    return {
+        "violations": [
+            {"path": d.path, "line": d.line, "col": d.col,
+             "rule": d.rule_id, "severity": d.severity,
+             "message": d.message}
+            for d in result.diagnostics],
+        "baselined": result.baselined,
+        "stale_baseline_entries": list(result.stale_entries),
+        "failed": result.failed,
+    }
+
+
+def render_sarif(result: LintResult) -> dict:
+    """SARIF 2.1.0 minimal profile: one run, the rule catalog as
+    reportingDescriptors, one result per diagnostic with a physical
+    location (CI annotation shape)."""
+    rules = all_rules()
+    rule_index = {r.id: i for i, r in enumerate(rules)}
+    results = []
+    for d in result.diagnostics:
+        results.append({
+            "ruleId": d.rule_id,
+            "ruleIndex": rule_index.get(d.rule_id, -1),
+            "level": ("error" if d.severity == SEVERITY_ERROR
+                      else "warning"),
+            "message": {"text": d.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": d.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": d.line,
+                        "startColumn": d.col,
+                    },
+                },
+            }],
+        })
+    driver = {
+        "name": "keplint",
+        "informationUri": ("https://github.com/sustainable-computing-io/"
+                           "kepler"),
+        "rules": [{
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.summary},
+            "fullDescription": {"text": r.rationale},
+            "defaultConfiguration": {
+                "level": ("error" if r.severity == SEVERITY_ERROR
+                          else "warning"),
+            },
+        } for r in rules],
+    }
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": driver},
+            "results": results,
+        }],
+    }
 
 
 if __name__ == "__main__":

@@ -13,7 +13,7 @@ module is a small, self-contained AST lint engine that can:
   disable-file=KTL101`` anywhere in the file);
 - carry per-file/per-function *markers* that scope rules declaratively
   (``# keplint: monotonic-only``, ``# keplint: hot-loop``,
-  ``# keplint: guarded-by=_lock`` — see ``rules.py``);
+  ``# keplint: guarded-by=_lock`` — see the ``rules/`` package);
 - freeze existing violations in a committed baseline so new ones fail
   while old ones ratchet down (:class:`Baseline`), mirroring the
   strict-typing ratchet in ``pyproject.toml``.
@@ -38,8 +38,10 @@ __all__ = [
     "Diagnostic",
     "FileContext",
     "LintResult",
+    "ProjectRule",
     "REGISTRY",
     "Rule",
+    "build_file_context",
     "find_repo_root",
     "lint_paths",
     "register",
@@ -48,12 +50,20 @@ __all__ = [
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
 
+# the trees whose membership means anything for rule scoping; paths
+# outside them are always fully linted (see Rule.in_scope)
+SCOPED_TREES = ("kepler_tpu", "hack", "benchmarks")
+
 # one directive grammar for suppressions AND rule markers; parsed once per
-# file so rules never re-scan source text
+# file so rules never re-scan source text.  The whole-program vocabulary
+# (thread-role, taint-*, sanitizes, …) is consumed by analysis/project.py
+# and the KTL111-113 rule family.
 _DIRECTIVE = re.compile(
     r"#\s*keplint:\s*"
     r"(?P<kind>disable-file|disable|monotonic-only|hot-loop|"
-    r"guarded-by|requires-lock|donates)"
+    r"guarded-by|requires-lock|donates|"
+    r"thread-role|role-boundary|role-registrar|forbid-role|allow-role|"
+    r"taint-source|taint-sink|sanitizes)"
     r"(?:=(?P<arg>[A-Za-z0-9_,\- ]+))?")
 
 
@@ -93,6 +103,7 @@ class FileContext:
         self.root = os.path.abspath(root) if root else os.path.dirname(
             self.path)
         self.lines: list[str] = source.splitlines()
+        self._walk_nodes: list[ast.AST] | None = None
         # line (1-based) → [(kind, arg-or-None)]; directives come from
         # real COMMENT tokens only, so a docstring QUOTING a directive
         # (this one included) never arms or disarms anything
@@ -106,6 +117,15 @@ class FileContext:
                 self.directives.setdefault(lineno, []).append((kind, arg))
                 if kind in ("disable-file", "monotonic-only"):
                     self.file_directives.add((kind, arg))
+
+    @property
+    def walk_nodes(self) -> list[ast.AST]:
+        """Every AST node of the file, in ``ast.walk`` order, computed
+        once and shared by all rules — the tree is walked once per RUN,
+        not once per rule (the dominant cost of the old engine)."""
+        if self._walk_nodes is None:
+            self._walk_nodes = list(ast.walk(self.tree))
+        return self._walk_nodes
 
     # -- marker helpers (rules call these) ---------------------------------
 
@@ -200,8 +220,37 @@ class Rule:
     severity: str = SEVERITY_ERROR
     summary: str = ""
     rationale: str = ""
+    # top-level tree segments this rule runs over, relative to the lint
+    # root.  The attribution invariants live in the package; rules that
+    # also police tooling/bench code widen this deliberately (ISSUE 9:
+    # KTL101/KTL105 extend to hack/ and benchmarks/).
+    tree_scope: tuple[str, ...] = ("kepler_tpu",)
 
     def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+    def in_scope(self, rel_path: str) -> bool:
+        head = rel_path.split("/", 1)[0]
+        # scoping only partitions the KNOWN trees (hack/ and benchmarks/
+        # get a curated rule subset); a path outside all of them — an
+        # explicitly linted test file, a scratch script — gets every
+        # rule, matching the pre-scoping behavior (a silent all-clear on
+        # an explicit path would be a false negative)
+        if head not in SCOPED_TREES:
+            return True
+        return head in self.tree_scope
+
+
+class ProjectRule(Rule):
+    """A rule that needs the whole program: runs once per lint over a
+    :class:`~kepler_tpu.analysis.project.ProjectContext` (shared ASTs,
+    symbol table, call graph, thread roles) instead of once per file.
+    Per-file suppression directives still apply to its diagnostics."""
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        return ()
+
+    def check_project(self, project: "object") -> Iterable[Diagnostic]:
         raise NotImplementedError
 
 
@@ -282,38 +331,98 @@ def find_repo_root(start: str) -> str:
         cur = parent
 
 
-def lint_file(path: str, root: str,
-              rules: Sequence[Rule] | None = None) -> list[Diagnostic]:
-    """All non-suppressed diagnostics for one file (no baseline)."""
-    rules = list(rules) if rules is not None else all_rules()
+def build_file_context(path: str, root: str) -> "FileContext | Diagnostic":
+    """Parse one file into a :class:`FileContext` — the single parse every
+    rule (per-file and whole-program) shares for the rest of the run.
+    Returns a KTL000 :class:`Diagnostic` when the file cannot be parsed."""
     rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
     try:
         with open(path, encoding="utf-8") as f:
             source = f.read()
         tree = ast.parse(source, filename=path)
     except (OSError, SyntaxError, ValueError) as err:
-        return [Diagnostic(path=rel, line=getattr(err, "lineno", 1) or 1,
-                           col=1, rule_id="KTL000",
-                           severity=SEVERITY_ERROR,
-                           message=f"cannot parse: {err}")]
-    ctx = FileContext(path=path, rel_path=rel, source=source, tree=tree,
-                      root=root)
+        return Diagnostic(path=rel, line=getattr(err, "lineno", 1) or 1,
+                          col=1, rule_id="KTL000",
+                          severity=SEVERITY_ERROR,
+                          message=f"cannot parse: {err}")
+    return FileContext(path=path, rel_path=rel, source=source, tree=tree,
+                       root=root)
+
+
+def _check_file(ctx: FileContext, rules: Sequence[Rule]) -> list[Diagnostic]:
     out: list[Diagnostic] = []
     for rule in rules:
+        if isinstance(rule, ProjectRule) or not rule.in_scope(ctx.rel_path):
+            continue
         for diag in rule.check(ctx):
             if not ctx.suppressed(diag):
                 out.append(diag)
-    return sorted(out)
+    return out
+
+
+def lint_file(path: str, root: str,
+              rules: Sequence[Rule] | None = None) -> list[Diagnostic]:
+    """All non-suppressed per-file diagnostics for one file (no baseline,
+    no whole-program rules — use :func:`lint_paths` for those)."""
+    rules = list(rules) if rules is not None else all_rules()
+    ctx = build_file_context(path, root)
+    if isinstance(ctx, Diagnostic):
+        return [ctx]
+    return sorted(_check_file(ctx, rules))
+
+
+def _check_project(ctxs: Sequence[FileContext],
+                   rules: Sequence[Rule]) -> list[Diagnostic]:
+    """Run the whole-program rules over one ProjectContext spanning
+    ``ctxs`` (already-parsed files — nothing is re-read or re-parsed)."""
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    if not project_rules or not ctxs:
+        return []
+    # deferred import: project.py imports engine types
+    from kepler_tpu.analysis.project import ProjectContext
+
+    project = ProjectContext(ctxs)
+    by_rel = {ctx.rel_path: ctx for ctx in ctxs}
+    out: list[Diagnostic] = []
+    for rule in project_rules:
+        for diag in rule.check_project(project):
+            if not rule.in_scope(diag.path):
+                continue
+            ctx = by_rel.get(diag.path)
+            if ctx is not None and ctx.suppressed(diag):
+                continue
+            out.append(diag)
+    return out
 
 
 def lint_paths(paths: Sequence[str], root: str | None = None,
                rules: Sequence[Rule] | None = None,
-               baseline: "Baseline | None" = None) -> LintResult:
-    """Lint every .py file under ``paths``; apply ``baseline`` if given."""
+               baseline: "Baseline | None" = None,
+               per_file: bool = False) -> LintResult:
+    """Lint every .py file under ``paths``; apply ``baseline`` if given.
+
+    Each file is parsed exactly once; the resulting contexts feed both
+    the per-file rules and the whole-program (:class:`ProjectRule`)
+    analysis.  ``per_file=True`` restricts the whole-program rules to
+    one-file ProjectContexts — no cross-module call graph — which is how
+    the tests prove the call graph is load-bearing (and what the CLI's
+    ``--per-file`` exposes for bisecting findings)."""
     root = root or find_repo_root(paths[0] if paths else ".")
+    rules = list(rules) if rules is not None else all_rules()
     diags: list[Diagnostic] = []
+    ctxs: list[FileContext] = []
     for path in iter_python_files(paths):
-        diags.extend(lint_file(path, root, rules))
+        ctx = build_file_context(path, root)
+        if isinstance(ctx, Diagnostic):
+            diags.append(ctx)
+            continue
+        ctxs.append(ctx)
+        diags.extend(_check_file(ctx, rules))
+    if per_file:
+        for ctx in ctxs:
+            diags.extend(_check_project([ctx], rules))
+    else:
+        diags.extend(_check_project(ctxs, rules))
     diags.sort()
     if baseline is None:
         return LintResult(diagnostics=diags)

@@ -1,0 +1,58 @@
+"""KTL107 — jitted / Pallas code is side-effect-free."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from kepler_tpu.analysis.engine import Diagnostic, FileContext, Rule, register
+from kepler_tpu.analysis.rules.common import (
+    call_canonical,
+    imports_for,
+    jitted_functions,
+)
+
+_IMPURE_ROOTS = {"random", "time", "datetime"}
+_IMPURE_BARE = {"print", "open", "input"}
+
+
+@register
+class JitPureRule(Rule):
+    id = "KTL107"
+    name = "jit-pure"
+    summary = ("no Python side effects (print, wall clock, host RNG, "
+               "global state) inside jitted/Pallas functions")
+    rationale = (
+        "`jax.jit` traces Python once per shape; side effects run at "
+        "trace time only (or not at all after a cache hit), so a print, "
+        "`time.time()`, `np.random`, or global mutation inside a kernel "
+        "is either dead code or a silent nondeterminism bug. Kernels in "
+        "kepler_tpu/ops/ must stay pure functions of their arrays with "
+        "static shapes.")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        imports = imports_for(ctx)
+        for fn in jitted_functions(ctx):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    yield ctx.diag(
+                        self, node,
+                        f"{type(node).__name__.lower()} statement inside "
+                        f"jitted function {fn.name}(); jitted code must "
+                        "not mutate enclosing scopes")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                canon = call_canonical(node, imports) or ""
+                root = canon.split(".")[0]
+                impure = (
+                    canon in _IMPURE_BARE
+                    or root in _IMPURE_ROOTS
+                    or canon.startswith("numpy.random")
+                )
+                if impure:
+                    yield ctx.diag(
+                        self, node,
+                        f"impure call {canon}() inside jitted function "
+                        f"{fn.name}(); kernels must be side-effect-free "
+                        "(use jax.random / jax.debug.print if needed)")

@@ -1,0 +1,92 @@
+"""KTL109 — telemetry span discipline."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from kepler_tpu.analysis.engine import Diagnostic, FileContext, Rule, register
+from kepler_tpu.analysis.rules.common import (
+    Imports,
+    WALL_CLOCK_CALLS,
+    call_canonical,
+    imports_for,
+    jitted_functions,
+    terminal,
+)
+
+
+def _is_span_call(node: ast.AST, imports: Imports) -> bool:
+    """A call to the telemetry span API: ``telemetry.span(...)`` (module
+    import), ``kepler_tpu.telemetry.span`` (canonicalized from-import),
+    or a bare ``span(...)`` whose import resolves into the telemetry
+    package."""
+    if not isinstance(node, ast.Call):
+        return False
+    canon = call_canonical(node, imports) or ""
+    if terminal(canon) != "span":
+        return False
+    return canon == "span" or canon.endswith("telemetry.span")
+
+
+def _walk_span_body(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a span with-block like ``ast.walk`` but WITHOUT descending
+    into nested function/lambda definitions: a callback defined inside
+    the body runs after the span closed, so its clock calls are not
+    span-body timing."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+@register
+class SpanDisciplineRule(Rule):
+    id = "KTL109"
+    name = "span-discipline"
+    summary = ("span bodies use monotonic clocks only, and span() never "
+               "appears inside jitted/Pallas kernels")
+    rationale = (
+        "Telemetry spans time their body with `time.monotonic`; a wall-"
+        "clock call (`time.time`, `datetime.now`) inside a `with "
+        "span(...)` body means the stage's own logic is deriving "
+        "durations from a clock NTP can step — the histogram and the "
+        "code would disagree about what was measured. (The injected "
+        "`self._clock` seam stays legal: seams are the sanctioned wall-"
+        "clock source.) And `jax.jit` traces Python once per shape, so "
+        "a span inside a jitted/Pallas kernel times the TRACE, not the "
+        "execution — it would record one misleading sample per compile "
+        "and nothing afterwards (composes with KTL107's purity rule).")
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        imports = imports_for(ctx)
+        # part 1: wall-clock calls inside `with span(...)` bodies
+        for node in ctx.walk_nodes:
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_span_call(item.context_expr, imports)
+                       for item in node.items):
+                continue
+            for call in _walk_span_body(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                canon = call_canonical(call, imports)
+                if canon in WALL_CLOCK_CALLS:
+                    yield ctx.diag(
+                        self, call,
+                        f"wall-clock call {canon}() inside a telemetry "
+                        "span body; spans time with time.monotonic — "
+                        "use the monotonic clock or an injected seam")
+        # part 2: span() inside jitted / Pallas kernels
+        for fn in jitted_functions(ctx):
+            for call in ast.walk(fn):
+                if _is_span_call(call, imports):
+                    yield ctx.diag(
+                        self, call,
+                        f"telemetry span inside jitted function "
+                        f"{fn.name}(); spans run at trace time only — "
+                        "instrument the call site, not the kernel")

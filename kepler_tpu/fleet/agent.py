@@ -231,6 +231,7 @@ class FleetAgent:
             self._queue.append((seq, sample, emitted_at, trace_id))
             self._wake.set()
 
+    # keplint: thread-role=agent
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
             self._wake.wait(timeout=0.5)
@@ -244,6 +245,7 @@ class FleetAgent:
             if ctx.wait(0.0):
                 return
 
+    # keplint: thread-role=shutdown
     def shutdown(self) -> None:
         self._wake.set()
         # best-effort final flush: a clean node drain delivers its queued

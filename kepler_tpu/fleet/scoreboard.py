@@ -200,6 +200,8 @@ class FleetScoreboard:
         e.duplicates += 1
         e.last_seen = now  # a duplicate still proves the sender is alive
 
+    # keplint: taint-sink=bounded-store-key — the name becomes an LRU key
+    # and a metric label; callers sanitize wire-peeked names first
     def observe_quarantine(self, node: str, now: float,
                            reason: str) -> None:
         """Weak insert: the name may be hostile garbage (it is peeked

@@ -216,6 +216,10 @@ def align_zone_matrices(reports: Sequence[NodeReport],
     return zd_mat, zv_mat
 
 
+# keplint: forbid-role=http-handler — live engine state (device buffers,
+# compile cache, cost ledgers) is mutated by the pipelined window thread;
+# HTTP handlers read the PUBLISHED introspection snapshot the aggregator
+# caches under _results_lock at _publish time (PR 8 invariant, KTL113)
 class PackedWindowEngine:
     """Resident packed batch + program/update cache for the default
     (packed-f16) fleet path. Single-threaded by contract: only the
@@ -233,7 +237,7 @@ class PackedWindowEngine:
     # its instance attribute from the mesh)
     n_shards = 1
 
-    def __init__(self, mesh, backend: str = "einsum",
+    def __init__(self, mesh: Any, backend: str = "einsum",
                  model_mode: str | None = None,
                  node_bucket: int = 8, workload_bucket: int = 256,
                  shrink_after: int = 16, staging_slots: int = 2) -> None:
@@ -332,7 +336,7 @@ class PackedWindowEngine:
                 self._programs.pop(next(iter(self._programs)))
         return entry
 
-    def _jit_scatter(self, scatter_rows):
+    def _jit_scatter(self, scatter_rows: Callable[..., Any]) -> Any:
         """jit the donated scatter-update with the mesh shardings (the
         sharded engine overrides this — its per-shard operands carry
         placement themselves)."""
@@ -350,7 +354,7 @@ class PackedWindowEngine:
                     "compile_error",
                     f"injected compile failure for update key {key}")
 
-            def scatter_rows(resident, rows, idx):
+            def scatter_rows(resident: Any, rows: Any, idx: Any) -> Any:
                 # index n (the pad value) is out of bounds → dropped
                 return resident.at[idx].set(rows, mode="drop")
 
@@ -384,7 +388,7 @@ class PackedWindowEngine:
     def _label_suffix(self) -> str:
         return f"_s{self.n_shards}" if self.n_shards > 1 else ""
 
-    def _capture_cost(self, entry: list, fn, args: tuple) -> None:
+    def _capture_cost(self, entry: list, fn: Any, args: tuple) -> None:
         """Best-effort XLA ``cost_analysis()``/``memory_analysis()`` for a
         freshly compiled cache entry, stored as ``entry[2]``.
 
@@ -786,7 +790,7 @@ class ShardedWindowEngine(PackedWindowEngine):
 
     _LOCAL_SPARSE = True
 
-    def __init__(self, mesh, backend: str = "einsum",
+    def __init__(self, mesh: Any, backend: str = "einsum",
                  model_mode: str | None = None,
                  node_bucket: int = 8, workload_bucket: int = 256,
                  shrink_after: int = 16, staging_slots: int = 2) -> None:
@@ -839,7 +843,7 @@ class ShardedWindowEngine(PackedWindowEngine):
 
     # -- per-shard update programs -----------------------------------------
 
-    def _jit_scatter(self, scatter_rows):
+    def _jit_scatter(self, scatter_rows: Callable[..., Any]) -> Any:
         """Shard-local donated scatter: jitted WITHOUT mesh shardings —
         placement follows the committed per-shard operands, so one cache
         entry serves every shard (jax re-specializes per device)."""

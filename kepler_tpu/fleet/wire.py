@@ -27,6 +27,20 @@ MAGIC = b"KTPUFL1\n"
 _HEADER_LEN = struct.Struct("<I")
 MAX_HEADER_BYTES = 16 << 20
 MAX_ARRAY_BYTES = 256 << 20
+# node names become Prometheus label values, scoreboard/tracker keys, and
+# log fields; the cap matches the scoreboard's name_cap so one contract
+# bounds every store keyed on the name
+MAX_NODE_NAME = 128
+
+
+# keplint: sanitizes — the chokepoint that launders a wire-derived node
+# name: printable ASCII only (newlines would forge log lines; control
+# bytes corrupt label values), length-capped so hostile names can't mint
+# unbounded store keys / metric series
+def sanitize_node_name(name: str) -> str:
+    cleaned = "".join(c for c in name[:MAX_NODE_NAME]
+                      if " " <= c <= "\x7e")
+    return cleaned.strip()
 
 _DTYPES = {"float32": np.float32, "float64": np.float64,
            "int8": np.int8, "int32": np.int32, "bool": np.bool_}
@@ -139,6 +153,9 @@ def restamp_sent_at(data: bytes, sent_at: float) -> bytes:
     return restamp_transmit(data, sent_at)
 
 
+# keplint: taint-source — the ONLY wire accessor that skips validation
+# (the body already failed decoding); callers must sanitize_node_name()
+# before the peeked name touches a label, store key, or log line
 def peek_node_name(data: bytes) -> str | None:
     """Best-effort node name from a (possibly malformed) payload.
 
@@ -161,6 +178,9 @@ def peek_node_name(data: bytes) -> str | None:
         return None
 
 
+# keplint: sanitizes — every field is validated (dtype whitelist, bounds
+# checks, node-name charset/length) or the whole report is rejected, so
+# decoded output is trusted downstream
 def decode_report(data: bytes) -> tuple[NodeReport, dict[str, Any]]:
     """Parse a report payload → (NodeReport, header). Raises WireError on
     any malformed/oversized input."""
@@ -202,10 +222,19 @@ def decode_report(data: bytes) -> tuple[NodeReport, dict[str, Any]]:
     if (not isinstance(zone_names, list)
             or not all(isinstance(z, str) for z in zone_names)):
         raise WireError("zone_names must be a list of strings")
+    raw_name = header.get("node_name")
+    if not isinstance(raw_name, str):
+        raise WireError("node_name must be a string")
+    node_name = sanitize_node_name(raw_name)
+    if not node_name or node_name != raw_name:
+        # reject rather than silently rewrite: an agent sending control
+        # bytes or a >128-char name is misconfigured or hostile, and a
+        # rewritten identity would split its series mid-stream
+        raise WireError("node_name must be 1-128 printable ASCII chars")
     try:
         n_zones = len(zone_names)
         report = NodeReport(
-            node_name=str(header["node_name"]),
+            node_name=node_name,
             zone_deltas_uj=arrays["zone_deltas_uj"],
             zone_valid=arrays["zone_valid"],
             usage_ratio=float(header["usage_ratio"]),

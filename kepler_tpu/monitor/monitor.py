@@ -539,6 +539,9 @@ class PowerMonitor:
         except OSError:
             return ""
 
+    # keplint: role-boundary — the per-refresh atomic write of the tiny
+    # counter-state file IS the durability contract (PR 3); local disk,
+    # bounded size, failures never break refresh
     def _persist_state(self, now: float) -> None:
         """Write the raw counter baseline + wall anchor, atomically.
 

@@ -77,6 +77,9 @@ def ensure_built(force: bool = False) -> str | None:
         return _LIB
 
 
+# keplint: role-boundary — one-time lazy build+dlopen, memoized in _lib;
+# after the first call this is a pointer return, so hot-loop callers only
+# ever pay the subprocess/compile cost once at startup
 def load() -> ctypes.CDLL | None:
     """Load (building if needed) the native library; None on any failure."""
     global _lib, _load_failed

@@ -209,6 +209,9 @@ class ResourceInformer:
 
     # -- refresh ----------------------------------------------------------
 
+    # keplint: role-boundary — reading /proc IS this component's
+    # measurement seam (the meter analog); it keeps its own I/O budget
+    # contract rather than inheriting the hot-loop blocking ban
     def refresh(self) -> None:
         """One full scan: processes first, then container/VM/pod rollups and
         node totals (reference Refresh :349-410 runs the rollups in three

@@ -29,6 +29,13 @@ log = logging.getLogger("kepler.server")
 Handler = Callable[[BaseHTTPRequestHandler], tuple[int, dict[str, str], bytes]]
 
 
+# keplint: sanitizes — request paths/headers go into log lines; control
+# bytes (an encoded %00, a smuggled ESC sequence) would forge log records
+# or corrupt terminals, so log fields are filtered to printable ASCII
+def printable(value: str, cap: int = 256) -> str:
+    return "".join(c for c in str(value)[:cap] if " " <= c <= "\x7e")
+
+
 @dataclass
 class Endpoint:
     path: str
@@ -63,6 +70,9 @@ class APIServer:
     def name(self) -> str:
         return "api-server"
 
+    # keplint: role-registrar=http-handler — every callable registered
+    # here runs on a ThreadingHTTPServer worker thread; keplint roots the
+    # http-handler thread role at the registered handler (KTL112/KTL113)
     def register(self, path: str, name: str, description: str,
                  handler: Handler, max_body: int = 1 << 20) -> None:
         """Add an endpoint to the catalog (reference Register :167)."""
@@ -74,6 +84,7 @@ class APIServer:
     def init(self) -> None:
         outer = self
 
+        # keplint: thread-role=http-handler
         class RequestHandler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
@@ -119,7 +130,7 @@ class APIServer:
                 try:
                     status, headers, body = endpoint.handler(self)
                 except Exception:
-                    log.exception("handler %s failed", path)
+                    log.exception("handler %s failed", printable(path))
                     self._respond(500, {"Content-Type": "text/plain"},
                                   b"internal error\n")
                     return
@@ -143,7 +154,7 @@ class APIServer:
                 except (BrokenPipeError, ConnectionResetError):
                     # client gave up (e.g. agent timeout) — not our error
                     log.debug("client disconnected before response: %s",
-                              self.path)
+                              printable(self.path))
 
         self._handler_cls = RequestHandler
         self.register("/", "Home", "Landing page", self._landing_page)

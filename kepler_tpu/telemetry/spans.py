@@ -540,6 +540,7 @@ def make_traces_handler(rec: SpanRecorder | None = None):
     import json
     from urllib.parse import parse_qs, urlparse
 
+    # keplint: thread-role=http-handler
     def handler(request) -> tuple[int, dict[str, str], bytes]:
         active = rec if rec is not None else _active
         qs = parse_qs(urlparse(request.path).query)
