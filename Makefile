@@ -81,7 +81,7 @@ native-asan: ## AddressSanitizer pass over the native scanner/renderer
 .PHONY: lint
 lint:
 	$(PYTHON) -m compileall -q kepler_tpu tests hack benchmarks
-	$(PYTHON) -m kepler_tpu.analysis kepler_tpu hack benchmarks
+	$(PYTHON) -m kepler_tpu.analysis --device-tier kepler_tpu hack benchmarks
 	$(PYTHON) hack/gen_lint_docs.py --check
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check kepler_tpu tests hack; \
@@ -95,12 +95,20 @@ lint:
 	fi
 
 .PHONY: keplint
-keplint: ## project-native AST invariant checks only
+keplint: ## project-native AST invariant checks only (host tiers; no device traces)
 	$(PYTHON) -m kepler_tpu.analysis kepler_tpu hack benchmarks
 
+.PHONY: kepljax
+kepljax: ## device tier alone: trace registered programs, run KTL120-123
+	$(PYTHON) -m kepler_tpu.analysis --device-tier --only=KTL120,KTL121,KTL122,KTL123 kepler_tpu
+
+.PHONY: kepljax-snapshots
+kepljax-snapshots: ## regenerate the KTL123 golden program fingerprints (.kepljax.json)
+	$(PYTHON) -m kepler_tpu.analysis --update-snapshots
+
 .PHONY: keplint-sarif
-keplint-sarif: ## keplint findings as SARIF 2.1.0 (CI annotation feed; stdout is pipeable JSON)
-	@$(PYTHON) -m kepler_tpu.analysis --format=sarif kepler_tpu hack benchmarks
+keplint-sarif: ## keplint + device-tier findings as SARIF 2.1.0 (CI annotation feed; stdout is pipeable JSON)
+	@$(PYTHON) -m kepler_tpu.analysis --device-tier --format=sarif kepler_tpu hack benchmarks
 
 .PHONY: keplint-baseline
 keplint-baseline: ## refreeze the keplint baseline (after fixing findings)

@@ -49,12 +49,16 @@ python -m kepler_tpu.analysis path/ file.py
 python -m kepler_tpu.analysis --list-rules
 python -m kepler_tpu.analysis --format=sarif   # SARIF 2.1.0 (make keplint-sarif)
 python -m kepler_tpu.analysis --per-file       # disable cross-module analysis
+python -m kepler_tpu.analysis --device-tier    # + trace device programs (KTL120-123)
+python -m kepler_tpu.analysis --only=KTL120    # single-rule iteration loop
 ```
 
 Exit codes: `0` clean (baselined findings tolerated), `1` new
 violations, `2` usage errors. `--format=json|sarif` emits
 machine-readable reports (SARIF 2.1.0 minimal profile, consumable as
-CI annotations).
+CI annotations). `--only=KTLxxx[,KTLxxx]` restricts a run to the named
+rules so a single-rule iteration loop does not pay every family's cost
+— in particular the device tier's trace cost.
 
 ## Whole-program analysis
 
@@ -92,6 +96,49 @@ call graph) — useful for bisecting which findings are genuinely
 interprocedural; the test suite uses it to prove the call graph is
 load-bearing.
 
+## Device tier (kepljax, KTL120-123)
+
+The host tiers see source text; the compiled packed/sharded fleet
+programs the attribution math actually runs on are a different plane.
+`--device-tier` (wired into `make lint`) traces every entry of a
+declarative **program registry**
+(`kepler_tpu/analysis/device/registry.py`) abstractly —
+`jit(...).trace(ShapeDtypeStruct...)` + StableHLO lowering on a
+CPU-only host (`JAX_PLATFORMS=cpu`, virtual devices, no execution, no
+backend compile) — and runs four check families over the jaxprs:
+
+- **KTL120 dtype-flow** — no f16/bf16 dot accumulators or reduction
+  operands anywhere; half casts only at the boundaries the entry
+  declares (`allowed_half_casts`, e.g. the packed program's one
+  `float32->float16` wire quantizer, bf16 MXU operand feeds).
+- **KTL121 donation-alias** — the entry's `donates` contract must be
+  realized in the lowered module's argument attributes
+  (`tf.aliasing_output` / `jax.buffer_donor`), and no undeclared arg
+  may alias; a dropped donation is a silent full-copy per window.
+- **KTL122 collective-discipline** — the traced program's explicit
+  collectives must stay inside `allowed_collectives`, and
+  `require_shard_map` entries must actually contain a `shard_map`
+  (GSPMD inserts collectives at partitioning time, invisible to the
+  jaxpr tier — losing the shard_map is how a regression to a
+  replicated-index gather reads here).
+- **KTL123 program-ratchet** — a normalized structural fingerprint per
+  entry/case (aval signatures, compute-primitive histogram with
+  version-noisy wrapper primitives excluded, collective set, half-cast
+  pairs, shard_map presence, donation map) is committed as a golden
+  snapshot in `.kepljax.json`; drift fails lint with a field diff.
+  After an INTENDED program change, `make kepljax-snapshots`
+  regenerates and the snapshot diff becomes part of code review.
+
+Registry entries are declarative: factory + representative bucket-shape
+cases (including pad-row/minimal-ladder edges) + the contract
+vocabulary above (`donates`, `allowed_collectives`,
+`allowed_half_casts`, `require_shard_map`, `n_devices`). CPU-host
+caveats: traces stage the CPU lowering of each program (the packed
+program serves f32 estimator compute off-TPU by design, so bf16-only
+TPU casts do not appear), and fingerprints describe structure, not
+cost. A jax upgrade can legitimately shift a fingerprint; regenerating
+snapshots then is expected and the diff shows the cause.
+
 ## Suppressing
 
 Append `# keplint: disable=KTL1xx` to the offending line (or put it on
@@ -114,6 +161,7 @@ instead of hardcoding module lists:
 | `# keplint: guarded-by=_lock` (on an attribute assignment in `__init__`) | KTL108/KTL111: writes to this attribute require `with self._lock` (KTL111 checks writers in other classes/modules too) |
 | `# keplint: requires-lock=_lock` (above a `def`) | KTL108/KTL111: this function may only be called with the lock held; callers are checked, cross-module included |
 | `# keplint: donates=<positions>` (on a callable binding) | KTL110: calls through this binding consume the arguments at those positions |
+| `# keplint: layout-definition` (above a `def`/`class`) | KTL114: the one scope allowed to spell packed row-layout offset arithmetic |
 | `# keplint: thread-role=<role>` (above a `def` or `class`) | KTL113: roots the thread role here; it propagates to everything reachable |
 | `# keplint: role-registrar=<role>` (above a `def`) | KTL113: callables passed to this function become roots of `<role>` |
 | `# keplint: role-boundary` (above a `def`) | KTL113: role propagation stops here — the seam keeps its own contract |
@@ -133,12 +181,17 @@ down. The committed baseline is **empty**: every finding in the shipped
 tree was fixed, not grandfathered (`tests/test_keplint.py` pins this —
 including for the whole-program rules).
 
+The device tier has its own ratchet shape: the committed
+`.kepljax.json` golden fingerprints (see above) — drift fails, and
+regeneration is an explicit, reviewable act.
+
 The same ratchet stance applies to typing: `pyproject.toml` declares a
 strict mypy tier (`config/`, `monitor/snapshot`, `fleet/wire`,
 `fleet/window`, `fleet/scoreboard`, `fleet/aggregator`, `fault/`,
-`analysis/` — fully typed, `disallow_untyped_defs`) and a checked tier
-(`monitor/`, `fleet/`, `service/` — `check_untyped_defs`); modules
-move *up* tiers, never down.
+`analysis/`, `parallel/packed`, `parallel/mesh`, `parallel/compat` —
+fully typed, `disallow_untyped_defs`) and a checked tier (`monitor/`,
+`fleet/`, `service/` — `check_untyped_defs`); modules move *up* tiers,
+never down.
 
 ## Extending
 
@@ -147,11 +200,16 @@ Per-file rules subclass `kepler_tpu.analysis.Rule` and implement
 once-per-run node list, instead of re-walking `ctx.tree`).
 Whole-program rules subclass `ProjectRule` and implement
 `check_project(project)` over the `ProjectContext` (symbol table, call
-graph, roles, lock summaries). Either way: set `id`/`name`/`severity`/
-`summary`/`rationale` (and `tree_scope` if the rule polices `hack/` or
-`benchmarks/` too), decorate with `@register` in the matching module
-under `kepler_tpu/analysis/rules/`, add a good/bad fixture pair to
-`tests/test_keplint.py` (cross-module fixtures for project rules), and
+graph, roles, lock summaries). Device-tier rules subclass `DeviceRule`
+and implement `check_trace(report)` over a
+`kepler_tpu.analysis.device.trace.TraceReport`; new device programs
+register a `ProgramSpec` (factory + cases + contract) in
+`kepler_tpu/analysis/device/registry.py` and commit regenerated
+snapshots. Either way: set `id`/`name`/`severity`/`summary`/
+`rationale` (and `tree_scope` if the rule polices `hack/` or
+`benchmarks/` too), decorate with `@register`, add a good/bad fixture
+pair to `tests/test_keplint.py` (cross-module fixtures for project
+rules, spec fixtures in `tests/test_kepljax.py` for device rules), and
 regenerate this doc. Engine internals (directives, baselines, file
 walking, SARIF) live in `kepler_tpu/analysis/engine.py` and
 `__main__.py`.
@@ -167,14 +225,20 @@ def render() -> str:
         raise SystemExit(
             f"gen_lint_docs: rules missing summary/rationale: {missing}")
     from kepler_tpu.analysis import ProjectRule
+    from kepler_tpu.analysis.engine import DeviceRule
 
     lines = [PREAMBLE]
     lines.append("| Rule | Name | Tier | Scope | Severity | Invariant |")
     lines.append("| --- | --- | --- | --- | --- | --- |")
     for r in rules:
-        tier = ("whole-program" if isinstance(r, ProjectRule)
-                else "per-file")
-        scope = ", ".join(f"`{t}/`" for t in r.tree_scope)
+        if isinstance(r, DeviceRule):
+            tier, scope = "device", "traced device programs"
+        elif isinstance(r, ProjectRule):
+            tier = "whole-program"
+            scope = ", ".join(f"`{t}/`" for t in r.tree_scope)
+        else:
+            tier = "per-file"
+            scope = ", ".join(f"`{t}/`" for t in r.tree_scope)
         lines.append(f"| `{r.id}` | {r.name} | {tier} | {scope} | "
                      f"{r.severity} | {r.summary} |")
     lines.append("")

@@ -11,6 +11,15 @@ finding), ``json`` (machine-readable summary), or ``sarif`` (SARIF
 keplint-sarif``). ``--per-file`` restricts the whole-program rules
 (KTL111-113) to single-file contexts: cross-module findings disappear,
 which is useful for bisecting whether a finding needs the call graph.
+
+``--device-tier`` additionally traces the registered device programs
+(``kepler_tpu/analysis/device``) and runs the KTL120-123 families over
+their jaxprs — seconds of staging cost, so it is opt-in (``make lint``
+passes it). ``--update-snapshots`` regenerates the KTL123 golden
+fingerprints (``.kepljax.json``) and exits. ``--only=KTL110,KTL120``
+restricts a run to the named rules — a single-rule iteration loop no
+longer pays every other family's cost (the device tier's trace cost
+made that painful).
 """
 
 from __future__ import annotations
@@ -66,6 +75,15 @@ def main(argv: Sequence[str] | None = None) -> int:
                         help="restrict whole-program rules (KTL111-113) "
                              "to single-file contexts — no cross-module "
                              "call graph")
+    parser.add_argument("--device-tier", action="store_true",
+                        help="also trace the registered device programs "
+                             "and run the KTL120-123 jaxpr-tier checks")
+    parser.add_argument("--update-snapshots", action="store_true",
+                        help="regenerate the KTL123 golden program "
+                             "fingerprints (.kepljax.json) and exit")
+    parser.add_argument("--only", default=None, metavar="KTLxxx[,KTLxxx]",
+                        help="run only the named rules; naming a KTL12x "
+                             "id implies --device-tier")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -73,6 +91,16 @@ def main(argv: Sequence[str] | None = None) -> int:
             print(f"{rule.id}  {rule.name:<20} [{rule.severity}] "
                   f"{rule.summary}")
         return 0
+
+    only_ids: set[str] | None = None
+    if args.only:
+        only_ids = {p.strip() for p in args.only.split(",") if p.strip()}
+        known = {r.id for r in all_rules()}
+        unknown = only_ids - known
+        if unknown:
+            print(f"keplint: unknown rule id(s) in --only: "
+                  f"{', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
 
     root = find_repo_root(args.paths[0] if args.paths else os.getcwd())
     if args.paths:
@@ -85,6 +113,17 @@ def main(argv: Sequence[str] | None = None) -> int:
             print(f"keplint: no such path: {path}", file=sys.stderr)
             return 2
 
+    if args.update_snapshots:
+        from kepler_tpu.analysis.device import (SNAPSHOT_NAME,
+                                                write_snapshots)
+
+        count, errors = write_snapshots(root)
+        for diag in errors:
+            print(diag.render(), file=sys.stderr)
+        print(f"keplint: wrote {os.path.join(root, SNAPSHOT_NAME)} "
+              f"({count} program fingerprint(s))")
+        return 1 if errors else 0
+
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
     baseline: Baseline | None = None
     if not args.no_baseline and not args.write_baseline:
@@ -96,15 +135,39 @@ def main(argv: Sequence[str] | None = None) -> int:
                       file=sys.stderr)
                 return 2
 
+    rules = all_rules()
+    if only_ids is not None:
+        rules = [r for r in rules if r.id in only_ids]
+    # --only with a device-rule id implies the device tier: silently
+    # skipping the only rules the user named (and printing "clean")
+    # would be a false all-clear
+    device_ids = {"KTL120", "KTL121", "KTL122", "KTL123"}
+    if only_ids is None:
+        device_wanted = args.device_tier
+    else:
+        device_wanted = bool(only_ids & device_ids)
+
+    def run_lint() -> LintResult:
+        result = lint_paths(paths, root=root, rules=rules,
+                            per_file=args.per_file)
+        if device_wanted:
+            from kepler_tpu.analysis.device import analyze_device_programs
+
+            result.diagnostics.extend(
+                analyze_device_programs(root, only=only_ids))
+            result.diagnostics.sort()
+        return result
+
     if args.write_baseline:
-        full = lint_paths(paths, root=root, per_file=args.per_file)
+        full = run_lint()
         Baseline.from_diagnostics(full.diagnostics).save(baseline_path)
         print(f"keplint: wrote {baseline_path} "
               f"({len(full.diagnostics)} frozen violation(s))")
         return 0
 
-    result: LintResult = lint_paths(paths, root=root, baseline=baseline,
-                                    per_file=args.per_file)
+    result = run_lint()
+    if baseline is not None:
+        result = baseline.apply(result.diagnostics)
     if args.format == "sarif":
         print(json.dumps(render_sarif(result), indent=2))
         return 1 if result.failed else 0

@@ -1,0 +1,49 @@
+"""kepljax: jaxpr-tier analysis of the registered device programs.
+
+The host-plane tiers (per-file KTL101-110/114, whole-program
+KTL111-113) see source text; this tier sees what the attribution math
+actually runs — the staged jaxprs and lowered modules of every jitted
+device program, traced abstractly on a CPU-only host (no devices, no
+execution). Four families ride each trace:
+
+- **KTL120 dtype-flow** — half precision never accumulates; casts only
+  at declared boundaries (the f16 wire quantizer, bf16 MXU operands).
+- **KTL121 donation-alias** — the `donates` contract is REAL in the
+  lowered module's input/output aliasing, both directions.
+- **KTL122 collective-discipline** — explicit collectives match the
+  entry's allowlist; shard-local programs keep their shard_map.
+- **KTL123 program-ratchet** — normalized structural fingerprints
+  against committed golden snapshots (``.kepljax.json``).
+
+Run via ``python -m kepler_tpu.analysis --device-tier`` (wired into
+``make lint``); regenerate snapshots with ``make kepljax-snapshots``.
+Importing this package registers the rules but touches no jax.
+"""
+
+from kepler_tpu.analysis.device.checks import (  # noqa: F401
+    DEVICE_RULE_IDS,
+    SNAPSHOT_NAME,
+    analyze_device_programs,
+    clear_trace_cache,
+    load_snapshots,
+    write_snapshots,
+)
+from kepler_tpu.analysis.device.registry import (  # noqa: F401
+    DEVICE_PROGRAMS,
+    ProgramCase,
+    ProgramSpec,
+    spec_by_name,
+)
+
+__all__ = [
+    "DEVICE_PROGRAMS",
+    "DEVICE_RULE_IDS",
+    "ProgramCase",
+    "ProgramSpec",
+    "SNAPSHOT_NAME",
+    "analyze_device_programs",
+    "clear_trace_cache",
+    "load_snapshots",
+    "spec_by_name",
+    "write_snapshots",
+]

@@ -1,0 +1,360 @@
+"""kepljax check families KTL120-123 + the device-tier runner.
+
+Each rule consumes the :class:`~kepler_tpu.analysis.device.trace
+.TraceReport` of one registry case and yields engine
+:class:`~kepler_tpu.analysis.engine.Diagnostic`\\ s anchored at the
+program's home module, so device-tier findings ride the same severity,
+baseline-ratchet and text/json/SARIF machinery as every other keplint
+rule. Traces are cached per (spec, case) for the life of the process —
+the dominant cost is staging, paid once however many families run.
+
+The KTL123 golden snapshots live in ``.kepljax.json`` at the repo root
+(``make kepljax-snapshots`` / ``--update-snapshots`` regenerates); the
+committed file is the ratchet — structural drift in any registered
+program fails lint with a field-level diff instead of surfacing as a
+bench regression rounds later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from kepler_tpu.analysis.engine import (
+    Diagnostic,
+    DeviceRule,
+    SEVERITY_ERROR,
+    register,
+)
+from kepler_tpu.analysis.device.registry import (
+    DEVICE_PROGRAMS,
+    ProgramSpec,
+)
+from kepler_tpu.analysis.device.trace import TraceReport, fingerprint
+
+SNAPSHOT_NAME = ".kepljax.json"
+SNAPSHOT_VERSION = 1
+
+DEVICE_RULE_IDS = ("KTL120", "KTL121", "KTL122", "KTL123")
+
+# process-lifetime trace cache: (spec.name, case.name) → TraceReport
+_TRACE_CACHE: dict[tuple[str, str], TraceReport] = {}
+
+
+def clear_trace_cache() -> None:
+    _TRACE_CACHE.clear()
+
+
+def _diag(rule: DeviceRule, report: TraceReport, message: str) -> Diagnostic:
+    return Diagnostic(
+        path=report.spec.source, line=1, col=1, rule_id=rule.id,
+        severity=rule.severity,
+        message=f"[{report.key}] {message}")
+
+
+@register
+class DtypeFlowRule(DeviceRule):
+    id = "KTL120"
+    name = "dtype-flow"
+    summary = ("half precision (f16/bf16) never accumulates: no half "
+               "dot accumulators or reduction operands, and half casts "
+               "only at the boundaries the registry entry declares")
+    rationale = (
+        "The packed fleet wire format quantizes watts to f16 at exactly "
+        "one declared boundary (~0.05% error, inside the 0.5%-of-RAPL "
+        "budget) and estimator trunks feed bf16 OPERANDS to the MXU with "
+        "f32 accumulators (`models.nn.acc_matmul`). That budget only "
+        "holds while those are the ONLY half-precision touchpoints: a "
+        "bare `x16 @ w16` rounds every partial sum to ~3 decimal digits, "
+        "and a stray `.astype(f16)` mid-program quantizes an "
+        "intermediate, both silently. This check walks every registered "
+        "program's jaxpr dataflow: any dot_general with a half-precision "
+        "accumulator (output dtype), any reduction over half operands, "
+        "and any half `convert_element_type` pair outside the entry's "
+        "`allowed_half_casts` declaration is a finding.")
+
+    def check_trace(self, report: TraceReport) -> Iterable[Diagnostic]:
+        for desc in report.half_dots:
+            yield _diag(self, report,
+                        f"dot_general accumulates in half precision "
+                        f"({desc}); pin the accumulator f32 "
+                        f"(models.nn.acc_matmul / "
+                        f"preferred_element_type)")
+        for desc in report.half_reduces:
+            yield _diag(self, report,
+                        f"reduction over half-precision operands "
+                        f"({desc}); accumulate in f32")
+        allowed = report.spec.allowed_half_casts
+        for pair, count in sorted(report.half_casts.items()):
+            if pair not in allowed:
+                yield _diag(
+                    self, report,
+                    f"undeclared half-precision cast {pair} (×{count}); "
+                    f"declared boundaries: "
+                    f"{sorted(allowed) or 'none'}")
+
+
+@register
+class DonationAliasRule(DeviceRule):
+    id = "KTL121"
+    name = "donation-alias"
+    summary = ("the lowered module's input/output aliasing matches the "
+               "entry's `donates` contract — every declared-donated leaf "
+               "really aliases, nothing else does")
+    rationale = (
+        "`donate_argnums` is a request, not a guarantee: XLA only "
+        "aliases a donated buffer into an output of matching "
+        "shape/dtype. A declared-donated arg that could NOT alias is a "
+        "silent perf cliff (the resident fleet batch gets copied every "
+        "window instead of updated in place) and a latent hazard — "
+        "KTL110's rebind discipline assumes the handle really dies. The "
+        "reverse is worse: an UNdeclared donation consumes a buffer the "
+        "engine still holds. The check parses the lowered module's "
+        "argument attributes — `tf.aliasing_output` (alias placed at "
+        "lowering) and `jax.buffer_donor` (donation deferred to the "
+        "compiler) both realize the contract; an arg with NEITHER was "
+        "dropped, which jax also announces with a 'donated buffers "
+        "were not usable' warning — and compares the flattened-leaf "
+        "donation map against the registry contract, both directions.")
+
+    def check_trace(self, report: TraceReport) -> Iterable[Diagnostic]:
+        expected: set[int] = set()
+        for user_arg in report.spec.donates:
+            expected |= report.flat_indices_of_arg(user_arg)
+        realized = report.aliased_args | report.donor_args
+        dropped = sorted(expected - realized)
+        if dropped:
+            yield _diag(
+                self, report,
+                f"declared donation (user args "
+                f"{list(report.spec.donates)}) is not realized: flat "
+                f"args {dropped} carry neither tf.aliasing_output nor "
+                f"jax.buffer_donor — every call pays a full copy")
+        unexpected = sorted(realized - expected)
+        if unexpected:
+            yield _diag(
+                self, report,
+                f"undeclared donation/aliasing on flat args "
+                f"{unexpected}: the caller's buffer dies without a "
+                f"`donates` contract saying so")
+        for warning in report.donation_warnings:
+            yield _diag(self, report,
+                        f"lowering warned: {warning[:160]}")
+
+
+@register
+class CollectiveDisciplineRule(DeviceRule):
+    id = "KTL122"
+    name = "collective-discipline"
+    summary = ("explicit collectives stay inside the entry's allowlist, "
+               "and shard-local programs keep their shard_map structure")
+    rationale = (
+        "The fleet window's scaling contract (PR 7) is that the only "
+        "cross-shard step is the caller's result fetch — the packed "
+        "program's sparse gather stays shard-local under `shard_map`, "
+        "and the attention/pipeline/MoE programs each have a KNOWN "
+        "collective schedule (ppermute ring, all_to_all pair, …). This "
+        "check enumerates the traced jaxpr's communication primitives "
+        "against the entry's allowlist, and — because GSPMD inserts "
+        "collectives at partitioning time where the jaxpr tier cannot "
+        "see them — additionally requires `require_shard_map` entries "
+        "to actually contain a shard_map: a regression to a "
+        "replicated-index gather (plain GSPMD jit) would be satisfied "
+        "with an all-gather of the whole resident batch at compile "
+        "time, and losing the shard_map is exactly how that reads at "
+        "the jaxpr tier.")
+
+    def check_trace(self, report: TraceReport) -> Iterable[Diagnostic]:
+        rogue = report.collectives - report.spec.allowed_collectives
+        if rogue:
+            yield _diag(
+                self, report,
+                f"collectives {sorted(rogue)} outside the allowlist "
+                f"{sorted(report.spec.allowed_collectives) or '(none)'}")
+        if report.spec.require_shard_map and not report.has_shard_map:
+            yield _diag(
+                self, report,
+                "program lost its shard_map structure: GSPMD would now "
+                "satisfy cross-shard data movement (e.g. a "
+                "replicated-index gather → all-gather of the resident "
+                "batch) at partitioning time, invisible to this tier")
+
+
+@register
+class ProgramRatchetRule(DeviceRule):
+    id = "KTL123"
+    name = "program-ratchet"
+    summary = ("each registered program's normalized jaxpr fingerprint "
+               "matches its committed golden snapshot (.kepljax.json); "
+               "drift fails with a diff, --update-snapshots regenerates")
+    rationale = (
+        "Program structure predicts cost (PAPERS.md: portable "
+        "prediction of kernel time/power from program structure) — so "
+        "pin the structure. The fingerprint is deliberately normalized "
+        "(user-visible aval signatures, compute/data-movement primitive "
+        "histogram with version-noisy wrapper primitives excluded, "
+        "collective set, half-cast pairs, shard_map presence, aliasing "
+        "map) so it is stable across jax versions by design while still "
+        "catching an accidental extra transpose, a dtype widen, a lost "
+        "donation or a new collective in review — instead of three "
+        "bench rounds later as an unexplained regression. "
+        "`make kepljax-snapshots` regenerates after INTENDED changes; "
+        "the diff in the commit is the review surface.")
+
+    def check_snapshot(self, report: TraceReport,
+                       snapshot: dict | None) -> Iterable[Diagnostic]:
+        fp = fingerprint(report)
+        if snapshot is None:
+            yield _diag(
+                self, report,
+                "no golden snapshot for this program/case; run "
+                "`make kepljax-snapshots` and commit .kepljax.json")
+            return
+        for field in sorted(set(fp) | set(snapshot)):
+            got, want = fp.get(field), snapshot.get(field)
+            if got != want:
+                yield _diag(
+                    self, report,
+                    f"fingerprint drift in `{field}`: snapshot "
+                    f"{_compact(want)} != traced {_compact(got)} — "
+                    f"intended? regenerate with `make kepljax-snapshots` "
+                    f"and review the diff")
+
+
+def _compact(value: object, limit: int = 160) -> str:
+    text = json.dumps(value, sort_keys=True, default=str)
+    return text if len(text) <= limit else text[:limit] + "…"
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def snapshot_path(root: str) -> str:
+    return os.path.join(root, SNAPSHOT_NAME)
+
+
+def load_snapshots(root: str) -> dict[str, dict] | None:
+    path = snapshot_path(root)
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return None
+    if not isinstance(data, dict) or data.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot file {path!r}")
+    programs = data.get("programs", {})
+    if not isinstance(programs, dict):
+        raise ValueError(f"malformed snapshot file {path!r}")
+    return programs
+
+
+def _trace_all(specs: tuple[ProgramSpec, ...]) -> tuple[
+        list[TraceReport], list[Diagnostic]]:
+    from kepler_tpu.analysis.device.trace import trace_case
+
+    reports: list[TraceReport] = []
+    errors: list[Diagnostic] = []
+    for spec in specs:
+        for case in spec.cases:
+            key = (spec.name, case.name)
+            report = _TRACE_CACHE.get(key)
+            if report is None:
+                try:
+                    report = trace_case(spec, case)
+                except Exception as err:  # tracing is hostile territory
+                    errors.append(Diagnostic(
+                        path=spec.source, line=1, col=1,
+                        rule_id="KTL000", severity=SEVERITY_ERROR,
+                        message=f"[{spec.name}/{case.name}] device "
+                                f"program failed to build/trace: "
+                                f"{type(err).__name__}: "
+                                f"{str(err)[:200]}"))
+                    continue
+                _TRACE_CACHE[key] = report
+            reports.append(report)
+    return reports, errors
+
+
+def write_snapshots(root: str,
+                    specs: tuple[ProgramSpec, ...] = DEVICE_PROGRAMS,
+                    ) -> tuple[int, list[Diagnostic]]:
+    """Regenerate ``.kepljax.json`` from live traces → (count, errors)."""
+    reports, errors = _trace_all(specs)
+    payload = {
+        "version": SNAPSHOT_VERSION,
+        "comment": "kepljax golden program fingerprints (KTL123): "
+                   "normalized jaxpr structure per registry entry/case. "
+                   "Regenerate with `make kepljax-snapshots` after an "
+                   "INTENDED program change; review the diff. Never "
+                   "edit by hand.",
+        "programs": {r.key: fingerprint(r)
+                     for r in sorted(reports, key=lambda r: r.key)},
+    }
+    with open(snapshot_path(root), "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return len(reports), errors
+
+
+def analyze_device_programs(
+        root: str,
+        only: set[str] | None = None,
+        specs: tuple[ProgramSpec, ...] = DEVICE_PROGRAMS,
+) -> list[Diagnostic]:
+    """Trace every registry case and run the device-tier families.
+
+    ``only`` restricts to a subset of rule ids (the CLI's ``--only``);
+    trace/build failures always report (as KTL000).
+    """
+    from kepler_tpu.analysis.engine import REGISTRY
+
+    def want(rule_id: str) -> bool:
+        return only is None or rule_id in only
+
+    reports, diags = _trace_all(specs)
+    trace_rules = [REGISTRY[rid] for rid in ("KTL120", "KTL121", "KTL122")
+                   if want(rid)]
+    for report in reports:
+        for rule in trace_rules:
+            diags.extend(rule.check_trace(report))
+    if want("KTL123"):
+        ratchet = REGISTRY["KTL123"]
+        try:
+            snapshots = load_snapshots(root)
+        except ValueError as err:
+            diags.append(Diagnostic(
+                path=SNAPSHOT_NAME, line=1, col=1, rule_id="KTL123",
+                severity=SEVERITY_ERROR, message=str(err)))
+            snapshots = {}
+        if snapshots is None:
+            diags.append(Diagnostic(
+                path=SNAPSHOT_NAME, line=1, col=1, rule_id="KTL123",
+                severity=SEVERITY_ERROR,
+                message=f"missing {SNAPSHOT_NAME}; generate the golden "
+                        f"program snapshots with `make kepljax-snapshots` "
+                        f"and commit them"))
+        else:
+            for report in reports:
+                diags.extend(ratchet.check_snapshot(
+                    report, snapshots.get(report.key)))
+            live = {r.key for r in reports}
+            wanted_specs = {s.name for s in specs}
+            registered = {s.name for s in DEVICE_PROGRAMS}
+            for key in sorted(snapshots):
+                spec_name = key.rsplit("/", 1)[0]
+                # a snapshot key is stale when its case disappeared from
+                # an analyzed spec, OR its whole spec left the registry
+                # (a test analyzing a specs SUBSET must not false-flag
+                # the other still-registered programs' entries)
+                if key not in live and (spec_name in wanted_specs
+                                        or spec_name not in registered):
+                    diags.append(Diagnostic(
+                        path=SNAPSHOT_NAME, line=1, col=1,
+                        rule_id="KTL123", severity=SEVERITY_ERROR,
+                        message=f"stale snapshot entry {key!r} (program/"
+                                f"case no longer registered); regenerate "
+                                f"with `make kepljax-snapshots`"))
+    return sorted(diags)

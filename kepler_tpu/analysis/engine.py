@@ -35,6 +35,7 @@ from typing import Iterable, Iterator, Sequence
 
 __all__ = [
     "Baseline",
+    "DeviceRule",
     "Diagnostic",
     "FileContext",
     "LintResult",
@@ -61,7 +62,7 @@ SCOPED_TREES = ("kepler_tpu", "hack", "benchmarks")
 _DIRECTIVE = re.compile(
     r"#\s*keplint:\s*"
     r"(?P<kind>disable-file|disable|monotonic-only|hot-loop|"
-    r"guarded-by|requires-lock|donates|"
+    r"guarded-by|requires-lock|donates|layout-definition|"
     r"thread-role|role-boundary|role-registrar|forbid-role|allow-role|"
     r"taint-source|taint-sink|sanitizes)"
     r"(?:=(?P<arg>[A-Za-z0-9_,\- ]+))?")
@@ -251,6 +252,20 @@ class ProjectRule(Rule):
         return ()
 
     def check_project(self, project: "object") -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+class DeviceRule(Rule):
+    """A device-tier rule: checks TRACED jaxprs of the registered device
+    programs (``kepler_tpu/analysis/device/``), not source files. Runs
+    only when the CLI is invoked with ``--device-tier`` (traces cost
+    real seconds; the per-file tiers stay instant); registered here so
+    the catalog, SARIF driver and ``--list-rules`` stay complete."""
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        return ()
+
+    def check_trace(self, report: "object") -> Iterable[Diagnostic]:
         raise NotImplementedError
 
 

@@ -11,10 +11,13 @@ level — so the rules need no hardcoded knowledge of which module does
 what, and fixture tests exercise them hermetically.
 
 The package splits one module per rule family; importing it populates
-the registry. KTL101-110 run per file; KTL111-113 are
+the registry. KTL101-110 and KTL114 run per file; KTL111-113 are
 :class:`~kepler_tpu.analysis.engine.ProjectRule` families over the
 whole-program :class:`~kepler_tpu.analysis.project.ProjectContext`
-(call graph, thread roles, lock summaries, taint propagation).
+(call graph, thread roles, lock summaries, taint propagation);
+KTL120-123 are :class:`~kepler_tpu.analysis.engine.DeviceRule`
+families over traced device-program jaxprs
+(:mod:`kepler_tpu.analysis.device`, opt-in via ``--device-tier``).
 """
 
 from __future__ import annotations
@@ -32,3 +35,5 @@ from kepler_tpu.analysis.rules import spans  # noqa: F401  KTL109
 from kepler_tpu.analysis.rules import donate  # noqa: F401  KTL110
 from kepler_tpu.analysis.rules import taint  # noqa: F401  KTL112
 from kepler_tpu.analysis.rules import roles  # noqa: F401  KTL113
+from kepler_tpu.analysis.rules import layout  # noqa: F401  KTL114
+from kepler_tpu.analysis import device as _device  # noqa: F401  KTL120-123

@@ -606,7 +606,9 @@ class PackedWindowEngine:
     def _rebuild(self, rows: Sequence[RowInput], nb: int, wb: int,
                  zones_t: tuple[str, ...]) -> int:
         """Full re-pack: shape key or zone axis changed (or first window)."""
-        from kepler_tpu.parallel.packed import pack_fleet_inputs, packed_width
+        from kepler_tpu.parallel.packed import (PackedLayout,
+                                                pack_fleet_inputs,
+                                                packed_width)
 
         ordered = sorted(rows, key=lambda r: r.name)
         reports = [r.report for r in ordered]
@@ -642,8 +644,7 @@ class PackedWindowEngine:
                        + [None] * (nb - n_real))
         self._free = list(range(nb - 1, n_real - 1, -1))
         width = packed.shape[1]
-        self._empty_row = np.zeros(width, np.float32)
-        self._empty_row[:wb] = np.nan  # no valid workloads
+        self._empty_row = PackedLayout(wb, len(zones_t)).empty_row()
         self._stages = [np.zeros((0, width), np.float32)
                         for _ in self._stages]
         return n_real
@@ -994,12 +995,14 @@ class ShardedWindowEngine(PackedWindowEngine):
         rows, so clustering model nodes on a shard subset would multiply
         the whole mesh's estimator FLOPs by the imbalance). Only bucket/
         zone moves land here — a steady fleet never migrates a node."""
-        from kepler_tpu.parallel.packed import pack_fleet_inputs, packed_width
+        from kepler_tpu.parallel.packed import (PackedLayout,
+                                                pack_fleet_inputs)
 
         jax = self._jax
         k_sh = self.n_shards
         z = len(zones_t)
-        width = packed_width(wb, z)
+        layout = PackedLayout(wb, z)
+        width = layout.width
         by_name = sorted(rows, key=lambda r: r.name)
         ordered = ([r for r in by_name if r.report.mode == MODE_MODEL]
                    + [r for r in by_name if r.report.mode != MODE_MODEL])
@@ -1042,8 +1045,7 @@ class ShardedWindowEngine(PackedWindowEngine):
                     self._row_of[r.name] = base + j
                     self._names[base + j] = r.name
             else:
-                packed = np.zeros((sb, width), np.float32)
-                packed[:, :wb] = np.nan  # the packed empty row
+                packed = np.tile(layout.empty_row(), (sb, 1))
             shard_packed.append(packed)
             shard_idents.append([r.ident for r in members]
                                 + [_EMPTY] * (sb - n_real))
@@ -1063,8 +1065,7 @@ class ShardedWindowEngine(PackedWindowEngine):
         self._stage_i = 0
         self._key = (sb, wb, zones_t)
         self._width = width
-        self._empty_row = np.zeros(width, np.float32)
-        self._empty_row[:wb] = np.nan
+        self._empty_row = layout.empty_row()
         return h2d_shards
 
     def _delta_sync_shards(self, rows: Sequence[RowInput],

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from kepler_tpu.models.features import NUM_FEATURES
-from kepler_tpu.models.nn import glorot, layer_norm
+from kepler_tpu.models.nn import acc_matmul, glorot, layer_norm
 
 
 class BlockParams(TypedDict):
@@ -73,19 +73,17 @@ def block_fn(block, x: jax.Array,
     """One residual block: x [.., D] → [.., D]. ``block`` has NO stage axis —
     this is the uniform stage function the pipeline applies per device."""
     y = layer_norm(x, block["ln_scale"], block["ln_bias"])
-    y = y.astype(compute_dtype)
-    y = jax.nn.gelu(y @ block["w0"].astype(compute_dtype)
-                    + block["b0"].astype(compute_dtype))
-    return x + (y @ block["w1"].astype(compute_dtype)).astype(jnp.float32) \
-        + block["b1"]
+    # half operands, f32 accumulators (KTL120 dtype-flow)
+    y = jax.nn.gelu(acc_matmul(y, block["w0"], compute_dtype)
+                    + block["b0"])
+    return x + acc_matmul(y, block["w1"], compute_dtype) + block["b1"]
 
 
 def embed(params: DeepParams, features: jax.Array,
           compute_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
     """[.., F] → [.., D] (runs OUTSIDE the pipeline; it is one tiny matmul)."""
-    x = features.astype(compute_dtype) @ params["in_proj"].astype(
-        compute_dtype)
-    return x.astype(jnp.float32) + params["in_bias"]
+    x = acc_matmul(features, params["in_proj"], compute_dtype)
+    return x + params["in_bias"]
 
 
 def head(params: DeepParams, x: jax.Array, workload_valid: jax.Array,

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from kepler_tpu.models.features import NUM_FEATURES
-from kepler_tpu.models.nn import glorot
+from kepler_tpu.models.nn import acc_matmul, glorot
 
 
 class MLPParams(TypedDict):
@@ -61,7 +61,7 @@ def predict_mlp(
     clamp: bool = True,
     compute_dtype: jnp.dtype = jnp.bfloat16,
 ) -> jax.Array:
-    """→ watts f32 [..., W, Z]; bf16 matmuls, f32 accumulation at the end.
+    """→ watts f32 [..., W, Z]; bf16 matmul operands, f32 accumulators.
 
     Wide-and-deep: the ``w_skip`` path carries the dominant linear
     power-vs-CPU-time signal in full f32 (power models are linear to first
@@ -73,12 +73,12 @@ def predict_mlp(
     ``clamp`` as in ``predict_linear``: floor at 0 W for serving only —
     training needs gradients through negative raw outputs.
     """
-    x = features.astype(compute_dtype)
-    h = jax.nn.gelu(x @ params["w0"].astype(compute_dtype)
-                    + params["b0"].astype(compute_dtype))
-    h = jax.nn.gelu(h @ params["w1"].astype(compute_dtype)
-                    + params["b1"].astype(compute_dtype))
-    watts = (h @ params["w2"].astype(compute_dtype)).astype(jnp.float32)
+    cd = compute_dtype
+    # half operands, f32 accumulators throughout (KTL120 dtype-flow):
+    # gelu/bias arithmetic runs f32, each matmul re-casts its operands
+    h = jax.nn.gelu(acc_matmul(features, params["w0"], cd) + params["b0"])
+    h = jax.nn.gelu(acc_matmul(h, params["w1"], cd) + params["b1"])
+    watts = acc_matmul(h, params["w2"], cd)
     watts = watts + features.astype(jnp.float32) @ params["w_skip"]
     watts = watts + params["b2"]
     if clamp:

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from kepler_tpu.models.features import NUM_FEATURES
-from kepler_tpu.models.nn import glorot, layer_norm
+from kepler_tpu.models.nn import acc_matmul, glorot, layer_norm
 from kepler_tpu.ops.attention import full_attention
 
 
@@ -111,30 +111,30 @@ def temporal_trunk(
     h = N_HEADS
     cd = compute_dtype
 
-    x = feat_hist.astype(cd) @ params["in_proj"].astype(cd)
-    x = x.astype(jnp.float32) + params["pos_emb"][:t]
+    # half operands, f32 accumulators (KTL120 dtype-flow): every matmul
+    # goes through acc_matmul; residual/bias/softmax arithmetic stays f32
+    x = acc_matmul(feat_hist, params["in_proj"], cd)
+    x = x + params["pos_emb"][:t]
     x = jnp.where(t_valid[..., None], x, 0.0)
 
     # -- attention block (pre-LN, residual) --------------------------------
     y = layer_norm(x, params["ln1_scale"], params["ln1_bias"])
-    y16 = y.astype(cd)
-    q = (y16 @ params["wq"].astype(cd)).reshape(b, t, h, d // h)
-    k = (y16 @ params["wk"].astype(cd)).reshape(b, t, h, d // h)
-    v = (y16 @ params["wv"].astype(cd)).reshape(b, t, h, d // h)
+    q = acc_matmul(y, params["wq"], cd).reshape(b, t, h, d // h)
+    k = acc_matmul(y, params["wk"], cd).reshape(b, t, h, d // h)
+    v = acc_matmul(y, params["wv"], cd).reshape(b, t, h, d // h)
     if attention_fn is None:
         attn = full_attention(q, k, v, causal=True, t_valid=t_valid,
                               compute_dtype=cd)
     else:
         attn = attention_fn(q, k, v, t_valid)
     attn = attn.reshape(b, t, d)
-    x = x + (attn.astype(cd) @ params["wo"].astype(cd)).astype(jnp.float32)
+    x = x + acc_matmul(attn, params["wo"], cd)
 
     # -- MLP block ---------------------------------------------------------
-    y = layer_norm(x, params["ln2_scale"], params["ln2_bias"]).astype(cd)
-    y = jax.nn.gelu(y @ params["w_mlp0"].astype(cd)
-                    + params["b_mlp0"].astype(cd))
-    x = x + (y @ params["w_mlp1"].astype(cd)).astype(jnp.float32) \
-        + params["b_mlp1"]
+    y = layer_norm(x, params["ln2_scale"], params["ln2_bias"])
+    y = jax.nn.gelu(acc_matmul(y, params["w_mlp0"], cd)
+                    + params["b_mlp0"])
+    x = x + acc_matmul(y, params["w_mlp1"], cd) + params["b_mlp1"]
 
     return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
 
@@ -160,18 +160,18 @@ def _last_query_trunk(
     dh = d // h
     cd = compute_dtype
 
-    x = feat_hist.astype(cd) @ params["in_proj"].astype(cd)
-    x = x.astype(jnp.float32) + params["pos_emb"][:t]
+    x = acc_matmul(feat_hist, params["in_proj"], cd)
+    x = x + params["pos_emb"][:t]
     x = jnp.where(t_valid[..., None], x, 0.0)
     last = jnp.maximum(jnp.sum(t_valid, axis=-1) - 1, 0).astype(jnp.int32)
 
     y = layer_norm(x, params["ln1_scale"], params["ln1_bias"])
-    y16 = y.astype(cd)
-    y_last = jnp.take_along_axis(y16, last[:, None, None], axis=1)[:, 0]
-    q = (y_last @ params["wq"].astype(cd)).reshape(b, h, dh)
-    k = (y16 @ params["wk"].astype(cd)).reshape(b, t, h, dh)
-    v = (y16 @ params["wv"].astype(cd)).reshape(b, t, h, dh)
-    scores = jnp.einsum("bhd,bthd->bht", q, k).astype(jnp.float32)
+    y_last = jnp.take_along_axis(y, last[:, None, None], axis=1)[:, 0]
+    q = acc_matmul(y_last, params["wq"], cd).reshape(b, h, dh)
+    k = acc_matmul(y, params["wk"], cd).reshape(b, t, h, dh)
+    v = acc_matmul(y, params["wv"], cd).reshape(b, t, h, dh)
+    scores = jnp.einsum("bhd,bthd->bht", q.astype(cd), k.astype(cd),
+                        preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(dh, jnp.float32))
     # finite mask value (not -inf): an all-invalid window must yield 0
     # attention, not softmax(-inf…)=NaN — parity with full_attention's
@@ -181,19 +181,19 @@ def _last_query_trunk(
     # produce — full parity with the all-positions trunk.
     causal = jnp.arange(t, dtype=jnp.int32)[None, :] <= last[:, None]
     scores = jnp.where((t_valid & causal)[:, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cd)
+    probs = jax.nn.softmax(scores, axis=-1)
     any_valid = t_valid.any(axis=-1)
     probs = jnp.where(any_valid[:, None, None], probs, 0.0)
-    attn = jnp.einsum("bht,bthd->bhd", probs, v).reshape(b, d)
+    attn = jnp.einsum("bht,bthd->bhd", probs.astype(cd), v.astype(cd),
+                      preferred_element_type=jnp.float32).reshape(b, d)
 
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
-    x_last = x_last + (attn @ params["wo"].astype(cd)).astype(jnp.float32)
+    x_last = x_last + acc_matmul(attn, params["wo"], cd)
 
-    y = layer_norm(x_last, params["ln2_scale"], params["ln2_bias"]).astype(cd)
-    y = jax.nn.gelu(y @ params["w_mlp0"].astype(cd)
-                    + params["b_mlp0"].astype(cd))
-    x_last = x_last + (y @ params["w_mlp1"].astype(cd)).astype(jnp.float32) \
-        + params["b_mlp1"]
+    y = layer_norm(x_last, params["ln2_scale"], params["ln2_bias"])
+    y = jax.nn.gelu(acc_matmul(y, params["w_mlp0"], cd)
+                    + params["b_mlp0"])
+    x_last = x_last + acc_matmul(y, params["w_mlp1"], cd) + params["b_mlp1"]
     return layer_norm(x_last, params["ln_f_scale"], params["ln_f_bias"])
 
 

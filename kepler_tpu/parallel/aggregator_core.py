@@ -177,7 +177,7 @@ def shard_by_node(fn, mesh: Mesh, in_specs):
     layout, not semantics. check_vma=False because pallas_call defeats the
     varying-axes checker.
     """
-    from jax import shard_map
+    from kepler_tpu.parallel.compat import shard_map
 
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
                      out_specs=P(NODE_AXIS), check_vma=False)

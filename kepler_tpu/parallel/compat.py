@@ -1,0 +1,59 @@
+"""jax API compatibility seams for the parallel layer.
+
+The sharded programs are written against the current jax surface
+(``jax.shard_map`` with its ``check_vma`` varying-axes checker and
+``jax.lax.pcast`` for marking loop carries device-varying). Older
+toolchains — including CPU-only CI hosts pinned to jax 0.4.x — ship the
+same machinery as ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` replication checker and no ``pcast`` at all. This module
+is the single place that bridges the two so every shard-mapped program
+(ring/Ulysses attention, the GPipe pipeline, expert parallelism, the
+packed fleet program, ``shard_by_node``) builds — and therefore the
+device-tier analyzer (``kepler_tpu.analysis.device``) can trace them —
+on either toolchain.
+
+Semantics on the fallback path: ``pcast``-style varying marking does
+not exist, so the replication checker cannot validate the ring/pipeline
+carry pattern — ``shard_map`` therefore forces ``check_rep=False``
+there. The checker is a tracing-time diagnostic only; program semantics
+are unchanged (tests assert the sharded kernels still match their dense
+references bit-for-bit on the fallback path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+try:  # current surface: jax.shard_map(..., check_vma=...)
+    from jax import shard_map as _shard_map_new  # type: ignore[attr-defined]
+except ImportError:
+    _shard_map_new = None
+
+_PCAST = getattr(jax.lax, "pcast", None)
+
+
+def shard_map(f: Callable[..., Any], *, mesh: Any, in_specs: Any,
+              out_specs: Any, check_vma: bool = True) -> Callable[..., Any]:
+    """``jax.shard_map`` with a ``jax.experimental.shard_map`` fallback.
+
+    ``check_vma`` maps onto the old API's ``check_rep``; on the fallback
+    path it is forced off (see module docstring).
+    """
+    if _shard_map_new is not None:
+        return _shard_map_new(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    return _shard_map_old(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+
+def pcast_varying(x: Any, axis_name: str) -> Any:
+    """Mark ``x`` device-varying over ``axis_name`` (loop-carry hygiene
+    under the varying-axes checker); identity where ``pcast`` does not
+    exist — the fallback ``shard_map`` runs with the checker off."""
+    if _PCAST is None:
+        return x
+    return _PCAST(x, axis_name, to="varying")

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kepler_tpu.models.moe import MoEParams, expert_forward, gate_logits
+from kepler_tpu.parallel.compat import shard_map
 
 EXPERT_AXIS = "expert"
 
@@ -108,7 +109,7 @@ def make_expert_parallel_moe(
                                  capacity=capacity,
                                  compute_dtype=compute_dtype)
         experts = {k: params[k] for k in expert_keys}
-        return jax.shard_map(
+        return shard_map(
             body,
             mesh=mesh,
             in_specs=({k: P(axis_name) for k in expert_keys},

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import math
 import os
-from typing import Sequence
+from typing import Any, Sequence
 
 import jax
 from jax.sharding import Mesh
@@ -91,7 +91,7 @@ def initialize_multihost(
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if not addr:
         return False
-    kwargs = {"coordinator_address": addr}
+    kwargs: dict[str, Any] = {"coordinator_address": addr}
     nproc = (num_processes if num_processes is not None
              else os.environ.get("JAX_NUM_PROCESSES"))
     pid = (process_id if process_id is not None

@@ -30,18 +30,22 @@ program.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from kepler_tpu.parallel.aggregator_core import (
+    FleetResult,
     fleet_attribution_program,
     mix_model_watts,
     resolve_attribute_fn,
     shard_by_node,
 )
-from kepler_tpu.parallel.fleet import MODE_MODEL, FleetBatch
+from kepler_tpu.parallel.fleet import MODE_MODEL, FleetBatch, NodeReport
 from kepler_tpu.parallel.mesh import NODE_AXIS
 from kepler_tpu.models.estimator import predictor
 
@@ -52,9 +56,72 @@ ROW_NODE_ACTIVE = -2
 ROW_NODE_TOTAL = -1
 
 
+# keplint: layout-definition
+@dataclass(frozen=True)
+class PackedLayout:
+    """THE packed input-row layout — the single source of truth.
+
+    One f32 row is ``cpu[W] | zone[Z] | zone_valid[Z] | ratio, denom,
+    dt, mode``. Every producer and consumer of packed rows — the jitted
+    device programs here, the ``fleet.window`` staging engines, and the
+    pure-NumPy rung-3 mirror (:func:`numpy_fleet_window`) — derives its
+    offsets from this class, so the jax program and its host fallback
+    cannot drift apart silently. Raw layout-offset arithmetic anywhere
+    outside this class is a keplint finding (KTL114 ``packed-layout``);
+    this is the only ``layout-definition``-marked scope.
+    """
+
+    n_workloads: int
+    n_zones: int
+
+    @property
+    def width(self) -> int:
+        """Total packed row width."""
+        return self.n_workloads + 2 * self.n_zones + 4
+
+    @property
+    def cpu(self) -> slice:
+        """Per-workload cpu-delta columns (NaN = invalid slot)."""
+        return slice(0, self.n_workloads)
+
+    @property
+    def zone(self) -> slice:
+        """Per-zone energy-delta columns (µJ)."""
+        return slice(self.n_workloads, self.n_workloads + self.n_zones)
+
+    @property
+    def zone_valid(self) -> slice:
+        """Per-zone validity columns (0.0/1.0)."""
+        return slice(self.n_workloads + self.n_zones,
+                     self.n_workloads + 2 * self.n_zones)
+
+    @property
+    def col_ratio(self) -> int:
+        return self.n_workloads + 2 * self.n_zones + 0
+
+    @property
+    def col_denom(self) -> int:
+        return self.n_workloads + 2 * self.n_zones + 1
+
+    @property
+    def col_dt(self) -> int:
+        return self.n_workloads + 2 * self.n_zones + 2
+
+    @property
+    def col_mode(self) -> int:
+        return self.n_workloads + 2 * self.n_zones + 3
+
+    def empty_row(self) -> np.ndarray:
+        """One packed row holding no node: zeros, cpu columns NaN (no
+        valid workload slots) — what cleared resident rows scatter."""
+        row = np.zeros(self.width, np.float32)
+        row[self.cpu] = np.nan
+        return row
+
+
 def packed_width(n_workloads: int, n_zones: int) -> int:
     """Row width of the packed INPUT layout."""
-    return n_workloads + 2 * n_zones + 4
+    return PackedLayout(n_workloads, n_zones).width
 
 
 def pack_fleet_inputs(batch: FleetBatch,
@@ -66,21 +133,23 @@ def pack_fleet_inputs(batch: FleetBatch,
     mis-shaped.
     """
     n, w, z = batch.shape
-    if out is None or out.shape != (n, w + 2 * z + 4):
-        out = np.empty((n, w + 2 * z + 4), np.float32)
+    lay = PackedLayout(w, z)
+    if out is None or out.shape != (n, lay.width):
+        out = np.empty((n, lay.width), np.float32)
     # invalid workload slots ride as NaN in the cpu column — no separate
     # mask plane needed in the packed layout
-    out[:, :w] = np.where(batch.workload_valid, batch.cpu_deltas, np.nan)
-    out[:, w: w + z] = batch.zone_deltas_uj
-    out[:, w + z: w + 2 * z] = batch.zone_valid
-    out[:, w + 2 * z + 0] = batch.usage_ratio
-    out[:, w + 2 * z + 1] = batch.node_cpu_delta
-    out[:, w + 2 * z + 2] = batch.dt_s
-    out[:, w + 2 * z + 3] = batch.mode
+    out[:, lay.cpu] = np.where(batch.workload_valid, batch.cpu_deltas,
+                               np.nan)
+    out[:, lay.zone] = batch.zone_deltas_uj
+    out[:, lay.zone_valid] = batch.zone_valid
+    out[:, lay.col_ratio] = batch.usage_ratio
+    out[:, lay.col_denom] = batch.node_cpu_delta
+    out[:, lay.col_dt] = batch.dt_s
+    out[:, lay.col_mode] = batch.mode
     return out
 
 
-def pack_reports_into(out: np.ndarray, reports,
+def pack_reports_into(out: np.ndarray, reports: Sequence[NodeReport],
                       zone_deltas_mat: np.ndarray,
                       zone_valid_mat: np.ndarray,
                       n_workloads: int) -> None:
@@ -90,9 +159,9 @@ def pack_reports_into(out: np.ndarray, reports,
     planes and the NaN-merge pass the two-step route pays are real
     milliseconds at fleet scale. Rows beyond each report's workload
     count stay NaN (invalid)."""
-    n, w = len(reports), n_workloads
-    z = zone_deltas_mat.shape[1]
-    out[:n, :w] = np.nan
+    n = len(reports)
+    lay = PackedLayout(n_workloads, zone_deltas_mat.shape[1])
+    out[:n, lay.cpu] = np.nan
     lengths = np.fromiter((len(r.cpu_deltas) for r in reports),
                           np.int64, n)
     total = int(lengths.sum())
@@ -103,32 +172,35 @@ def pack_reports_into(out: np.ndarray, reports,
         starts = np.concatenate(([0], np.cumsum(lengths)[:-1]))
         cols = np.arange(total) - np.repeat(starts, lengths)
         out[rows, cols] = flat
-    out[:n, w: w + z] = zone_deltas_mat
-    out[:n, w + z: w + 2 * z] = zone_valid_mat
-    out[:n, w + 2 * z + 0] = np.fromiter(
+    out[:n, lay.zone] = zone_deltas_mat
+    out[:n, lay.zone_valid] = zone_valid_mat
+    out[:n, lay.col_ratio] = np.fromiter(
         (r.usage_ratio for r in reports), np.float64, n)
-    out[:n, w + 2 * z + 1] = np.fromiter(
+    out[:n, lay.col_denom] = np.fromiter(
         (r.node_cpu_delta for r in reports), np.float64, n)
-    out[:n, w + 2 * z + 2] = np.fromiter(
+    out[:n, lay.col_dt] = np.fromiter(
         (r.dt_s for r in reports), np.float64, n)
-    out[:n, w + 2 * z + 3] = np.fromiter(
+    out[:n, lay.col_mode] = np.fromiter(
         (r.mode for r in reports), np.int64, n)
 
 
-def _unpack_fields(packed: jax.Array, w: int, z: int):
-    cpu_nan = packed[:, :w]
+def _unpack_fields(packed: jax.Array, w: int, z: int) -> tuple[
+        jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array,
+        jax.Array, jax.Array]:
+    lay = PackedLayout(w, z)
+    cpu_nan = packed[:, lay.cpu]
     workload_valid = ~jnp.isnan(cpu_nan)
     cpu = jnp.where(workload_valid, cpu_nan, 0.0)
-    zone = packed[:, w: w + z]
-    zone_valid = packed[:, w + z: w + 2 * z] > 0.5
-    ratio = packed[:, w + 2 * z + 0]
-    denom = packed[:, w + 2 * z + 1]
-    dt = packed[:, w + 2 * z + 2]
-    mode = packed[:, w + 2 * z + 3].astype(jnp.int32)
+    zone = packed[:, lay.zone]
+    zone_valid = packed[:, lay.zone_valid] > 0.5
+    ratio = packed[:, lay.col_ratio]
+    denom = packed[:, lay.col_denom]
+    dt = packed[:, lay.col_dt]
+    mode = packed[:, lay.col_mode].astype(jnp.int32)
     return cpu, workload_valid, zone, zone_valid, ratio, denom, dt, mode
 
 
-def _pack_watts_f16(res) -> jax.Array:
+def _pack_watts_f16(res: FleetResult) -> jax.Array:
     """FleetResult → one f16 [N, W+2, Z] output (one D2H), in watts."""
     watts = res.workload_power_uw * 1e-6  # µW → W for f16 range
     active = res.node_active_power_uw[:, None, :] * 1e-6
@@ -141,7 +213,7 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
                               model_mode: str | None = None,
                               backend: str = "einsum",
                               model_bucket: int | None = None,
-                              local_model_rows: bool = False):
+                              local_model_rows: bool = False) -> Callable:
     """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+2, Z]``.
 
     W and Z are static (they define the packing layout); N stays dynamic
@@ -173,7 +245,8 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
         # packed wire format is the quantizer either way).
         base_fn = predict_fn
 
-        def predict_fn(params, feats, valid, _fn=base_fn):
+        def predict_fn(params: Any, feats: jax.Array, valid: jax.Array,
+                       _fn: Callable = base_fn) -> jax.Array:
             return _fn(params, feats, valid, compute_dtype=jnp.float32)
 
     w, z = n_workloads, n_zones
@@ -184,7 +257,8 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
             "sparse model evaluation (model_bucket) requires the einsum "
             f"backend; got {backend!r}")
 
-    def unpack_and_attribute(model_params, packed):
+    def unpack_and_attribute(model_params: Any,
+                             packed: jax.Array) -> jax.Array:
         fields = _unpack_fields(packed, w, z)
         cpu, workload_valid, zone, zone_valid, ratio, denom, dt, mode = fields
         res = fleet_attribution_program(
@@ -193,7 +267,8 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
             attribute_fn=attribute_fn)
         return _pack_watts_f16(res)
 
-    def unpack_and_attribute_sparse(model_params, packed, model_rows):
+    def unpack_and_attribute_sparse(model_params: Any, packed: jax.Array,
+                                    model_rows: jax.Array) -> jax.Array:
         from kepler_tpu.models.features import build_features
 
         fields = _unpack_fields(packed, w, z)
@@ -213,7 +288,7 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
                                                mode, dt))
 
     if sparse and local_model_rows:
-        from jax.experimental.shard_map import shard_map
+        from kepler_tpu.parallel.compat import shard_map
 
         # per-shard body: every array is the shard's LOCAL block, so the
         # pad/clamp/drop index space is the local row count and no
@@ -277,7 +352,7 @@ def _numpy_features(cpu: np.ndarray, valid: np.ndarray, denom: np.ndarray,
     return np.where(valid[..., None], feats, 0.0)
 
 
-def _numpy_model_watts(model_mode: str, params, feats: np.ndarray,
+def _numpy_model_watts(model_mode: str, params: Any, feats: np.ndarray,
                        valid: np.ndarray) -> np.ndarray | None:
     """NumPy forward for the estimators the host rung can serve (linear,
     mlp — the shipped default). → watts f32 [N, W, Z], or None when the
@@ -307,7 +382,7 @@ def _numpy_model_watts(model_mode: str, params, feats: np.ndarray,
 
 
 def numpy_fleet_window(packed: np.ndarray, n_workloads: int, n_zones: int,
-                       params=None,
+                       params: Any = None,
                        model_mode: str | None = None) -> np.ndarray:
     """Pure-NumPy mirror of the packed fleet program — the aggregator's
     host-fallback rung (docs/developer/resilience.md "Device-plane
@@ -323,15 +398,16 @@ def numpy_fleet_window(packed: np.ndarray, n_workloads: int, n_zones: int,
     and the ladder's health probe names the degraded rung.
     """
     w, z = n_workloads, n_zones
-    cpu_nan = packed[:, :w]
+    lay = PackedLayout(w, z)
+    cpu_nan = packed[:, lay.cpu]
     valid = ~np.isnan(cpu_nan)
     cpu = np.where(valid, cpu_nan, 0.0).astype(np.float32)
-    zone = packed[:, w: w + z]
-    zone_valid = packed[:, w + z: w + 2 * z] > 0.5
-    ratio = packed[:, w + 2 * z + 0]
-    denom = packed[:, w + 2 * z + 1]
-    dt = packed[:, w + 2 * z + 2]
-    mode = packed[:, w + 2 * z + 3].astype(np.int32)
+    zone = packed[:, lay.zone]
+    zone_valid = packed[:, lay.zone_valid] > 0.5
+    ratio = packed[:, lay.col_ratio]
+    denom = packed[:, lay.col_denom]
+    dt = packed[:, lay.col_dt]
+    mode = packed[:, lay.col_mode].astype(np.int32)
 
     # node split (ops.attribution._node_split, NumPy)
     deltas = np.where(zone_valid, zone, 0.0).astype(np.float32)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from kepler_tpu.parallel.compat import pcast_varying, shard_map
+
 STAGE_AXIS = "stage"
 
 
@@ -61,8 +63,8 @@ def _pp_shard(stage_params, x_mb, *, axis_name, stage_fn):
 
     # zeros-initialised carries must be marked device-varying over the stage
     # axis up front or the fori_loop carry types mismatch (shard_map vma rule)
-    state = jax.lax.pcast(jnp.zeros_like(x_mb[0]), axis_name, to="varying")
-    out = jax.lax.pcast(jnp.zeros_like(x_mb), axis_name, to="varying")
+    state = pcast_varying(jnp.zeros_like(x_mb[0]), axis_name)
+    out = pcast_varying(jnp.zeros_like(x_mb), axis_name)
     _, out = jax.lax.fori_loop(0, m + n - 1, tick, (state, out))
     # every stage wrote a buffer; only the last stage's is the answer —
     # zero the rest and psum so the result replicates
@@ -94,7 +96,7 @@ def make_pipeline(
             raise ValueError(
                 f"batch {b} not divisible by {n_microbatches} microbatches")
         x_mb = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
-        out = jax.shard_map(
+        out = shard_map(
             body,
             mesh=mesh,
             in_specs=(P(axis_name), P()),

@@ -45,16 +45,18 @@ def ids(diags):
 
 
 class TestEngine:
-    def test_registry_has_thirteen_domain_rules(self):
+    def test_registry_has_eighteen_domain_rules(self):
         rules = all_rules()
         assert [r.id for r in rules] == sorted(r.id for r in rules)
-        assert len(rules) == 13
-        assert len({r.name for r in rules}) == 13
+        assert len(rules) == 18
+        assert len({r.name for r in rules}) == 18
         for r in rules:
             assert r.summary and r.rationale, f"{r.id} lacks docs"
-        # ISSUE 9: the whole-program families are registered
         ids = {r.id for r in rules}
+        # ISSUE 9: the whole-program families are registered
         assert {"KTL111", "KTL112", "KTL113"} <= ids
+        # ISSUE 10: the layout contract + device-tier families
+        assert {"KTL114", "KTL120", "KTL121", "KTL122", "KTL123"} <= ids
 
     def test_syntax_error_reports_ktl000(self, lint):
         diags = lint("def broken(:\n")
@@ -1018,6 +1020,62 @@ class TestCLI:
         out = capsys.readouterr().out
         for rid in ("KTL101", "KTL108"):
             assert rid in out
+
+
+class TestPackedLayoutRule:
+    """KTL114: packed row-layout offsets live only in the
+    `layout-definition` scope (ISSUE 10, satellite 1)."""
+
+    REL = "kepler_tpu/parallel/packed.py"
+
+    def test_bad_raw_offset_arithmetic(self, lint):
+        diags = lint("""
+            def unpack(packed, w, z):
+                return packed[:, w + 2 * z + 1]
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL114"]
+        assert "PackedLayout" in diags[0].message
+
+    def test_bad_slice_bound_with_literal_mult(self, lint):
+        diags = lint("""
+            def zones(packed, w, z):
+                packed[:, w + z: w + 2 * z] = 0.0
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL114"]
+
+    def test_bad_in_window_module_too(self, lint):
+        diags = lint("""
+            def stage(out, wb, zb):
+                out[:, wb + 2 * zb + 3] = 1
+        """, rel="kepler_tpu/fleet/window.py")
+        assert ids(diags) == ["KTL114"]
+
+    def test_good_layout_definition_scope_is_exempt(self, lint):
+        diags = lint("""
+            # keplint: layout-definition
+            class PackedLayout:
+                def ratio(self, packed, w, z):
+                    return packed[:, w + 2 * z + 0]
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_good_row_and_shard_indexing_stays_legal(self, lint):
+        diags = lint("""
+            def shardwork(mode_arr, counts, base, sb, k, mb, changed):
+                a = mode_arr[k * sb:(k + 1) * sb]
+                b = counts[base:base + sb]
+                c = counts[:len(changed)]
+                d = counts[k * mb + len(changed)]
+                return a, b, c, d
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_good_other_modules_out_of_scope(self, lint):
+        diags = lint("""
+            def unscoped(packed, w, z):
+                return packed[:, w + 2 * z + 1]
+        """, rel="kepler_tpu/ops/mod.py")
+        assert diags == []
 
 
 class TestShippedTreeIsClean:

@@ -1,0 +1,160 @@
+"""Seeded property sweep: ``numpy_fleet_window`` ≡ the packed device
+program.
+
+The NumPy mirror is the degradation ladder's rung-3 lifeline — it keeps
+the aggregator publishing with the device plane completely dead — so it
+must track the jax program's packed row layout and math exactly, across
+every bucket shape the ladders produce. Until now it had example-based
+tests only; this sweep pins it property-style:
+
+- against the f32 jax reference (`fleet_attribution_program` at f32
+  compute) the mirror is EXACT to float tolerance;
+- against the shipped packed-f16 program it stays inside the 0.5%
+  wire-quantization budget;
+
+over seeded random fleets spanning bucket shapes, pad-row edges
+(buckets larger than the live fleet, zero-workload rows), and mixed
+MODE_MODEL/MODE_RATIO populations. Both sides consume the SAME packed
+array built through `PackedLayout`, so a layout regression (KTL114's
+subject) fails here too.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kepler_tpu.models.mlp import init_mlp  # noqa: E402
+from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO  # noqa: E402
+from kepler_tpu.parallel.mesh import make_mesh  # noqa: E402
+from kepler_tpu.parallel.packed import (  # noqa: E402
+    PackedLayout,
+    make_packed_fleet_program,
+    numpy_fleet_window,
+    unpack_fleet_window,
+)
+
+# (n_live, node_bucket, w_max, workload_bucket, zones, model_fraction)
+SWEEP = [
+    (1, 4, 3, 4, 1, 0.0),
+    (3, 8, 5, 8, 2, 0.5),
+    (8, 8, 1, 1, 1, 0.0),  # minimal ladder rung, no pad columns
+    (6, 16, 7, 8, 2, 1.0),  # all-model fleet, half the bucket padded
+    (5, 8, 4, 8, 3, 0.3),
+]
+
+
+def _random_packed(rng: np.random.Generator, n_live: int, nb: int,
+                   w_max: int, wb: int, z: int,
+                   model_fraction: float) -> np.ndarray:
+    """Build a packed batch the way the window engine would: live rows
+    with ragged workload counts, pad rows empty (cpu NaN, zeros)."""
+    lay = PackedLayout(wb, z)
+    packed = np.tile(lay.empty_row(), (nb, 1))
+    for i in range(n_live):
+        w_real = int(rng.integers(1, w_max + 1))
+        row = packed[i]
+        row[lay.cpu][:w_real] = rng.uniform(0.0, 5e5, w_real)
+        row[lay.zone] = rng.uniform(0.0, 2e6, z)
+        row[lay.zone_valid] = (rng.uniform(size=z) > 0.2).astype(np.float32)
+        row[lay.col_ratio] = rng.uniform(0.0, 1.0)
+        row[lay.col_denom] = rng.uniform(1.0, 2e6)
+        row[lay.col_dt] = rng.uniform(0.5, 5.0)
+        row[lay.col_mode] = (MODE_MODEL
+                             if rng.uniform() < model_fraction
+                             else MODE_RATIO)
+    return packed
+
+
+def _f32_reference(packed: np.ndarray, wb: int, z: int,
+                   params) -> np.ndarray:
+    """The f32 jax reference: unpack via PackedLayout, run the unpacked
+    fleet program at f32 compute, re-pack the [N, W+2, Z] watts array."""
+    from kepler_tpu.models.estimator import predictor
+    from kepler_tpu.parallel.aggregator_core import (
+        fleet_attribution_program)
+
+    lay = PackedLayout(wb, z)
+    cpu_nan = packed[:, lay.cpu]
+    valid = ~np.isnan(cpu_nan)
+    cpu = np.where(valid, cpu_nan, 0.0).astype(np.float32)
+    predict_fn = functools.partial(predictor("mlp"),
+                                   compute_dtype=jnp.float32)
+    res = fleet_attribution_program(
+        params,
+        jnp.asarray(packed[:, lay.zone]),
+        jnp.asarray(packed[:, lay.zone_valid] > 0.5),
+        jnp.asarray(packed[:, lay.col_ratio]),
+        jnp.asarray(cpu),
+        jnp.asarray(valid),
+        jnp.asarray(packed[:, lay.col_denom]),
+        jnp.asarray(packed[:, lay.col_dt]),
+        jnp.asarray(packed[:, lay.col_mode].astype(np.int32)),
+        predict_fn=predict_fn,
+    )
+    watts = np.asarray(res.workload_power_uw) * 1e-6
+    active = np.asarray(res.node_active_power_uw)[:, None, :] * 1e-6
+    total = np.asarray(res.node_power_uw)[:, None, :] * 1e-6
+    return np.concatenate([watts, active, total], axis=1)
+
+
+@pytest.mark.parametrize(
+    "n_live,nb,w_max,wb,z,model_fraction", SWEEP,
+    ids=[f"n{c[0]}of{c[1]}_w{c[3]}_z{c[4]}_m{int(c[5] * 100)}"
+         for c in SWEEP])
+def test_numpy_mirror_matches_device_program(n_live, nb, w_max, wb, z,
+                                             model_fraction):
+    rng = np.random.default_rng(nb * 1000 + wb * 10 + z)
+    packed = _random_packed(rng, n_live, nb, w_max, wb, z, model_fraction)
+    params = init_mlp(jax.random.PRNGKey(7), n_zones=z)
+
+    mirror = numpy_fleet_window(packed, wb, z, params=dict(params),
+                                model_mode="mlp")
+    assert mirror.shape == (nb, wb + 2, z)
+    assert mirror.dtype == np.float32
+
+    # f32-exact leg: the mirror IS the program's math
+    ref = _f32_reference(packed, wb, z, params)
+    np.testing.assert_allclose(mirror, ref, rtol=2e-5, atol=1e-6)
+
+    # f16 budget leg: the shipped packed program quantizes to the wire
+    # format; the mirror must sit inside the 0.5% budget against it
+    mesh = make_mesh((1,), ("node",), devices=jax.devices()[:1])
+    program = make_packed_fleet_program(mesh, n_workloads=wb, n_zones=z,
+                                        model_mode="mlp")
+    f16 = np.asarray(program(dict(params), jnp.asarray(packed)),
+                     np.float32)
+    scale = np.maximum(np.abs(mirror), 1e-3)  # watts below 1 mW are noise
+    rel = np.abs(f16 - mirror) / scale
+    assert float(rel.max()) <= 5e-3, (
+        f"mirror vs f16 program rel error {rel.max():.2%} > 0.5% budget")
+
+
+def test_pad_rows_publish_zero_watts():
+    """Empty bucket rows (the pad the ladders append) must come back as
+    exactly zero watts from both the mirror and the unpack helpers."""
+    wb, z, nb = 4, 2, 8
+    rng = np.random.default_rng(0)
+    packed = _random_packed(rng, 3, nb, 3, wb, z, 0.5)
+    mirror = numpy_fleet_window(packed, wb, z)
+    wl, active, total = unpack_fleet_window(mirror)
+    assert wl.shape == (nb, wb, z)
+    np.testing.assert_array_equal(wl[3:], 0.0)
+    np.testing.assert_array_equal(active[3:], 0.0)
+    np.testing.assert_array_equal(total[3:], 0.0)
+
+
+def test_mirror_moe_mode_publishes_absence_not_fabrication():
+    """Modes without a NumPy mirror (moe/deep) must publish ZERO model
+    watts — absence — rather than garbage or a crash."""
+    wb, z, nb = 3, 2, 4
+    rng = np.random.default_rng(1)
+    packed = _random_packed(rng, 4, nb, 3, wb, z, 1.0)
+    out = numpy_fleet_window(packed, wb, z, params={"bogus": 1},
+                             model_mode="moe")
+    np.testing.assert_array_equal(out, 0.0 * out)
