@@ -71,28 +71,50 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 
 def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              interval: float = 5.0, workloads: int = 100,
-             model_mode: str | None = "mlp") -> dict:
+             model_mode: str | None = "mlp", replicas: int = 1,
+             kill_at: float = 0.0) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
-    from kepler_tpu.fleet.wire import encode_report
+    from kepler_tpu.fleet.wire import encode_report, restamp_transmit
     from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
     from kepler_tpu.parallel.mesh import make_mesh
     from kepler_tpu.server.http import APIServer
     from kepler_tpu.service.lifecycle import CancelContext
 
-    server = APIServer(listen_addresses=["127.0.0.1:0"])
-    server.init()
-    agg = Aggregator(server, interval=interval, stale_after=interval * 3,
-                     model_mode=model_mode, node_bucket=64,
-                     workload_bucket=128, pipeline_depth=2)
-    agg._mesh = make_mesh()
-    agg.init()
-    ctx = CancelContext()
-    threads = [threading.Thread(target=server.run, args=(ctx,), daemon=True),
-               threading.Thread(target=agg.run, args=(ctx,), daemon=True)]
+    # multi-replica topology (ISSUE 11): N aggregator replicas sharing
+    # the consistent-hash ingest ring; agents follow 421 owner
+    # redirects and fail over between replicas. --kill-at shuts one
+    # replica down mid-soak and rebalances the survivors (epoch 2) —
+    # the gate then requires ZERO windows lost across the hand-off.
+    replicas = max(1, int(replicas))
+    servers: list[APIServer] = []
+    for _ in range(replicas):
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        servers.append(s)
+    peers = [f"{h}:{p}" for (h, p) in (s.addresses[0] for s in servers)]
+    aggs: list[Aggregator] = []
+    ctxs: list[CancelContext] = []
+    threads: list[threading.Thread] = []
+    for i, server in enumerate(servers):
+        agg = Aggregator(server, interval=interval,
+                         stale_after=interval * 3,
+                         model_mode=model_mode, node_bucket=64,
+                         workload_bucket=128, pipeline_depth=2,
+                         peers=peers if replicas > 1 else None,
+                         self_peer=peers[i] if replicas > 1 else "")
+        agg._mesh = make_mesh()
+        agg.init()
+        ctx = CancelContext()
+        threads += [
+            threading.Thread(target=server.run, args=(ctx,), daemon=True),
+            threading.Thread(target=agg.run, args=(ctx,), daemon=True)]
+        aggs.append(agg)
+        ctxs.append(ctx)
     for t in threads:
         t.start()
     time.sleep(0.2)
-    host, port = server.addresses[0]
+    victim = replicas - 1 if replicas > 1 and kill_at > 0 else -1
+    live = set(range(replicas))
 
     rng = np.random.default_rng(0)
     zones = ["package", "core", "dram", "uncore"]
@@ -103,6 +125,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         [] for _ in range(n_agents)]
     rejects = np.zeros(n_agents, np.int64)
     errors = np.zeros(n_agents, np.int64)
+    redirects = np.zeros(n_agents, np.int64)
     stop = threading.Event()
 
     def agent(idx: int) -> None:
@@ -123,30 +146,74 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             mode=MODE_MODEL if idx % 2 else MODE_RATIO,
             workload_kinds=np.ones(workloads, np.int8),
         )
-        conn = http.client.HTTPConnection(host, port, timeout=30)
+        t_idx = idx % len(peers)
+
+        def connect():
+            h, _, p = peers[t_idx].rpartition(":")
+            return http.client.HTTPConnection(h, int(p), timeout=30)
+
+        conn = connect()
         seq = 0
+        acked = 0
+        epoch = 0
         # de-synchronized start so 1000 agents don't phase-lock
         time.sleep((idx / n_agents) * interval)
         lat = latencies[idx]
         while not stop.is_set():
             seq += 1
-            body = encode_report(rep, zones, seq=seq, run=f"r{idx}")
-            t0 = time.perf_counter()
-            try:
-                conn.request("POST", "/v1/report", body=body)
-                resp = conn.getresponse()
-                resp.read()
-                status = resp.status
-            except OSError:
-                errors[idx] += 1
-                conn.close()
-                conn = http.client.HTTPConnection(host, port, timeout=30)
-                stop.wait(interval)  # no tight reconnect spin
-                continue
-            lat.append((time.monotonic(),
-                        (time.perf_counter() - t0) * 1e3))
-            if status != 204:
-                rejects[idx] += 1
+            base = encode_report(rep, zones, seq=seq, run=f"r{idx}")
+            # at-least-once: retry THIS seq until a replica concludes
+            # it — a replica outage then shows up as duplicates and
+            # redirects, never as a seq-gap loss, which is exactly what
+            # the multi-replica gate asserts
+            while not stop.is_set():
+                # sent_at is semantically WALL time: the aggregator's
+                # skew quarantine compares it against its own wall clock
+                # keplint: disable=KTL101
+                body = restamp_transmit(base, time.time(),
+                                        owner=peers[t_idx], epoch=epoch,
+                                        acked_through=acked)
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/v1/report", body=body)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                except OSError:
+                    errors[idx] += 1
+                    conn.close()
+                    t_idx = (t_idx + 1) % len(peers)  # failover
+                    conn = connect()
+                    stop.wait(min(0.25, interval))  # no reconnect spin
+                    continue
+                if status == 421:
+                    redirects[idx] += 1
+                    owner = ""
+                    try:
+                        payload = json.loads(data)
+                        owner = payload.get("owner", "")
+                        epoch = max(epoch, int(payload.get("epoch", 0)))
+                    except (ValueError, TypeError):
+                        pass
+                    t_idx = (peers.index(owner) if owner in peers
+                             else (t_idx + 1) % len(peers))
+                    conn.close()
+                    conn = connect()
+                    continue
+                if status >= 500:
+                    errors[idx] += 1
+                    conn.close()
+                    t_idx = (t_idx + 1) % len(peers)
+                    conn = connect()
+                    stop.wait(min(0.25, interval))
+                    continue
+                lat.append((time.monotonic(),
+                            (time.perf_counter() - t0) * 1e3))
+                if status == 204:
+                    acked = seq
+                else:
+                    rejects[idx] += 1
+                break
             stop.wait(interval)
         conn.close()
 
@@ -157,6 +224,25 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
               for i in range(n_agents)]
     for t in agents:
         t.start()
+
+    killer = None
+    if victim >= 0:
+        def kill_and_rebalance() -> None:
+            # the chaos leg: one replica goes dark mid-soak, survivors
+            # adopt the shrunken membership at epoch 2 — displaced
+            # agents fail over, follow redirects, and the gate proves
+            # no window was lost across the hand-off
+            ctxs[victim].cancel()
+            servers[victim].shutdown()
+            aggs[victim].shutdown()
+            live.discard(victim)
+            surviving = [p for i, p in enumerate(peers) if i != victim]
+            for i in live:
+                aggs[i].apply_membership(surviving, 2)
+
+        killer = threading.Timer(max(0.0, kill_at), kill_and_rebalance)
+        killer.daemon = True
+        killer.start()
     # ramp: wait until every agent has had a chance to connect+report and
     # a couple of attribution windows completed (first-window jit compile
     # memory and GIL stalls are one-time), so the steady-state baselines
@@ -164,8 +250,10 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     # The plateau is still reported, as soak_rss_ramp_mib.
     ramp_deadline = time.monotonic() + min(4 * interval, seconds)
     while time.monotonic() < ramp_deadline:
-        if (agg._stats["attributions_total"] >= 2
-                and time.monotonic() - t_start >= interval):
+        done = sum(aggs[i]._stats["attributions_total"]
+                   for i in sorted(live))
+        if done >= 2 * len(live) \
+                and time.monotonic() - t_start >= interval:
             break
         time.sleep(0.25)
     time.sleep(1.0)  # let compile-peak allocations settle before baselining
@@ -176,9 +264,26 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     for t in agents:
         t.join(timeout=10)
     duration = time.monotonic() - t_start
-    stats = dict(agg._stats)
-    ctx.cancel()
-    server.shutdown()
+    if killer is not None:
+        killer.cancel()  # no-op when it already fired
+    # surviving-replica stats: counters sum, per-window last_* figures
+    # take the max (summing latencies across replicas would be a lie)
+    live_aggs = [aggs[i] for i in sorted(live)]
+    stats = dict(live_aggs[0]._stats)
+    for a in live_aggs[1:]:
+        for k, v in a._stats.items():
+            cur = stats.get(k)
+            if not isinstance(v, (int, float)) \
+                    or not isinstance(cur, (int, float)):
+                continue
+            if k.startswith("last_") and k.endswith("_ms"):
+                stats[k] = max(cur, v)
+            else:
+                stats[k] = cur + v
+    for ctx in ctxs:
+        ctx.cancel()
+    for i in sorted(live):
+        servers[i].shutdown()
     rss_end = rss_mib()
 
     all_samples = [tv for lat in latencies for tv in lat]
@@ -207,6 +312,11 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         "soak_compile_count": int(stats["window_compiles_total"]),
         "soak_rss_ramp_mib": round(rss_start - rss_boot, 1),
         "soak_rss_growth_mib": round(rss_end - rss_start, 1),
+        "soak_replicas": replicas,
+        "soak_replica_killed": victim >= 0,
+        "soak_redirects": int(redirects.sum()),
+        "soak_windows_lost": int(stats.get("windows_lost_total", 0)),
+        "soak_duplicates": int(stats.get("duplicates_total", 0)),
     }
 
 
@@ -228,6 +338,10 @@ def gate(row: dict, p99_budget_ms: float = 250.0,
         failures.append(
             f"last window saw {row['soak_last_batch_nodes']} of "
             f"{row['soak_agents']} agents (reports going stale?)")
+    if row.get("soak_replicas", 1) > 1 and row.get("soak_windows_lost"):
+        failures.append(
+            f"{row['soak_windows_lost']} windows lost across the "
+            "replicated ingest tier (hand-off must be replay, not loss)")
     return failures
 
 
@@ -237,6 +351,11 @@ def main() -> None:
     p.add_argument("--seconds", type=float, default=60.0)
     p.add_argument("--interval", type=float, default=5.0)
     p.add_argument("--workloads", type=int, default=100)
+    p.add_argument("--replicas", type=int, default=1,
+                   help="aggregator replicas sharing the ingest ring")
+    p.add_argument("--kill-at", type=float, default=0.0,
+                   help="seconds into the soak to kill one replica and "
+                        "rebalance (0 = no kill; needs --replicas >= 2)")
     p.add_argument("--p99-budget-ms", type=float, default=250.0)
     p.add_argument("--rss-budget-mib", type=float, default=96.0,
                    help="steady-state (post-ramp) RSS growth gate")
@@ -246,7 +365,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    row = run_soak(args.agents, args.seconds, args.interval, args.workloads)
+    row = run_soak(args.agents, args.seconds, args.interval,
+                   args.workloads, replicas=args.replicas,
+                   kill_at=args.kill_at)
     row["soak_rss_growth_budget_mib"] = args.rss_budget_mib
     failures = ([] if args.no_gate
                 else gate(row, args.p99_budget_ms, args.rss_budget_mib))

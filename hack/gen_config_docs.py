@@ -226,6 +226,23 @@ DESCRIPTIONS = {
                             "node's self-reported power as anomalous "
                             "on the scoreboard (`0` disables the "
                             "flag).",
+    "aggregator.peers": "HA ingest ring: every replica's dialable "
+                        "endpoint (the SAME list on every replica and "
+                        "agent). Each replica accepts only the nodes "
+                        "the consistent-hash ring assigns it and "
+                        "answers the rest with a `421 + owner + epoch` "
+                        "redirect agents follow. Empty = "
+                        "single-replica ingest.",
+    "aggregator.self_peer": "Which `aggregator.peers` entry THIS "
+                            "replica is (replica role only; agents "
+                            "leave it empty).",
+    "aggregator.ring_epoch": "Ingest-ring membership epoch — bump it "
+                             "when rolling out a changed peers list so "
+                             "agents re-resolve ownership (monotonic, "
+                             ">= 1).",
+    "aggregator.ring_vnodes": "Virtual nodes per ring peer: ownership "
+                              "granularity (higher = smoother "
+                              "distribution, slower ring build).",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -323,6 +340,10 @@ FLAG_OF = {
     "aggregator.dispatch_timeout": "--aggregator.dispatch-timeout",
     "aggregator.scoreboard_cap": "--aggregator.scoreboard-cap",
     "aggregator.anomaly_z": "--aggregator.anomaly-z",
+    "aggregator.peers": "--aggregator.peers (repeatable)",
+    "aggregator.self_peer": "--aggregator.self-peer",
+    "aggregator.ring_epoch": "--aggregator.ring-epoch",
+    "aggregator.ring_vnodes": "--aggregator.ring-vnodes",
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",

@@ -1,12 +1,14 @@
 #!/usr/bin/env python3
 """`make introspect`: boot a local aggregator, ingest two node reports
-over HTTP, run one fleet window, then fetch `/debug/window` and
-`/debug/fleet` and validate their JSON against the catalog schema in
-docs/developer/observability.md ("Device introspection" / "Fleet
-scoreboard"). Exit 0 only when both endpoints serve schema-valid JSON
-with a populated engine dump and scoreboard — the zero-to-working proof
-that the introspection plane is wired end to end in the real binary
-wiring (APIServer + Aggregator.init), not just in unit tests.
+over HTTP, run one fleet window, then fetch `/debug/window`,
+`/debug/fleet`, and `/debug/ring` and validate their JSON against the
+catalog schemas in docs/developer/observability.md ("Device
+introspection" / "Fleet scoreboard") and resilience.md ("Ingest
+hand-off"). Exit 0 only when all three endpoints serve schema-valid
+JSON with a populated engine dump, scoreboard, and ring view — the
+zero-to-working proof that the introspection plane is wired end to end
+in the real binary wiring (APIServer + Aggregator.init), not just in
+unit tests.
 """
 
 from __future__ import annotations
@@ -32,6 +34,9 @@ ENGINE_REQUIRED = {"engine", "n_shards", "window_seq", "buckets",
                    "compile_count"}
 FLEET_REQUIRED = {"cap", "anomaly_z", "flag_ttl_s", "stale_after_s",
                   "states", "nodes"}
+RING_REQUIRED = {"enabled", "epoch", "self", "peers", "vnodes",
+                 "ownership_ratio", "owned_nodes", "redirected_total",
+                 "last_redirect_age_s"}
 NODE_REQUIRED = {"state", "state_code", "last_seen_age_s", "reports",
                  "duplicates", "windows_lost", "quarantined",
                  "delivery_ewma_s", "power_w", "power_mean_w",
@@ -56,8 +61,13 @@ def main() -> int:
     from kepler_tpu.service.lifecycle import CancelContext
 
     server = APIServer(listen_addresses=["127.0.0.1:0"])
+    # a 1-peer ring: ownership machinery active (epoch, /debug/ring
+    # populated) with every node owned locally — the smoke's reports
+    # must ingest, not redirect
     agg = Aggregator(server, model_mode="mlp", node_bucket=8,
-                     workload_bucket=16, stale_after=1e9)
+                     workload_bucket=16, stale_after=1e9,
+                     peers=["127.0.0.1:28283"],
+                     self_peer="127.0.0.1:28283")
     agg.init()
     server.init()
     ctx = CancelContext()
@@ -118,11 +128,26 @@ def main() -> int:
             _check(not gap, f"scoreboard row {name} missing {gap}")
             _check(row["state"] == "healthy",
                    f"{name} state {row['state']!r} (expected healthy)")
+        with urllib.request.urlopen(f"{base}/debug/ring",
+                                    timeout=10) as resp:
+            ring = json.loads(resp.read())
+        missing = RING_REQUIRED - set(ring)
+        _check(not missing, f"/debug/ring missing keys {missing}")
+        _check(ring["enabled"] is True, "ring enabled")
+        _check(ring["epoch"] >= 1, f"ring epoch {ring['epoch']}")
+        _check(ring["ownership_ratio"] == 1.0,
+               "single peer owns the whole hash space")
+        _check(ring["owned_nodes"] == 2,
+               f"owned_nodes {ring['owned_nodes']} (expected 2)")
+        _check(ring["redirected_total"] == 0,
+               "no redirects on a 1-peer ring")
+
         print(f"introspect smoke OK: rung={window['rung_name']} "
               f"shards={window['shards']} "
               f"programs={len(programs)} "
               f"nodes={len(fleet['nodes'])} "
-              f"states={fleet['states']}")
+              f"states={fleet['states']} "
+              f"ring_epoch={ring['epoch']}")
         return 0
     finally:
         ctx.cancel()

@@ -30,6 +30,13 @@ log = logging.getLogger("kepler.aggregator")
 def main(argv: Sequence[str] | None = None) -> int:
     try:
         cfg = parse_args_and_config(argv, skip_validation=("host",))
+        # the aggregator binary IS the replica role regardless of the
+        # aggregator.enabled flag (which gates the node binary's embedded
+        # aggregator) — ring membership must be coherent here too, as a
+        # friendly startup error rather than a constructor traceback
+        if cfg.aggregator.peers and not cfg.aggregator.self_peer:
+            raise ValueError("aggregator.selfPeer must name this replica "
+                             "when aggregator.peers is set")
     except (ValueError, OSError) as err:
         print(f"error: {err}", file=sys.stderr)
         return 1
@@ -82,6 +89,10 @@ def main(argv: Sequence[str] | None = None) -> int:
         mesh_axes=cfg.aggregator.mesh_axes,
         scoreboard_cap=cfg.aggregator.scoreboard_cap,
         anomaly_z=cfg.aggregator.anomaly_z,
+        peers=cfg.aggregator.peers,
+        self_peer=cfg.aggregator.self_peer,
+        ring_epoch=cfg.aggregator.ring_epoch,
+        ring_vnodes=cfg.aggregator.ring_vnodes,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

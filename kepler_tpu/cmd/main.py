@@ -167,6 +167,7 @@ def create_services(cfg: Config) -> list:
             breaker_cooldown=cfg.aggregator.breaker_cooldown,
             flush_timeout_s=cfg.aggregator.flush_timeout,
             spool=spool,
+            peers=cfg.aggregator.peers,
         )
         server.health.register_probe("fleet-agent", agent.health)
         if spool is not None:

@@ -370,6 +370,18 @@ class AggregatorConfig:
     # self-reported power (0 disables the anomaly flag)
     scoreboard_cap: int = 1024
     anomaly_z: float = 4.0
+    # -- HA ingest ring (docs/developer/resilience.md "Ingest
+    # hand-off"): static replica membership for the consistent-hash
+    # ingest tier. peers lists every replica's dialable endpoint (the
+    # SAME list on every replica and every agent); selfPeer names which
+    # entry this replica is (replica role only); ringEpoch versions the
+    # membership (bump it when rolling out a changed peers list);
+    # ringVnodes is the virtual-node count per peer (ownership
+    # granularity). Empty peers = single-replica ingest, ring inert.
+    peers: list[str] = field(default_factory=list)
+    self_peer: str = ""
+    ring_epoch: int = 1
+    ring_vnodes: int = 64
 
 
 @dataclass
@@ -477,6 +489,26 @@ class Config:
         if self.aggregator.anomaly_z < 0:
             errs.append("aggregator.anomalyZ must be >= 0 (0 disables "
                         "the anomaly flag)")
+        # HA ingest ring: membership must be coherent at startup — a
+        # replica that can't place itself in the ring would redirect
+        # every report forever
+        agg = self.aggregator
+        if any(not isinstance(p, str) or not p for p in agg.peers):
+            errs.append("aggregator.peers entries must be non-empty "
+                        "strings")
+        elif len(set(agg.peers)) != len(agg.peers):
+            errs.append("aggregator.peers must not contain duplicates")
+        elif agg.self_peer and agg.peers \
+                and agg.self_peer not in agg.peers:
+            errs.append(f"aggregator.selfPeer {agg.self_peer!r} must be "
+                        "one of aggregator.peers")
+        elif agg.enabled and agg.peers and not agg.self_peer:
+            errs.append("aggregator.selfPeer must be set when the "
+                        "aggregator role is enabled with aggregator.peers")
+        if agg.ring_epoch < 1:
+            errs.append("aggregator.ringEpoch must be >= 1")
+        if agg.ring_vnodes < 1:
+            errs.append("aggregator.ringVnodes must be >= 1")
         if self.aggregator.dispatch_timeout < 0:
             errs.append("aggregator.dispatchTimeout must be >= 0 "
                         "(0 disables the stall watchdog)")
@@ -590,6 +622,9 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "dispatchTimeout": "dispatch_timeout",
     "scoreboardCap": "scoreboard_cap",
     "anomalyZ": "anomaly_z",
+    "selfPeer": "self_peer",
+    "ringEpoch": "ring_epoch",
+    "ringVnodes": "ring_vnodes",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
@@ -759,6 +794,20 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         default=None, type=float,
         help="rolling z-score threshold flagging a node's reported "
              "power as anomalous (0 disables)")
+    add("--aggregator.peers", dest="aggregator_peers", default=None,
+        action="append",
+        help="repeatable: one ingest-ring replica endpoint per flag "
+             "(the same list on every replica and agent)")
+    add("--aggregator.self-peer", dest="aggregator_self_peer",
+        default=None,
+        help="which aggregator.peers entry THIS replica is")
+    add("--aggregator.ring-epoch", dest="aggregator_ring_epoch",
+        default=None, type=int,
+        help="ingest-ring membership epoch (bump when rolling out a "
+             "changed peers list)")
+    add("--aggregator.ring-vnodes", dest="aggregator_ring_vnodes",
+        default=None, type=int,
+        help="virtual nodes per ring peer (ownership granularity)")
     add("--agent.spool-dir", dest="agent_spool_dir", default=None,
         help="crash-safe report spool directory (empty disables)")
     add("--tpu.platform", dest="tpu_platform", default=None,
@@ -824,6 +873,11 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
            args.aggregator_dispatch_timeout, _parse_duration)
     set_if(("aggregator", "scoreboard_cap"), args.aggregator_scoreboard_cap)
     set_if(("aggregator", "anomaly_z"), args.aggregator_anomaly_z)
+    if args.aggregator_peers:
+        cfg.aggregator.peers = list(args.aggregator_peers)
+    set_if(("aggregator", "self_peer"), args.aggregator_self_peer)
+    set_if(("aggregator", "ring_epoch"), args.aggregator_ring_epoch)
+    set_if(("aggregator", "ring_vnodes"), args.aggregator_ring_vnodes)
     if args.agent_spool_dir is not None:
         cfg.agent.spool.dir = args.agent_spool_dir
     set_if(("tpu", "platform"), args.tpu_platform)

@@ -55,6 +55,10 @@ KNOWN_SITES = (
     "device.compile_error",   # window: a cold program/update compile fails
     "device.oom_on_grow",     # window: a bucket-growth recompile OOMs
     "device.stall",           # window: the fetch hangs `arg` seconds
+    # HA ingest tier (consistent-hash replicated aggregators,
+    # docs/developer/resilience.md "Ingest hand-off")
+    "net.partition",          # agent: report delivered, response dropped
+    "replica.down",           # aggregator: ingest answers 503 (replica dead)
 )
 
 

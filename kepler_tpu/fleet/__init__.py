@@ -9,6 +9,7 @@ back per node.
 
 from kepler_tpu.fleet.agent import FleetAgent
 from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.ring import HashRing
 from kepler_tpu.fleet.scoreboard import FleetScoreboard
 from kepler_tpu.fleet.spool import Spool
 from kepler_tpu.fleet.wire import (
@@ -21,6 +22,7 @@ __all__ = [
     "Aggregator",
     "FleetAgent",
     "FleetScoreboard",
+    "HashRing",
     "Spool",
     "WireError",
     "decode_report",

@@ -30,6 +30,22 @@ Self-telemetry (ISSUE 4): the emit→spool-append→drain→send legs carry
 trace (``trace`` id + ``emitted_at`` in the wire header) that the
 aggregator closes at merge into
 ``kepler_fleet_delivery_latency_seconds{path="fresh"|"replay"}``.
+
+HA ingest tier (ISSUE 11): with ``peers`` set (the replicas'
+``aggregator.peers`` list), the agent learns the consistent-hash ring
+LAZILY — it dials any peer, follows the structured ``421 + owner +
+epoch`` redirect to the replica that owns its ``node_name``, and
+re-resolves when a response advertises a higher membership epoch. A
+replica outage falls back to the machinery above unchanged (backoff,
+breaker, spool), with one addition: each consecutive failure rotates to
+the next peer, so the first live replica answers with ownership truth
+(a 2xx or a redirect). On an owner CHANGE the hand-off is hot: the
+agent rewinds its spool tail (``handoff_replay`` records) so the new
+owner rebuilds the node's recent state from real records — replicas
+that already ingested them absorb the overlap through the ``(run,
+seq)`` dedup window, and the ``acked_through`` watermark stamped at
+transmit keeps the new owner's gap detection from fabricating loss for
+windows the OLD owner acknowledged.
 """
 
 from __future__ import annotations
@@ -40,6 +56,7 @@ from __future__ import annotations
 import base64
 import collections
 import http.client
+import json
 import logging
 import random
 import socket
@@ -48,11 +65,13 @@ import threading
 import time as _time
 import urllib.parse
 import uuid
-from typing import Callable
+from typing import Callable, Sequence
 
 from kepler_tpu import fault, telemetry
+from kepler_tpu.fleet.ring import coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.spool import Spool
-from kepler_tpu.fleet.wire import WireError, encode_report, restamp_transmit
+from kepler_tpu.fleet.wire import (WireError, encode_report,
+                                   peek_identity, restamp_transmit)
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
 from kepler_tpu.service.lifecycle import CancelContext, backoff_with_jitter
@@ -84,6 +103,104 @@ class UnsendableRecordError(Exception):
     network contact happened, so it is evidence of nothing."""
 
 
+class OwnerRedirectError(Exception):
+    """421 from a replica that does not own this node: a structured
+    redirect naming the owning peer + the ring membership epoch. NOT a
+    rejection (the payload is fine) and NOT an outage (the tier
+    answered) — the drain loop follows it to the owner and retries the
+    SAME window there."""
+
+    def __init__(self, owner: str | None, epoch: int | None) -> None:
+        super().__init__(
+            f"report redirected to owner {owner!r} (epoch {epoch})")
+        self.owner = owner
+        self.epoch = epoch
+
+
+def _parse_redirect(data: bytes, headers) -> tuple[str | None, int | None]:
+    """(owner, epoch) from a 421 response — body JSON first, the
+    ``X-Kepler-Owner``/``X-Kepler-Epoch`` headers as fallback. Both
+    values arrive from the network and are laundered through the ring's
+    sanitizers; an unusable redirect returns ``(None, None)`` and is
+    handled as a failed send, never followed blindly."""
+    owner: object = None
+    epoch: object = None
+    try:
+        payload = json.loads(data)
+        if isinstance(payload, dict):
+            owner = payload.get("owner")
+            epoch = payload.get("epoch")
+    except (ValueError, UnicodeDecodeError):
+        pass
+    if owner is None:
+        owner = headers.get("X-Kepler-Owner")
+    if epoch is None:
+        epoch = _epoch_from_header(headers.get("X-Kepler-Epoch"))
+    return sanitize_peer(owner), coerce_epoch(epoch)
+
+
+def _epoch_from_header(raw: str | None) -> int | None:
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+class _PeerTarget:
+    """One dialable ingest replica (parsed once, switched cheaply).
+
+    ``display`` is the credential-stripped identity (no URL userinfo):
+    it is what leaves the process — health payloads, log lines, and the
+    ``owner`` wire header — so an endpoint of the documented
+    ``https://user:pw@agg:28283`` form never leaks its password."""
+
+    __slots__ = ("url", "display", "host", "port", "path", "tls",
+                 "auth_header", "tls_ctx")
+
+    def __init__(self, url: str, display: str, host: str, port: int,
+                 path: str, tls: bool, auth_header: str, tls_ctx) -> None:
+        self.url = url
+        self.display = display
+        self.host = host
+        self.port = port
+        self.path = path
+        self.tls = tls
+        self.auth_header = auth_header
+        self.tls_ctx = tls_ctx
+
+
+def _parse_target(endpoint: str, tls_skip_verify: bool) -> _PeerTarget:
+    u = urllib.parse.urlsplit(endpoint if "//" in endpoint
+                              else f"http://{endpoint}")
+    if not u.hostname or not u.port:
+        raise ValueError(
+            f"aggregator endpoint needs host:port, got {endpoint!r}")
+    tls = u.scheme == "https"
+    auth_header = ""
+    if u.username is not None:
+        creds = f"{urllib.parse.unquote(u.username)}:" \
+                f"{urllib.parse.unquote(u.password or '')}"
+        auth_header = "Basic " + base64.b64encode(creds.encode()).decode()
+        if not tls:
+            log.warning(
+                "aggregator endpoint has basic-auth credentials but no "
+                "https:// scheme — the Authorization header will go over "
+                "the wire in cleartext")
+    tls_ctx = None
+    if tls:
+        tls_ctx = ssl.create_default_context()
+        if tls_skip_verify:
+            tls_ctx.check_hostname = False
+            tls_ctx.verify_mode = ssl.CERT_NONE
+    display = (f"{u.scheme}://{u.hostname}:{u.port}" if "//" in endpoint
+               else f"{u.hostname}:{u.port}")
+    return _PeerTarget(endpoint, display, u.hostname, u.port,
+                       (u.path.rstrip("/") or "") + "/v1/report",
+                       tls, auth_header, tls_ctx)
+
+
 class FleetAgent:
     def __init__(
         self,
@@ -103,6 +220,8 @@ class FleetAgent:
         monotonic: Callable[[], float] | None = None,
         jitter_seed: int | None = None,
         spool: Spool | None = None,
+        peers: Sequence[str] | None = None,
+        handoff_replay: int = 8,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
@@ -154,36 +273,42 @@ class FleetAgent:
         self._stats = {"sent_total": 0, "send_failures": 0,
                        "dropped_total": 0, "server_rejections": 0,
                        "connects_total": 0,
-                       "breaker_opens": 0, "flushed_on_shutdown": 0}
-        u = urllib.parse.urlsplit(endpoint if "//" in endpoint
-                                  else f"http://{endpoint}")
-        if not u.hostname or not u.port:
-            raise ValueError(
-                f"aggregator endpoint needs host:port, got {endpoint!r}")
-        self._host, self._port = u.hostname, u.port
-        self._path = (u.path.rstrip("/") or "") + "/v1/report"
-        self._tls = u.scheme == "https"
-        # aggregator behind basic auth (webconfig.py): credentials ride in
-        # the endpoint URL userinfo — https://user:pw@agg:28283
-        self._auth_header = ""
-        if u.username is not None:
-            creds = f"{urllib.parse.unquote(u.username)}:" \
-                    f"{urllib.parse.unquote(u.password or '')}"
-            self._auth_header = "Basic " + base64.b64encode(
-                creds.encode()).decode()
-            if not self._tls:
-                log.warning(
-                    "aggregator endpoint has basic-auth credentials but no "
-                    "https:// scheme — the Authorization header will go over "
-                    "the wire in cleartext")
-        # fixed for the agent's lifetime → build the TLS context once, not
-        # per report send
-        self._tls_ctx = None
-        if self._tls:
-            self._tls_ctx = ssl.create_default_context()
-            if tls_skip_verify:
-                self._tls_ctx.check_hostname = False
-                self._tls_ctx.verify_mode = ssl.CERT_NONE
+                       "breaker_opens": 0, "flushed_on_shutdown": 0,
+                       "redirects_followed": 0, "failovers": 0,
+                       "handoffs": 0}
+        # HA ingest tier: the replica set. With one endpoint this is a
+        # 1-peer tier and every ring mechanism below is inert; with
+        # ``peers`` (the replicas' aggregator.peers list, basic-auth/TLS
+        # carried per URL exactly like the single endpoint) the agent
+        # follows 421 owner redirects and fails over between replicas.
+        # TLS contexts are built once per peer, not per send.
+        self._tls_skip_verify = tls_skip_verify
+        urls = [u for u in (list(peers) if peers else []) if u]
+        if endpoint and endpoint not in urls:
+            urls.insert(0, endpoint)
+        if not urls:
+            raise ValueError("fleet agent needs an aggregator endpoint "
+                             "or a non-empty peers list")
+        self._peers = [_parse_target(u, tls_skip_verify) for u in urls]
+        # loop/growth bounds FROZEN at the configured membership: a
+        # replica naming ever-fresh owners must neither grow the peer
+        # list without bound nor raise its own redirect-hop budget
+        self._configured_peers = len(self._peers)
+        self._max_learned_peers = self._configured_peers + 8
+        # ring state, learned lazily off responses: the current owner
+        # target, the highest membership epoch seen, redirect-loop
+        # accounting, and the delivered watermark (highest seq with a
+        # 2xx from ANY replica) stamped into every transmit header
+        self._handoff_replay = max(0, int(handoff_replay))
+        self._ring_epoch = 0
+        self._redirect_hops = 0
+        self._acked_through = 0
+        # the replica that took the last 2xx: a success landing on a
+        # DIFFERENT one means this node's owner moved (whether we got
+        # there via a 421 redirect or by failover luck) — that is the
+        # hand-off moment, and the spool tail re-delivers
+        self._last_ok_target: _PeerTarget | None = None
+        self._set_target(self._peers[0])
 
     def name(self) -> str:
         return "fleet-agent"
@@ -267,6 +392,12 @@ class FleetAgent:
                     self._stats["dropped_total"] += 1
                     log.info("shutdown flush: unsendable record (%s)", err)
                     continue
+                except OwnerRedirectError as err:
+                    if self._follow_redirect(err):
+                        continue  # retry against the named owner
+                    log.info("shutdown flush stopped (unusable "
+                             "redirect): %s", err)
+                    break
                 except AggregatorRejectedError as err:
                     # this one sample is unacceptable; the rest may flush
                     self._finish_item(item)
@@ -292,6 +423,9 @@ class FleetAgent:
             "breaker": self._breaker_state,
             "consecutive_failures": self._consecutive_failures,
             "queued": self.backlog(),
+            "target": self._target.display,
+            "ring_epoch": self._ring_epoch,
+            "acked_through": self._acked_through,
             **self._stats,
         }
         if self._spool is not None:
@@ -395,7 +529,7 @@ class FleetAgent:
                 self._breaker_state = BREAKER_HALF_OPEN
                 log.info("circuit breaker half-open: probing aggregator")
             try:
-                self._send_item(item)
+                sent_seq = self._send_item(item)
             except UnsendableRecordError as err:
                 # poisoned record: ack + drop so the backlog moves on,
                 # but leave the breaker exactly as it was — this proves
@@ -404,6 +538,22 @@ class FleetAgent:
                 self._finish_item(item)
                 self._stats["dropped_total"] += 1
                 log.warning("dropping unsendable spooled record: %s", err)
+                continue
+            except OwnerRedirectError as err:
+                # this replica answered "not mine": follow the redirect
+                # and retry the SAME window against the named owner. An
+                # unusable redirect (loop, hostile owner) degrades to
+                # the ordinary failure path — backoff + failover decide
+                # the next attempt, the spool keeps the record safe.
+                if self._follow_redirect(err):
+                    continue
+                self._on_send_failure(err)
+                self._rotate_target()
+                if self._breaker_state == BREAKER_OPEN:
+                    return
+                delay = self._backoff_delay()
+                if ctx is None or ctx.wait(delay):
+                    return
                 continue
             except AggregatorRejectedError as err:
                 # the aggregator ANSWERED: delivery is healthy, this
@@ -420,6 +570,10 @@ class FleetAgent:
                 continue
             except (OSError, http.client.HTTPException) as err:
                 self._on_send_failure(err)
+                # probe a different replica next: during a replica
+                # outage successive attempts cycle the peer list, and
+                # the first live one answers with ownership truth
+                self._rotate_target()
                 if self._breaker_state == BREAKER_OPEN:
                     return
                 # closed, below threshold: retry after backoff with jitter
@@ -428,6 +582,16 @@ class FleetAgent:
                     return
                 continue
             self._finish_item(item)
+            if sent_seq:
+                # delivered watermark (any replica's 2xx): stamped into
+                # every transmit header so a NEW owner's gap detection
+                # never counts windows a previous owner acknowledged
+                self._acked_through = max(self._acked_through, sent_seq)
+            if self._target is not self._last_ok_target:
+                if self._last_ok_target is not None:
+                    self._handoff_rewind()
+                self._last_ok_target = self._target
+            self._redirect_hops = 0
             self._stats["sent_total"] += 1
             self._note_send_success()
 
@@ -498,6 +662,88 @@ class FleetAgent:
         return backoff_with_jitter(self._backoff_initial, self._backoff_max,
                                    self._consecutive_failures, self._rng)
 
+    def _set_target(self, target: _PeerTarget) -> None:
+        self._target = target
+        self._host, self._port = target.host, target.port
+        self._path, self._tls = target.path, target.tls
+        self._auth_header = target.auth_header
+        self._tls_ctx = target.tls_ctx
+
+    def _resolve_peer(self, owner: str) -> "_PeerTarget | None":
+        """The dialable target for a redirect's (sanitized) owner id: an
+        exact URL, display, or host:port match in the known peer list,
+        else — lazy ring learning for agents with a stale peers config —
+        the owner parsed as a fresh endpoint and remembered. Learning is
+        BOUNDED: past the cap an unknown owner is an unusable redirect
+        (failure path), never unbounded peer-list growth."""
+        for t in self._peers:
+            if owner in (t.url, t.display, f"{t.host}:{t.port}"):
+                return t
+        if len(self._peers) >= self._max_learned_peers:
+            return None
+        try:
+            target = _parse_target(owner, self._tls_skip_verify)
+        except ValueError:
+            return None
+        self._peers.append(target)
+        return target
+
+    def _follow_redirect(self, err: OwnerRedirectError) -> bool:
+        """Adopt a 421's owner + epoch. Returns False (caller treats it
+        as a failed send) when the redirect is unusable: hostile/empty
+        owner, a target we are already on, or an owner-disagreement
+        loop — the hop budget is frozen at the CONFIGURED peer count
+        (not the learned list, which a hostile replica could grow) and
+        resets only on a successful send."""
+        if err.epoch is not None and err.epoch > self._ring_epoch:
+            self._ring_epoch = err.epoch
+        if err.owner is None:
+            return False
+        self._redirect_hops += 1
+        if self._redirect_hops > self._configured_peers + 2:
+            return False
+        target = self._resolve_peer(err.owner)
+        if target is None or target is self._target:
+            return False
+        self._close_conn()
+        self._set_target(target)
+        self._stats["redirects_followed"] += 1
+        # the redirect IS an aggregator answer — the ingest tier is
+        # alive, so it closes the breaker like any other response
+        self._note_send_success()
+        log.info("ingest owner moved: following redirect to %s "
+                 "(ring epoch %d)", target.display, self._ring_epoch)
+        return True
+
+    def _handoff_rewind(self) -> None:
+        """Hot hand-off: the last 2xx came from a DIFFERENT replica
+        than the one before — this node's owner moved. Re-deliver the
+        spool tail so the new owner rebuilds the node's recent state
+        from real records; any replica that already ingested them
+        absorbs the overlap through the (run, seq) dedup window."""
+        if self._spool is None or not self._handoff_replay:
+            return
+        rewound = self._spool.rewind(self._handoff_replay)
+        if rewound:
+            self._stats["handoffs"] += 1
+            # an in-flight peek predates the rewound cursor (its ack
+            # would no-op anyway) — drop it so the drain restarts from
+            # the rewound tail in order
+            self._inflight = None
+            log.info("hand-off: re-delivering %d spooled record(s) to "
+                     "the new owner %s", rewound, self._target.display)
+
+    def _rotate_target(self) -> None:
+        """Outage failover: point the next attempt at the next
+        configured peer — the first live replica answers with ownership
+        truth (a 2xx if it owns this node, a 421 redirect if not)."""
+        if len(self._peers) <= 1:
+            return
+        i = self._peers.index(self._target)
+        self._close_conn()
+        self._set_target(self._peers[(i + 1) % len(self._peers)])
+        self._stats["failovers"] += 1
+
     def _connection(self) -> http.client.HTTPConnection:
         if self._conn is not None:
             return self._conn
@@ -556,20 +802,27 @@ class FleetAgent:
             return "replay"
         return "fresh"
 
-    def _send_item(self, item: tuple) -> None:
+    def _send_item(self, item: tuple) -> int:
+        """Send one queued window; returns its seq (0 when the payload
+        carries none, or belongs to a PREVIOUS run — an old run's
+        replayed seqs must not inflate this run's delivered watermark,
+        or they could mask the new run's own leading-window loss) so
+        the caller can advance ``acked_through`` after the ack."""
         if item[0] == "spool":
             rec = item[1]
             path = self._delivery_path(rec.appended_at, rec.recovered)
+            run, seq = peek_identity(rec.payload)
             with telemetry.span("agent.send"):
                 self._post(rec.payload, path=path,
                            appended_at=rec.appended_at)
-        else:
-            _tag, seq, sample, emitted_at, trace_id = item
-            path = self._delivery_path(emitted_at, False)
-            with telemetry.span("agent.send"):
-                self._post(self._encode(sample, seq, trace_id=trace_id,
-                                        emitted_at=emitted_at),
-                           path=path)
+            return seq if run == self._run_nonce else 0
+        _tag, seq, sample, emitted_at, trace_id = item
+        path = self._delivery_path(emitted_at, False)
+        with telemetry.span("agent.send"):
+            self._post(self._encode(sample, seq, trace_id=trace_id,
+                                    emitted_at=emitted_at),
+                       path=path)
+        return seq
 
     def _send(self, sample: WindowSample, seq: int | None = None) -> None:
         """Encode + POST one sample (direct-send path used by tests and
@@ -596,7 +849,10 @@ class FleetAgent:
             sent_at += spec.arg if spec.arg is not None else 300.0
         try:
             body = restamp_transmit(body, sent_at, delivery_path=path,
-                                    appended_at=appended_at)
+                                    appended_at=appended_at,
+                                    owner=self._target.display,
+                                    epoch=self._ring_epoch,
+                                    acked_through=self._acked_through)
         except WireError as err:
             # a spooled record that no longer parses (disk corruption the
             # CRC missed, or a format change across restart) can never be
@@ -616,19 +872,36 @@ class FleetAgent:
         try:
             conn.request("POST", self._path, body=body, headers=headers)
             resp = conn.getresponse()
-            resp.read()
+            data = resp.read()
         except Exception:
             # a dead persistent connection is not reusable — reconnect on
             # the next attempt
             self._close_conn()
             raise
+        if fault.fire("net.partition") is not None:
+            # one-way partition: the replica processed the report but
+            # its response never made it back — the agent must treat
+            # the send as failed and re-deliver later (the dedup window
+            # absorbs the duplicate)
+            self._close_conn()
+            raise OSError("fault-injected one-way partition "
+                          "(response lost)")
         if resp.status >= 300 or resp.will_close:
             self._close_conn()
+        if resp.status == 421:
+            owner, epoch = _parse_redirect(data, resp.headers)
+            raise OwnerRedirectError(owner, epoch)
         if 400 <= resp.status < 500:
             raise AggregatorRejectedError(resp.status)
         if resp.status >= 300:
             raise http.client.HTTPException(
                 f"aggregator returned {resp.status}")
+        # lazy epoch learning: accepts advertise the ring epoch too, so
+        # a settled agent still notices a membership bump
+        epoch = coerce_epoch(
+            _epoch_from_header(resp.headers.get("X-Kepler-Epoch")))
+        if epoch is not None and epoch > self._ring_epoch:
+            self._ring_epoch = epoch
 
     def _log_drop(self, err: Exception) -> None:
         # rate-limit to one warning per 30 s of MONOTONIC time (not sample

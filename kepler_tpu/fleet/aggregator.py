@@ -45,6 +45,7 @@ from typing import Any, Callable, Iterator, Mapping, Sequence
 import numpy as np
 
 from kepler_tpu import fault, telemetry
+from kepler_tpu.fleet.ring import HashRing, coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.wire import (
     WireError,
     decode_report,
@@ -407,6 +408,10 @@ class Aggregator:
         mesh_axes: Sequence[str] | None = None,
         scoreboard_cap: int = 1024,
         anomaly_z: float = 4.0,
+        peers: Sequence[str] | None = None,
+        self_peer: str = "",
+        ring_epoch: int = 1,
+        ring_vnodes: int = 64,
         clock: Callable[[], float] | None = None,
         mesh: Any = None,
     ) -> None:
@@ -495,12 +500,35 @@ class Aggregator:
         self._scoreboard = FleetScoreboard(  # keplint: guarded-by=_lock
             cap=scoreboard_cap, anomaly_z=anomaly_z,
             flag_ttl=degraded_ttl)
+        # HA ingest ring (ISSUE 11): with peers configured, this replica
+        # accepts only the nodes the consistent-hash ring assigns it and
+        # answers everyone else with a structured 421 owner redirect.
+        # The ring object is IMMUTABLE — a membership change swaps in a
+        # new one wholesale (apply_membership), so the ingest hot path
+        # reads it without the store lock.
+        self._ring: HashRing | None = None
+        self._self_peer = str(self_peer or "")
+        self._ring_vnodes = max(1, int(ring_vnodes))
+        if peers:
+            if not self._self_peer:
+                raise ValueError(
+                    "aggregator.selfPeer must name this replica when "
+                    "aggregator.peers is set")
+            self._ring = HashRing(peers, epoch=max(1, int(ring_epoch)),
+                                  vnodes=self._ring_vnodes)
+            if self._self_peer not in self._ring:
+                raise ValueError(
+                    f"aggregator.selfPeer {self_peer!r} is not in "
+                    f"aggregator.peers {list(self._ring.peers)!r}")
+        self._last_redirect_at: float | None = None  # keplint: guarded-by=_lock
+        self._last_membership_at: float | None = None  # keplint: guarded-by=_lock
         self._results_lock = threading.Lock()
         self._results: FleetResults | None = None  # keplint: guarded-by=_results_lock
         self._last_window_at: float | None = None
         self._stats = {"reports_total": 0, "rejected_total": 0,
                        "quarantined_total": 0, "malformed_total": 0,
                        "clock_skew_total": 0,
+                       "reports_redirected_total": 0,
                        "duplicates_total": 0, "windows_lost_total": 0,
                        "attributions_total": 0, "last_batch_nodes": 0,
                        "last_batch_workloads": 0,
@@ -638,10 +666,16 @@ class Aggregator:
         self._server.register("/debug/fleet", "Fleet scoreboard",
                               "per-node health state table",
                               self._handle_fleet_debug)
+        self._server.register("/debug/ring", "Ingest ring",
+                              "consistent-hash ingest ring: membership "
+                              "epoch, peers, ownership share, redirect "
+                              "counters", self._handle_ring_debug)
         health = getattr(self._server, "health", None)
         if health is not None:
             health.register_probe("fleet-aggregator", self.health)
             health.register_probe("fleet-window", self.window_health)
+            if self._ring is not None:
+                health.register_probe("fleet-ring", self.ring_health)
             # ready once init completed: endpoints registered, mesh built,
             # params validated — an empty fleet is still a ready aggregator
             health.register_readiness("fleet-aggregator",
@@ -704,6 +738,12 @@ class Aggregator:
             self, request: Any) -> tuple[int, dict[str, str], bytes]:
         if request.command != "POST":
             return 405, {"Content-Type": "text/plain"}, b"POST only\n"
+        if fault.fire("replica.down") is not None:
+            # chaos stand-in for a dying/overloaded replica: a 5xx the
+            # agent counts as a send failure (failover + spool), never
+            # as a permanent rejection
+            return (503, {"Content-Type": "text/plain"},
+                    b"replica down (fault injection)\n")
         try:
             with telemetry.span("aggregator.decode"):
                 report, header = decode_report(request.body)
@@ -758,6 +798,43 @@ class Aggregator:
                     f"bad header identity: seq={seq_raw!r} run={run_raw!r}")
             return (400, {"Content-Type": "text/plain"},
                     b"seq must be a non-negative integer and run a string\n")
+        # ring-header coercion, hardened exactly like run/seq: the
+        # owner/epoch/acked_through fields steer redirect handling and
+        # loss accounting, so hostile values (non-int, negative, bool,
+        # overlong/non-printable owner) are a 400 quarantine charged to
+        # the node — never a 500, never silently honored
+        owner_raw = header.get("owner", "")
+        epoch_val = coerce_epoch(header.get("epoch", 0))
+        acked_through = coerce_epoch(header.get("acked_through", 0))
+        owner_ok = owner_raw == "" or sanitize_peer(owner_raw) == owner_raw
+        if epoch_val is None or acked_through is None or not owner_ok:
+            with self._lock:
+                self._stats["rejected_total"] += 1
+                self._stats["quarantined_total"] += 1
+                self._stats["malformed_total"] += 1
+                self._record_degraded_locked(
+                    report.node_name, "malformed",
+                    f"bad ring header: owner={owner_raw!r} "
+                    f"epoch={header.get('epoch')!r} "
+                    f"acked_through={header.get('acked_through')!r}")
+            return (400, {"Content-Type": "text/plain"},
+                    b"owner must be a printable string, epoch and "
+                    b"acked_through non-negative integers\n")
+        # ownership: a report for a node the ring assigns elsewhere is
+        # answered with a structured redirect (the agent follows it and
+        # re-delivers there) — not stored, not charged, not tracked
+        ring = self._ring
+        if ring is not None:
+            owner = ring.owner(report.node_name)
+            if owner != self._self_peer:
+                with self._lock:
+                    self._stats["reports_redirected_total"] += 1
+                    self._last_redirect_at = received
+                body = json.dumps({"owner": owner,
+                                   "epoch": ring.epoch}).encode()
+                return (421, {"Content-Type": "application/json",
+                              "X-Kepler-Owner": owner,
+                              "X-Kepler-Epoch": str(ring.epoch)}, body)
         stored = _Stored(report=report,
                          zone_names=tuple(header["zone_names"]),
                          received=received,
@@ -812,6 +889,16 @@ class Aggregator:
                             self._seq_trackers,
                             key=lambda n: self._seq_trackers[n].touched))
                     tracker = _SeqTracker(stored.run, self._dedup_window)
+                    if acked_through > 0 and stored.seq > 0:
+                        # hand-off / restart seeding: the agent asserts
+                        # every seq ≤ acked_through got a 2xx from SOME
+                        # replica — delivered to a previous owner (or a
+                        # previous incarnation of this one), not lost.
+                        # min() clamps a stale or hostile watermark to
+                        # this report's own leading gap, so an agent can
+                        # only vouch for (or hide) its OWN stream.
+                        tracker.max_seen = min(acked_through,
+                                               stored.seq - 1)
                     self._seq_trackers[report.node_name] = tracker
                 tracker.touched = received
                 dup, lost = tracker.observe(stored.seq)
@@ -829,7 +916,7 @@ class Aggregator:
                     self._stats["reports_total"] += 1
                     self._scoreboard.observe_duplicate(report.node_name,
                                                        received)
-                    return 204, {}, b""
+                    return 204, self._epoch_headers(), b""
                 if lost:
                     lost_windows = lost
                     self._stats["windows_lost_total"] += lost
@@ -877,7 +964,79 @@ class Aggregator:
             self._observe_delivery_locked(report.node_name, header,
                                           received)
             self._stats["reports_total"] += 1
-        return 204, {}, b""
+        return 204, self._epoch_headers(), b""
+
+    def _epoch_headers(self) -> dict[str, str]:
+        """Accepts advertise the ring epoch so settled agents notice a
+        membership bump lazily (no extra round-trips)."""
+        ring = self._ring
+        if ring is None:
+            return {}
+        return {"X-Kepler-Epoch": str(ring.epoch)}
+
+    # -- ingest ring (HA ingest tier) --------------------------------------
+
+    def apply_membership(self, peers: Sequence[str], epoch: int) -> int:
+        """Adopt a new replica membership (an operator action: config
+        rollout, or the chaos suite's kill/rebalance): swap in a NEW
+        ring at a HIGHER epoch and drop stored reports for nodes this
+        replica no longer owns — their agents get redirected on their
+        next send, and a stale local copy must not keep attributing
+        them here meanwhile. Seq trackers are KEPT (bounded by their
+        cap): if ownership bounces back, dedup continuity absorbs the
+        re-delivered overlap. Returns the number of nodes handed off."""
+        if self._ring is None:
+            raise ValueError(
+                "ingest ring is not enabled (aggregator.peers is empty)")
+        new = self._ring.with_members(peers, epoch)
+        if self._self_peer not in new:
+            raise ValueError(
+                f"self peer {self._self_peer!r} is not in the new "
+                f"membership {list(new.peers)!r}")
+        with self._lock:
+            self._ring = new
+            dropped = [n for n in self._reports
+                       if new.owner(n) != self._self_peer]
+            for name in dropped:
+                del self._reports[name]
+                self._history.pop(name, None)
+                self._superseded_runs.pop(name, None)
+                # the node reports to its NEW owner now — a row left
+                # here would age into a permanent false 'stale' signal
+                self._scoreboard.drop(name)
+            self._last_membership_at = self._clock()
+        log.warning("ingest ring membership changed: epoch %d, %d "
+                    "peer(s), %d node(s) handed off", new.epoch,
+                    len(new), len(dropped))
+        return len(dropped)
+
+    def ring_health(self) -> dict:
+        """``fleet-ring`` probe for /healthz: degraded while a hand-off
+        is actively settling — a redirect answered or a membership
+        change applied within ``degradedTtl``. That is the operator's
+        "rebalance in progress" signal; it recovers on its own once
+        displaced agents stop arriving here."""
+        ring = self._ring
+        now = self._clock()
+        with self._lock:
+            last_redirect = self._last_redirect_at
+            last_membership = self._last_membership_at
+            redirected = self._stats["reports_redirected_total"]
+        settling = any(
+            t is not None and now - t <= self._degraded_ttl
+            for t in (last_redirect, last_membership))
+        out = {
+            "ok": not settling,
+            "epoch": ring.epoch if ring is not None else 0,
+            "peers": len(ring) if ring is not None else 0,
+            "self": self._self_peer,
+            "redirected_total": redirected,
+        }
+        if last_redirect is not None:
+            out["last_redirect_age_s"] = round(now - last_redirect, 3)
+        if last_membership is not None:
+            out["last_membership_age_s"] = round(now - last_membership, 3)
+        return out
 
     # keplint: requires-lock=_lock
     def _observe_delivery_locked(self, node: str, header: Mapping,
@@ -1873,6 +2032,36 @@ class Aggregator:
         return (200, {"Content-Type": "application/json"},
                 json.dumps(payload).encode())
 
+    def _handle_ring_debug(
+            self, request: Any) -> tuple[int, dict[str, str], bytes]:
+        """``GET /debug/ring``: the ingest ring's membership +
+        ownership view from THIS replica — epoch, peers, hash-space
+        share, owned node count, redirect accounting. ``enabled: false``
+        (epoch 0) when the tier runs single-replica."""
+        ring = self._ring
+        now = self._clock()
+        with self._lock:
+            redirected = self._stats["reports_redirected_total"]
+            last_redirect = self._last_redirect_at
+            owned = len(self._reports)
+        payload: dict[str, Any] = {
+            "enabled": ring is not None,
+            "epoch": ring.epoch if ring is not None else 0,
+            "self": self._self_peer,
+            "peers": list(ring.peers) if ring is not None else [],
+            "vnodes": ring.vnodes if ring is not None else 0,
+            "ownership_ratio": (
+                round(ring.ownership_ratio(self._self_peer), 6)
+                if ring is not None else 1.0),
+            "owned_nodes": owned,
+            "redirected_total": redirected,
+            "last_redirect_age_s": (
+                round(now - last_redirect, 3)
+                if last_redirect is not None else None),
+        }
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(payload).encode())
+
     def _handle_fleet_debug(self, request: Any) -> tuple[int,
                                                          dict[str, str],
                                                     bytes]:
@@ -2066,6 +2255,27 @@ class Aggregator:
             "Redelivered (run, seq) reports absorbed by the dedup window")
         duplicates.add_metric([], stats["duplicates_total"])
         yield duplicates
+        redirected = CounterMetricFamily(
+            "kepler_fleet_reports_redirected_total",
+            "Reports answered with a 421 owner redirect (node owned by "
+            "another ring replica; the agent follows to the owner)")
+        redirected.add_metric([], stats["reports_redirected_total"])
+        yield redirected
+        ring = self._ring
+        ring_epoch = GaugeMetricFamily(
+            "kepler_fleet_ring_epoch",
+            "Ingest ring membership epoch (monotonic, bumped per "
+            "membership change; 0 = ring disabled / single-replica)")
+        ring_epoch.add_metric([], ring.epoch if ring is not None else 0)
+        yield ring_epoch
+        ownership = GaugeMetricFamily(
+            "kepler_fleet_ring_ownership_ratio",
+            "Share of the consistent-hash space this replica owns "
+            "(1.0 = single replica or ring disabled)")
+        ownership.add_metric(
+            [], ring.ownership_ratio(self._self_peer)
+            if ring is not None else 1.0)
+        yield ownership
         now = self._clock()
         with self._lock:
             lost_by_node = dict(self._lost_by_node)

@@ -193,6 +193,17 @@ class FleetScoreboard:
                        * (e.power_var + self._alpha * delta * delta))
         e.power_n += 1
 
+    def drop(self, node: str) -> bool:
+        """Remove a node's row outright (ingest hand-off: the node now
+        belongs to another replica — keeping the row here would decay
+        into a permanent false 'stale' signal on the OLD owner)."""
+        entry = self._nodes.pop(node[:self._name_cap], None)
+        if entry is None:
+            return False
+        if entry.reports == 0:
+            self._junk -= 1
+        return True
+
     def observe_duplicate(self, node: str, now: float) -> None:
         e = self._touch(node, weak=True)
         if e is None:

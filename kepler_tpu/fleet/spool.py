@@ -158,6 +158,7 @@ class Spool:
         self._pending_records = 0  # keplint: guarded-by=_lock
         self._stats = {"appended_total": 0, "acked_total": 0,
                        "evicted_total": 0, "truncated_tail_records": 0,
+                       "rewound_total": 0,
                        "write_errors_total": 0, "fsync_errors_total": 0}
         self._open()
 
@@ -557,6 +558,60 @@ class Spool:
                     os.unlink(self._seg_path(idx))
                 except OSError:
                     pass
+
+    def rewind(self, max_records: int) -> int:
+        """Move the ack cursor BACK over up to ``max_records`` already-
+        acknowledged records so they re-deliver.
+
+        The ingest hand-off's spool-tail replay: when an agent's owner
+        moves (membership change, replica loss), the NEW owner has
+        never seen the node — re-sending the recent tail rebuilds its
+        scoreboard/seq state from real records, and any replica that
+        already ingested them absorbs the overlap through the
+        ``(run, seq)`` dedup window. Bounded by segment retention:
+        fully-acked sealed segments are deleted at ack time, so the
+        rewind reaches at most the start of the cursor's current
+        segment. Returns how many records the cursor moved back over.
+        """
+        if max_records <= 0:
+            return 0
+        with self._lock:
+            if self._cursor_off == 0:
+                return 0
+            end = (self._active_bytes
+                   if self._cursor_seg == self._active
+                   else self._segments.get(self._cursor_seg, (0, 0))[1])
+            end = min(end, self._cursor_off)
+            starts: list[int] = []
+            try:
+                with open(self._seg_path(self._cursor_seg), "rb") as fh:
+                    off = 0
+                    while off + _FRAME.size <= end:
+                        fh.seek(off)
+                        header = fh.read(_FRAME.size)
+                        if len(header) < _FRAME.size:
+                            break
+                        length, _crc, _ts = _FRAME.unpack(header)
+                        if length > MAX_RECORD_BYTES \
+                                or off + _FRAME.size + length > end:
+                            break
+                        starts.append(off)
+                        off += _FRAME.size + length
+            except OSError as err:
+                log.warning("spool rewind failed (%s); tail not "
+                            "re-delivered", err)
+                return 0
+            tail = [s for s in starts if s < self._cursor_off]
+            tail = tail[-max_records:]
+            if not tail:
+                return 0
+            self._cursor_off = tail[0]
+            self._peeked = None
+            self._pending_records += len(tail)
+            self._stats["rewound_total"] = (
+                self._stats.get("rewound_total", 0) + len(tail))
+            self._persist_cursor_locked()
+            return len(tail)
 
     # -- cursor persistence --------------------------------------------------
 

@@ -106,7 +106,10 @@ class WireError(ValueError):
 
 def restamp_transmit(data: bytes, sent_at: float,
                      delivery_path: str | None = None,
-                     appended_at: float | None = None) -> bytes:
+                     appended_at: float | None = None,
+                     owner: str | None = None,
+                     epoch: int | None = None,
+                     acked_through: int | None = None) -> bytes:
     """Rewrite a report payload's transmit-time header fields in place.
 
     Spooled records (``fleet.spool``) keep their original ``run``/``seq``
@@ -120,6 +123,15 @@ def restamp_transmit(data: bytes, sent_at: float,
     only knows at send time whether a window waited out an outage, and
     the aggregator's delivery-latency histogram measures replays from the
     ORIGINAL append time under the ``path="replay"`` label.
+
+    The HA-ingest ring fields are transmit-time as well: ``owner`` (the
+    replica the agent believes owns it), ``epoch`` (the agent's known
+    ring epoch), and ``acked_through`` (the highest seq the agent has a
+    2xx for — any replica's). A spooled record replayed to a NEW owner
+    after a hand-off must carry the agent's CURRENT view, not the one
+    baked in at append time: ``acked_through`` is how a fresh owner's
+    seq tracker seeds without fabricating a leading-gap loss spike for
+    windows that were delivered to the previous owner.
 
     Only the JSON header is re-serialized — array bytes pass through
     untouched. Raises :class:`WireError` on a payload it cannot parse."""
@@ -142,6 +154,12 @@ def restamp_transmit(data: bytes, sent_at: float,
         header["delivery_path"] = str(delivery_path)
     if appended_at is not None:
         header["appended_at"] = float(appended_at)
+    if owner is not None:
+        header["owner"] = str(owner)
+    if epoch is not None:
+        header["epoch"] = int(epoch)
+    if acked_through is not None:
+        header["acked_through"] = int(acked_through)
     header_bytes = json.dumps(header, separators=(",", ":")).encode()
     return b"".join([MAGIC, _HEADER_LEN.pack(len(header_bytes)),
                      header_bytes, data[off + hlen:]])
@@ -176,6 +194,37 @@ def peek_node_name(data: bytes) -> str | None:
         return name if isinstance(name, str) and name else None
     except Exception:
         return None
+
+
+def peek_identity(data: bytes) -> tuple[str, int]:
+    """Best-effort ``(run, seq)`` from a payload (``("", 0)`` when
+    unreadable or absent).
+
+    Used by the agent's delivered-watermark accounting: a spooled
+    record's identity lives only in its wire header, and the agent
+    needs it at ACK time to advance ``acked_through`` — scoped to the
+    run, because an old run's replayed seqs say nothing about the
+    current run's stream. Never raises."""
+    try:
+        if data[: len(MAGIC)] != MAGIC:
+            return "", 0
+        off = len(MAGIC)
+        (hlen,) = _HEADER_LEN.unpack_from(data, off)
+        off += _HEADER_LEN.size
+        if hlen > MAX_HEADER_BYTES or off + hlen > len(data):
+            return "", 0
+        header = json.loads(data[off: off + hlen])
+        if not isinstance(header, dict):
+            return "", 0
+        seq = header.get("seq")
+        run = header.get("run")
+        if isinstance(seq, bool) or not isinstance(seq, int) or seq < 0:
+            seq = 0
+        if not isinstance(run, str):
+            run = ""
+        return run, seq
+    except Exception:
+        return "", 0
 
 
 # keplint: sanitizes — every field is validated (dtype whitelist, bounds

@@ -63,6 +63,12 @@ class APIServer:
         self._endpoints: dict[str, Endpoint] = {}
         self._servers: list[ThreadingHTTPServer] = []
         self._threads: list[threading.Thread] = []
+        # flipped by shutdown(): established keep-alive connections get
+        # one 503 + close instead of being served forever by their
+        # handler threads (stopping the listener alone leaves a
+        # "stopped" server happily answering persistent clients — a
+        # killed ingest replica must actually go dark)
+        self._draining = False
         # probe plane: services register health/readiness callables here
         # (fleet agent breaker, monitor watchdog, aggregator quarantine)
         self.health = HealthRegistry()
@@ -92,6 +98,13 @@ class APIServer:
                 log.debug("http: " + fmt, *args)
 
             def _dispatch(self):
+                if outer._draining:
+                    # shutting down: refuse (retryable) and sever the
+                    # keep-alive so the client reconnects elsewhere
+                    self.close_connection = True
+                    self._respond(503, {"Content-Type": "text/plain"},
+                                  b"shutting down\n")
+                    return
                 if outer._auth_check is not None and not outer._auth_check(
                         self.headers.get("Authorization")):
                     # body (if any) was never read — drop the connection so
@@ -188,6 +201,7 @@ class APIServer:
 
     def shutdown(self) -> None:
         """Graceful shutdown, 5 s bound (reference :158-165)."""
+        self._draining = True
         for server in self._servers:
             server.shutdown()
             server.server_close()

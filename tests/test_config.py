@@ -402,6 +402,20 @@ FIELD_MATRIX = [
     FieldCase("aggregator.anomaly_z",
               "aggregator: {anomalyZ: 2.5}", 2.5,
               ["--aggregator.anomaly-z", "6"], 6.0),
+    # HA ingest ring (ISSUE 11)
+    FieldCase("aggregator.peers",
+              "aggregator: {peers: ['a:1', 'b:2']}", ["a:1", "b:2"],
+              ["--aggregator.peers", "c:3", "--aggregator.peers", "d:4"],
+              ["c:3", "d:4"]),
+    FieldCase("aggregator.self_peer",
+              "aggregator: {selfPeer: 'a:1'}", "a:1",
+              ["--aggregator.self-peer", "b:2"], "b:2"),
+    FieldCase("aggregator.ring_epoch",
+              "aggregator: {ringEpoch: 5}", 5,
+              ["--aggregator.ring-epoch", "7"], 7),
+    FieldCase("aggregator.ring_vnodes",
+              "aggregator: {ringVnodes: 32}", 32,
+              ["--aggregator.ring-vnodes", "16"], 16),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -525,6 +539,9 @@ class TestYAMLSpellings:
         "dispatchTimeout": "aggregator",
         "scoreboardCap": "aggregator",
         "anomalyZ": "aggregator",
+        "selfPeer": "aggregator",
+        "ringEpoch": "aggregator",
+        "ringVnodes": "aggregator",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
@@ -579,6 +596,9 @@ class TestYAMLSpellings:
         "dispatchTimeout": ("15s", 15.0),
         "scoreboardCap": ("128", 128),
         "anomalyZ": ("2.5", 2.5),
+        "selfPeer": ("'a:1'", "a:1"),
+        "ringEpoch": ("3", 3),
+        "ringVnodes": ("16", 16),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),
@@ -690,6 +710,26 @@ class TestValidationMatrix:
         ("aggregator.anomalyZ",
          lambda c: setattr(c.aggregator, "anomaly_z", -1.0),
          "anomalyZ"),
+        ("aggregator.peers.empty-entry",
+         lambda c: setattr(c.aggregator, "peers", ["a:1", ""]),
+         "non-empty strings"),
+        ("aggregator.peers.duplicate",
+         lambda c: setattr(c.aggregator, "peers", ["a:1", "a:1"]),
+         "duplicates"),
+        ("aggregator.selfPeer.not-a-peer",
+         lambda c: (setattr(c.aggregator, "peers", ["a:1", "b:2"]),
+                    setattr(c.aggregator, "self_peer", "c:3")),
+         "selfPeer"),
+        ("aggregator.selfPeer.required-for-replica",
+         lambda c: (setattr(c.aggregator, "enabled", True),
+                    setattr(c.aggregator, "peers", ["a:1", "b:2"])),
+         "selfPeer must be set"),
+        ("aggregator.ringEpoch",
+         lambda c: setattr(c.aggregator, "ring_epoch", 0),
+         "ringEpoch"),
+        ("aggregator.ringVnodes",
+         lambda c: setattr(c.aggregator, "ring_vnodes", 0),
+         "ringVnodes"),
         ("fault.specs",
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),

@@ -131,6 +131,87 @@ class TestIngestHeaderCoercion:
         assert agg._reports["typed"].seq == 7
 
 
+class TestRingHeaderCoercion:
+    """Satellite (ISSUE 11): the owner/epoch/acked_through ring fields
+    are hardened exactly like run/seq — hostile values (non-int,
+    negative, bool, overlong/non-printable) quarantine as a 400 charged
+    to the node, never a 500."""
+
+    @pytest.mark.parametrize("bad", [
+        {"owner": 42},
+        {"owner": ["a"]},
+        {"owner": "evil\nname"},
+        {"owner": "x" * 300},
+        {"epoch": "abc"},
+        {"epoch": -1},
+        {"epoch": True},
+        {"epoch": 2.5},
+        {"acked_through": "9"},
+        {"acked_through": -2},
+        {"acked_through": 1.5},
+        {"acked_through": [1]},
+    ])
+    def test_bad_ring_headers_quarantined(self, server, bad):
+        agg = make_agg(server)
+        blob = mutate_header(
+            encode_report(make_report("ringed"), ["package", "dram"],
+                          seq=1, run="r1"), **bad)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_raw(server, blob)
+        assert err.value.code == 400
+        assert agg._stats["malformed_total"] == 1
+        assert "ringed" in agg.degraded_nodes()
+        assert "ringed" not in agg._reports
+
+    def test_good_ring_headers_ingest(self, server):
+        agg = make_agg(server)
+        blob = mutate_header(
+            encode_report(make_report("ringed"), ["package", "dram"],
+                          seq=3, run="r1"),
+            owner="10.0.0.1:28283", epoch=2, acked_through=2)
+        assert post_raw(server, blob).status == 204
+        assert agg._reports["ringed"].seq == 3
+
+    def test_acked_through_suppresses_handoff_leading_gap(self, server):
+        """A fresh owner meeting a mid-run stream seeds its tracker
+        from the agent's delivered watermark: windows a previous owner
+        acknowledged were delivered, not lost — while gaps ABOVE the
+        watermark keep counting as real loss."""
+        agg = make_agg(server)
+        blob = mutate_header(
+            encode_report(make_report("moved"), ["package", "dram"],
+                          seq=7, run="r1"), acked_through=6)
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["windows_lost_total"] == 0
+        blob = mutate_header(
+            encode_report(make_report("moved"), ["package", "dram"],
+                          seq=10, run="r1"), acked_through=6)
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["windows_lost_total"] == 2  # seqs 8, 9
+
+    def test_hostile_watermark_clamped_to_own_stream(self, server):
+        """An inflated acked_through can hide at most the node's OWN
+        leading gap (min() clamp) — later gaps still count."""
+        agg = make_agg(server)
+        blob = mutate_header(
+            encode_report(make_report("liar"), ["package", "dram"],
+                          seq=4, run="r1"), acked_through=10_000)
+        assert post_raw(server, blob).status == 204
+        assert agg._seq_trackers["liar"].max_seen == 4
+        blob = mutate_header(
+            encode_report(make_report("liar"), ["package", "dram"],
+                          seq=8, run="r1"), acked_through=10_000)
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["windows_lost_total"] == 3  # seqs 5, 6, 7
+
+    def test_no_watermark_keeps_conservative_accounting(self, server):
+        """Pre-handoff agents (no acked_through) keep PR-3 semantics:
+        a fresh tracker counts the full leading gap."""
+        agg = make_agg(server)
+        post_report(server, make_report("plain"), seq=5, run="r1")
+        assert agg._stats["windows_lost_total"] == 4
+
+
 class TestDedupWindow:
     def test_duplicate_run_seq_absorbed(self, server):
         agg = make_agg(server)

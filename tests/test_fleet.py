@@ -95,6 +95,26 @@ class TestWire:
         with pytest.raises(WireError):
             decode_report(encode_report(report, ["package", "dram"]))
 
+    def test_restamp_ring_fields_roundtrip(self):
+        """The HA-ingest transmit stamps (owner/epoch/acked_through)
+        rewrite only the header; arrays pass through untouched."""
+        from kepler_tpu.fleet.wire import peek_identity, restamp_transmit
+
+        report = make_report()
+        blob = encode_report(report, ["package", "dram"], seq=9, run="r1")
+        stamped = restamp_transmit(blob, 123.0, owner="10.0.0.2:28283",
+                                   epoch=4, acked_through=8)
+        decoded, header = decode_report(stamped)
+        assert header["owner"] == "10.0.0.2:28283"
+        assert header["epoch"] == 4
+        assert header["acked_through"] == 8
+        assert header["sent_at"] == 123.0
+        assert header["seq"] == 9
+        np.testing.assert_array_equal(decoded.zone_deltas_uj,
+                                      report.zone_deltas_uj)
+        assert peek_identity(stamped) == ("r1", 9)
+        assert peek_identity(b"garbage") == ("", 0)
+
 
 @pytest.fixture()
 def server():

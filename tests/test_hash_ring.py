@@ -1,0 +1,150 @@
+"""Consistent-hash ingest ring (fleet/ring.py): ownership agreement
+across independently built rings, the minimal-disruption property under
+membership change, hash-space accounting, and the wire sanitizers for
+peer-supplied owner/epoch values."""
+
+import pytest
+
+from kepler_tpu.fleet.ring import (
+    MAX_PEER_NAME,
+    HashRing,
+    RingError,
+    coerce_epoch,
+    sanitize_peer,
+)
+
+PEERS = ["10.0.0.1:28283", "10.0.0.2:28283", "10.0.0.3:28283"]
+
+
+def keys(n=400, prefix="node"):
+    return [f"{prefix}-{i:04d}" for i in range(n)]
+
+
+class TestOwnershipAgreement:
+    def test_same_peer_list_same_ownership(self):
+        """Two replicas configured with the same peers list (any order)
+        must agree on every node's owner with no coordination."""
+        a = HashRing(PEERS, epoch=1)
+        b = HashRing(list(reversed(PEERS)), epoch=7)
+        for k in keys():
+            assert a.owner(k) == b.owner(k)
+
+    def test_epoch_does_not_affect_ownership(self):
+        a = HashRing(PEERS, epoch=1)
+        b = HashRing(PEERS, epoch=99)
+        assert [a.owner(k) for k in keys()] == [b.owner(k) for k in keys()]
+
+    def test_ownership_is_stable_across_processes(self):
+        """blake2b placement, not Python's salted hash(): a fixed probe
+        key maps to a fixed owner forever (pins hash-fn drift — a
+        silent change would orphan every spooled backlog mid-upgrade)."""
+        ring = HashRing(PEERS, epoch=1)
+        assert ring.owner("node-0000") == "10.0.0.1:28283"
+
+    def test_distribution_roughly_even(self):
+        ring = HashRing(PEERS, epoch=1)
+        counts = {p: 0 for p in PEERS}
+        for k in keys(3000):
+            counts[ring.owner(k)] += 1
+        for p, c in counts.items():
+            assert 0.15 < c / 3000 < 0.55, counts
+
+
+class TestMinimalDisruption:
+    @pytest.mark.parametrize("removed", PEERS)
+    def test_removal_moves_only_the_departed_peers_keys(self, removed):
+        ring = HashRing(PEERS, epoch=1)
+        before = {k: ring.owner(k) for k in keys()}
+        survivors = [p for p in PEERS if p != removed]
+        shrunk = ring.with_members(survivors, epoch=2)
+        for k, prev in before.items():
+            if prev == removed:
+                assert shrunk.owner(k) in survivors
+            else:
+                assert shrunk.owner(k) == prev, (
+                    f"{k} moved {prev} -> {shrunk.owner(k)} though its "
+                    "owner survived")
+
+    def test_addition_only_steals_for_the_newcomer(self):
+        ring = HashRing(PEERS, epoch=1)
+        before = {k: ring.owner(k) for k in keys()}
+        grown = ring.with_members(PEERS + ["10.0.0.4:28283"], epoch=2)
+        for k, prev in before.items():
+            after = grown.owner(k)
+            assert after == prev or after == "10.0.0.4:28283"
+
+    def test_with_members_requires_epoch_increase(self):
+        ring = HashRing(PEERS, epoch=5)
+        with pytest.raises(RingError):
+            ring.with_members(PEERS[:2], epoch=5)
+        with pytest.raises(RingError):
+            ring.with_members(PEERS[:2], epoch=4)
+        assert ring.with_members(PEERS[:2], epoch=6).epoch == 6
+
+
+class TestHashSpaceAccounting:
+    def test_ownership_ratios_sum_to_one(self):
+        ring = HashRing(PEERS, epoch=1)
+        assert sum(ring.ownership_ratio(p) for p in PEERS) == \
+            pytest.approx(1.0)
+        assert ring.ownership_ratio("not-a-peer") == 0.0
+
+    def test_single_peer_owns_everything(self):
+        ring = HashRing(["solo:1"], epoch=1)
+        assert ring.ownership_ratio("solo:1") == 1.0
+        assert all(ring.owner(k) == "solo:1" for k in keys(50))
+
+    def test_describe_shape(self):
+        ring = HashRing(PEERS, epoch=3, vnodes=16)
+        d = ring.describe(PEERS[0])
+        assert d["epoch"] == 3 and d["vnodes"] == 16
+        assert d["self"] == PEERS[0]
+        assert sorted(d["peers"]) == sorted(PEERS)
+        assert 0.0 < d["ownership_ratio"] < 1.0
+
+
+class TestConstructionValidation:
+    @pytest.mark.parametrize("peers", [
+        [], [""], ["ok", "ok"], ["bad\nname"], ["x" * (MAX_PEER_NAME + 1)],
+        [42], [None],
+    ])
+    def test_bad_peers_rejected(self, peers):
+        with pytest.raises(RingError):
+            HashRing(peers)
+
+    @pytest.mark.parametrize("epoch", [0, -1, "1", 1.5, True])
+    def test_bad_epoch_rejected(self, epoch):
+        with pytest.raises(RingError):
+            HashRing(PEERS, epoch=epoch)
+
+    @pytest.mark.parametrize("vnodes", [0, -4, "8"])
+    def test_bad_vnodes_rejected(self, vnodes):
+        with pytest.raises(RingError):
+            HashRing(PEERS, vnodes=vnodes)
+
+
+class TestWireSanitizers:
+    """Peer-supplied owner/epoch values (redirect bodies, echoed report
+    headers) are untrusted until laundered here."""
+
+    @pytest.mark.parametrize("value,expect", [
+        ("10.0.0.1:28283", "10.0.0.1:28283"),
+        ("http://agg:28283", "http://agg:28283"),
+        ("", None),
+        (None, None),
+        (42, None),
+        (b"bytes", None),
+        ("evil\nname", None),
+        ("nul\x00byte", None),
+        ("x" * (MAX_PEER_NAME + 1), None),
+        ("x" * MAX_PEER_NAME, "x" * MAX_PEER_NAME),
+    ])
+    def test_sanitize_peer(self, value, expect):
+        assert sanitize_peer(value) == expect
+
+    @pytest.mark.parametrize("value,expect", [
+        (0, 0), (7, 7), (-1, None), (True, None), (False, None),
+        ("3", None), (3.0, None), (None, None), ([3], None),
+    ])
+    def test_coerce_epoch(self, value, expect):
+        assert coerce_epoch(value) == expect

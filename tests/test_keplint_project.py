@@ -248,6 +248,41 @@ _TAINT_SANITIZED_GOOD = {
     """,
 }
 
+# the HA-ingest ring idiom (ISSUE 11): a 421 redirect names a peer the
+# agent will dial — the peer value is wire input and must pass the
+# ring's sanitizer chokepoint before it becomes a label/store key
+_RING_REDIRECT_BAD = {
+    "kepler_tpu/ring_mod.py": """
+        # keplint: sanitizes
+        def sanitize_peer(name):
+            return name[:256]
+    """,
+    "kepler_tpu/agent_mod.py": """
+        # keplint: taint-source
+        def parse_redirect(body):
+            return body.get("owner")
+
+        def follow(fam, body) -> None:
+            owner = parse_redirect(body)
+            fam.labels(owner)
+    """,
+}
+
+_RING_REDIRECT_GOOD = {
+    "kepler_tpu/ring_mod.py": _RING_REDIRECT_BAD["kepler_tpu/ring_mod.py"],
+    "kepler_tpu/agent_mod.py": """
+        from kepler_tpu.ring_mod import sanitize_peer
+
+        # keplint: taint-source
+        def parse_redirect(body):
+            return body.get("owner")
+
+        def follow(fam, body) -> None:
+            owner = sanitize_peer(parse_redirect(body))
+            fam.labels(owner)
+    """,
+}
+
 _TAINT_STORE_BAD = {
     "kepler_tpu/taint_mod.py": """
         # keplint: taint-source
@@ -380,6 +415,16 @@ class TestTaint:
 
     def test_registered_sanitizer_cleans(self, plint):
         assert plint(_TAINT_SANITIZED_GOOD) == []
+
+    def test_ring_redirect_owner_must_be_sanitized(self, plint):
+        """Peer-supplied owner values (ring redirects) are untrusted:
+        raw use as a label is flagged; laundering through the ring's
+        cross-module `sanitizes` chokepoint is clean — the shipped
+        `fleet/ring.py` sanitize_peer/coerce_epoch pattern."""
+        diags = plint(_RING_REDIRECT_BAD)
+        assert ids(diags) == ["KTL112"]
+        assert "parse_redirect" in diags[0].message
+        assert plint(_RING_REDIRECT_GOOD) == []
 
     def test_store_key_sink_flagged(self, plint):
         diags = plint(_TAINT_STORE_BAD)

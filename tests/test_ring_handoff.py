@@ -1,0 +1,516 @@
+"""HA ingest tier (ISSUE 11): replicated aggregators behind the
+consistent-hash ring — redirect flow, lazy epoch learning, failover,
+and the chaos-marked kill/rebalance soak proving the headline
+invariant: kill one of three replicas mid-soak → zero
+``kepler_fleet_windows_lost_total``, bounded duplicates, scoreboard
+states converged on the surviving owners within 3 intervals, and the
+delivery-latency histogram recording the replay path across the
+hand-off."""
+
+import threading
+import time
+
+import pytest
+
+from kepler_tpu import fault
+from kepler_tpu.fault import FaultPlan, FaultSpec
+from kepler_tpu.fleet import Aggregator, FleetAgent, Spool
+from kepler_tpu.fleet.agent import BREAKER_CLOSED
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+from tests.test_fleet import FakeMeterMonitor, make_sample
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+def make_tier(n, **agg_kw):
+    """n replicas sharing one ring. Returns (servers, aggs, peers,
+    ctxs); peers are the dialable host:port ids the ring runs on."""
+    servers = []
+    for _ in range(n):
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        servers.append(s)
+    peers = [f"{h}:{p}" for (h, p) in (s.addresses[0] for s in servers)]
+    aggs, ctxs = [], []
+    kw = dict(model_mode=None, node_bucket=8, workload_bucket=16)
+    kw.update(agg_kw)
+    for i, s in enumerate(servers):
+        agg = Aggregator(s, peers=peers, self_peer=peers[i], **kw)
+        agg.init()
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        aggs.append(agg)
+        ctxs.append(ctx)
+    time.sleep(0.05)
+    return servers, aggs, peers, ctxs
+
+
+def kill_replica(servers, aggs, ctxs, i):
+    ctxs[i].cancel()
+    servers[i].shutdown()
+    aggs[i].shutdown()
+
+
+def shutdown_tier(servers, aggs, ctxs, dead=()):
+    for i in range(len(servers)):
+        if i in dead:
+            continue
+        kill_replica(servers, aggs, ctxs, i)
+
+
+def make_agent(name, peers, spool_dir=None, **kw):
+    kw.setdefault("backoff_initial", 0.001)
+    kw.setdefault("backoff_max", 0.002)
+    kw.setdefault("jitter_seed", 0)
+    kw.setdefault("timeout_s", 5.0)
+    spool = Spool(str(spool_dir)) if spool_dir is not None else None
+    agent = FleetAgent(FakeMeterMonitor(), endpoint=f"http://{peers[0]}",
+                       node_name=name,
+                       peers=[f"http://{p}" for p in peers],
+                       spool=spool, **kw)
+    agent.init()
+    return agent
+
+
+def names_owned_by(ring, peers, per_peer=2):
+    """Deterministic node names such that every peer owns exactly
+    ``per_peer`` of them (the ring is a pure function of the peer set,
+    so this is stable across runs)."""
+    chosen = {p: [] for p in peers}
+    i = 0
+    while any(len(v) < per_peer for v in chosen.values()):
+        name = f"hand-{i:03d}"
+        owner = ring.owner(name)
+        if len(chosen[owner]) < per_peer:
+            chosen[owner].append(name)
+        i += 1
+        assert i < 10_000
+    return chosen
+
+
+def drive_interval(agents, aggs, live, ts):
+    """One fleet interval: every agent emits + drains one window, every
+    live replica runs one aggregation window."""
+    for agent in agents:
+        agent._on_window(make_sample(ts))
+        agent._drain(None)
+    for i in live:
+        aggs[i].aggregate_once()
+
+
+class TestRedirectFlow:
+    def test_non_owned_report_redirects_and_agent_follows(self, tmp_path):
+        servers, aggs, peers, ctxs = make_tier(2)
+        try:
+            ring = aggs[0]._ring
+            name = next(n for n in (f"redir-{i}" for i in range(100))
+                        if ring.owner(n) == peers[1])
+            agent = make_agent(name, peers, tmp_path / "sp")
+            agent._on_window(make_sample())
+            agent._drain(None)
+            h = agent.health()
+            assert h["redirects_followed"] == 1
+            assert h["target"] == f"http://{peers[1]}"
+            assert h["ring_epoch"] == 1
+            assert h["queued"] == 0 and h["sent_total"] == 1
+            assert aggs[0]._stats["reports_redirected_total"] == 1
+            assert aggs[0]._stats["reports_total"] == 0
+            assert name in aggs[1]._reports
+            # redirected reports are never charged to the node
+            assert name not in aggs[0].degraded_nodes()
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_owned_report_is_accepted_directly(self):
+        servers, aggs, peers, ctxs = make_tier(2)
+        try:
+            ring = aggs[0]._ring
+            name = next(n for n in (f"own-{i}" for i in range(100))
+                        if ring.owner(n) == peers[0])
+            agent = make_agent(name, peers)
+            agent._on_window(make_sample())
+            agent._drain(None)
+            assert agent.health()["redirects_followed"] == 0
+            assert name in aggs[0]._reports
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_accept_advertises_epoch_and_agent_learns_it(self):
+        servers, aggs, peers, ctxs = make_tier(2, ring_epoch=4)
+        try:
+            ring = aggs[0]._ring
+            name = next(n for n in (f"ep-{i}" for i in range(100))
+                        if ring.owner(n) == peers[0])
+            agent = make_agent(name, peers)
+            agent._on_window(make_sample())
+            agent._drain(None)
+            assert agent.health()["ring_epoch"] == 4
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_debug_ring_and_probe(self):
+        servers, aggs, peers, ctxs = make_tier(2, degraded_ttl=0.2)
+        try:
+            import json
+            import urllib.request
+            host, port = servers[0].addresses[0]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/ring", timeout=5) as r:
+                payload = json.loads(r.read())
+            assert payload["enabled"] is True
+            assert payload["epoch"] == 1
+            assert payload["self"] == peers[0]
+            assert sorted(payload["peers"]) == sorted(peers)
+            assert 0.0 < payload["ownership_ratio"] < 1.0
+            probe = aggs[0].ring_health()
+            assert probe["ok"] and probe["epoch"] == 1
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_ringless_aggregator_owns_everything(self, tmp_path):
+        """peers unset (the default): no redirects, /debug/ring says
+        disabled — the single-replica tier is unchanged."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        agg = Aggregator(s, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = make_agent("solo-node", [f"{host}:{port}"])
+            agent._on_window(make_sample())
+            agent._drain(None)
+            assert "solo-node" in agg._reports
+            import json
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/ring", timeout=5) as r:
+                payload = json.loads(r.read())
+            assert payload["enabled"] is False
+            assert payload["epoch"] == 0
+            assert payload["ownership_ratio"] == 1.0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_membership_requires_increasing_epoch(self):
+        servers, aggs, peers, ctxs = make_tier(2)
+        try:
+            with pytest.raises(Exception):
+                aggs[0].apply_membership(peers, 1)  # not an increase
+            dropped = aggs[0].apply_membership([peers[0]], 2)
+            assert dropped == 0  # nothing stored yet
+            assert aggs[0]._ring.epoch == 2
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+
+class TestRedirectHardening:
+    def test_hostile_ever_fresh_owners_bounded(self, tmp_path):
+        """A replica answering every POST with 421 naming a fresh owner
+        must neither grow the agent's peer list without bound nor hot-
+        loop: the hop budget is frozen at the configured peer count, so
+        the drain degrades to the ordinary failure path."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        counter = {"n": 0}
+
+        def evil_handler(request):
+            counter["n"] += 1
+            import json as _json
+            body = _json.dumps({"owner": f"10.9.9.{counter['n']}:1234",
+                                "epoch": 1}).encode()
+            return 421, {"Content-Type": "application/json"}, body
+
+        s.register("/v1/report", "evil", "always redirects elsewhere",
+                   evil_handler, max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = make_agent("loop-node", [f"{host}:{port}"],
+                               tmp_path / "sp")
+            agent._on_window(make_sample())
+            agent._drain(None)  # returns via the failure path, no spin
+            # bounded learning: configured 1 peer + at most 8 learned
+            assert len(agent._peers) <= 9
+            assert counter["n"] <= 12  # hop-capped, not a hot loop
+            assert agent.backlog() == 1  # the window is safe in the spool
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_old_run_replay_never_advances_watermark(self, tmp_path):
+        """A previous run's spooled records replay with their original
+        identity, but their seqs must not inflate THIS run's
+        acked_through — that could mask the new run's own leading-
+        window loss on a fresh owner."""
+        from kepler_tpu.fleet import Spool, encode_report
+        from tests.test_fleet import make_report
+
+        servers, aggs, peers, ctxs = make_tier(1, stale_after=1e9)
+        try:
+            spool = Spool(str(tmp_path / "sp"))
+            spool.append(encode_report(make_report("wm-node"),
+                                       ["package", "dram"], seq=50,
+                                       run="previous-run"))
+            spool.close()
+            agent = make_agent("wm-node", peers, tmp_path / "sp")
+            agent._drain(None)  # replays the old-run backlog
+            assert agent.health()["sent_total"] == 1
+            assert agent._acked_through == 0  # old run: no vouching
+            agent._on_window(make_sample())
+            agent._drain(None)
+            assert agent._acked_through == 1  # this run's seq 1
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_health_target_strips_credentials(self):
+        """Endpoint userinfo (basic auth) must never leak through the
+        health payload or the stamped owner header."""
+        servers, aggs, peers, ctxs = make_tier(1)
+        try:
+            host, port = servers[0].addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://user:hunter2@{host}:{port}",
+                               node_name="cred-node", jitter_seed=0)
+            agent.init()
+            agent._on_window(make_sample())
+            agent._drain(None)
+            h = agent.health()
+            assert "hunter2" not in h["target"]
+            assert h["target"] == f"http://{host}:{port}"
+            stored = aggs[0]._reports.get("cred-node")
+            assert stored is not None
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_membership_change_drops_scoreboard_rows(self, tmp_path):
+        """A handed-off node's scoreboard row leaves with it — the old
+        owner must not decay it into a permanent false 'stale'."""
+        servers, aggs, peers, ctxs = make_tier(2, stale_after=1e9)
+        try:
+            ring = aggs[0]._ring
+            grown = ring.with_members(peers + ["10.9.9.9:1234"], 2)
+            name = next(n for n in (f"sb-{i}" for i in range(500))
+                        if ring.owner(n) == peers[0]
+                        and grown.owner(n) == "10.9.9.9:1234")
+            agent = make_agent(name, peers)
+            agent._on_window(make_sample())
+            agent._drain(None)
+            now = aggs[0]._clock()
+            assert name in aggs[0]._scoreboard.snapshot(now, 15.0)["nodes"]
+            dropped = aggs[0].apply_membership(
+                peers + ["10.9.9.9:1234"], 2)
+            assert dropped == 1
+            snap = aggs[0]._scoreboard.snapshot(aggs[0]._clock(), 15.0)
+            assert name not in snap["nodes"]
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+
+class TestRingMetrics:
+    def test_families_exported(self):
+        servers, aggs, peers, ctxs = make_tier(2)
+        try:
+            fams = {f.name: f for f in aggs[0].collect()}
+            assert fams["kepler_fleet_ring_epoch"].samples[0].value == 1
+            ratio = fams["kepler_fleet_ring_ownership_ratio"]
+            assert 0.0 < ratio.samples[0].value < 1.0
+            # counter families expose without the _total suffix
+            assert "kepler_fleet_reports_redirected" in fams
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+
+@pytest.mark.chaos
+class TestRingHandoffChaos:
+    """The headline invariant, end to end over real HTTP."""
+
+    def test_kill_one_of_three_replicas_no_loss(self, tmp_path):
+        servers, aggs, peers, ctxs = make_tier(
+            3, stale_after=1e9, degraded_ttl=0.4)
+        victim = 1
+        agents = []
+        try:
+            ring = aggs[0]._ring
+            owned = names_owned_by(ring, peers, per_peer=2)
+            displaced = list(owned[peers[victim]])
+            agents = [make_agent(name, peers, tmp_path / name)
+                      for name in sum(owned.values(), [])]
+            live = [0, 1, 2]
+
+            # pre-kill soak: everyone delivers to their owner
+            ts = 100.0
+            for _ in range(4):
+                ts += 5.0
+                drive_interval(agents, aggs, live, ts)
+            for p, names in owned.items():
+                agg = aggs[peers.index(p)]
+                assert sorted(agg._reports) == sorted(names)
+            assert sum(a._stats["windows_lost_total"] for a in aggs) == 0
+
+            # kill one replica mid-soak; survivors adopt epoch 2
+            kill_replica(servers, aggs, ctxs, victim)
+            live = [0, 2]
+            survivors = [peers[0], peers[2]]
+            for i in live:
+                aggs[i].apply_membership(survivors, 2)
+
+            # hand-off soak: displaced agents fail over, follow the
+            # redirect, and replay their spool tail to the new owner
+            for k in range(6):
+                ts += 5.0
+                drive_interval(agents, aggs, live, ts)
+                if k == 2:
+                    # convergence bound: within 3 intervals of the kill
+                    # every displaced node is healthy on its NEW owner
+                    new_ring = aggs[0]._ring
+                    for name in displaced:
+                        agg = aggs[peers.index(new_ring.owner(name))]
+                        now = agg._clock()
+                        snap = agg._scoreboard.snapshot(now, 15.0)
+                        assert name in snap["nodes"], (name, snap["nodes"])
+                        assert snap["nodes"][name]["state"] == "healthy"
+
+            # ZERO loss across the surviving tier
+            for i in live:
+                assert aggs[i]._stats["windows_lost_total"] == 0, \
+                    aggs[i]._lost_by_node
+            # duplicates bounded: at most the hand-off tail per displaced
+            # agent (plus the in-flight retry), absorbed by dedup
+            dup_total = sum(aggs[i]._stats["duplicates_total"]
+                            for i in live)
+            assert dup_total <= len(displaced) * 9, dup_total
+            # every agent settled: fully drained, breaker closed, on the
+            # new membership epoch
+            for agent in agents:
+                h = agent.health()
+                assert h["queued"] == 0, h
+                assert h["breaker"] == BREAKER_CLOSED
+                assert h["ring_epoch"] == 2, h
+            # displaced agents actually handed off (followed a redirect
+            # and rewound their spool tail)
+            for agent in agents:
+                if agent._node_name in displaced:
+                    h = agent.health()
+                    assert h["redirects_followed"] >= 1
+                    assert h["handoffs"] >= 1
+            # the hand-off is visible in the delivery-latency histogram:
+            # the replayed tail lands under path="replay" on a survivor
+            replay = sum(a._delivery_hist["replay"].count
+                         for i, a in enumerate(aggs) if i in live)
+            assert replay > 0
+            # every displaced node is attributed by its new owner
+            new_ring = aggs[0]._ring
+            for name in displaced:
+                owner_agg = aggs[peers.index(new_ring.owner(name))]
+                assert name in owner_agg._reports
+        finally:
+            for agent in agents:
+                agent.shutdown()
+            shutdown_tier(servers, aggs, ctxs, dead=(victim,))
+
+    def test_healthz_degrades_then_recovers_across_handoff(self, tmp_path):
+        """Survivors' fleet-ring probe reports the rebalance: degraded
+        while displaced agents are still being redirected, ok again
+        once the hand-off settles (degradedTtl of redirect silence)."""
+        servers, aggs, peers, ctxs = make_tier(
+            2, stale_after=1e9, degraded_ttl=0.3)
+        try:
+            ring = aggs[0]._ring
+            # a node owned by replica 1; the agent starts pointed at 0
+            name = next(n for n in (f"hz-{i}" for i in range(100))
+                        if ring.owner(n) == peers[1])
+            agent = make_agent(name, peers, tmp_path / "sp")
+            assert aggs[0].ring_health()["ok"]
+            agent._on_window(make_sample())
+            agent._drain(None)
+            # replica 0 just redirected: its hand-off probe is degraded
+            assert not aggs[0].ring_health()["ok"]
+            time.sleep(0.35)
+            # settled: no redirects within the ttl → recovered
+            assert aggs[0].ring_health()["ok"]
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_one_way_partition_duplicates_absorbed(self, tmp_path):
+        """net.partition: the replica ingests the report but the agent
+        never sees the 204 — the retry is a duplicate the dedup window
+        absorbs; nothing is lost, nothing double-ingested."""
+        servers, aggs, peers, ctxs = make_tier(1, stale_after=1e9)
+        try:
+            ring = aggs[0]._ring
+            name = "part-node"
+            agent = make_agent(name, peers, tmp_path / "sp")
+            with fault.installed(FaultPlan([
+                    FaultSpec("net.partition", count=1)])) as plan:
+                agent._on_window(make_sample(100.0))
+                agent._drain(None)  # delivered, response dropped → failure
+                assert plan.fired("net.partition") == 1
+                agent._drain(None)  # re-delivery → 204 (duplicate)
+            h = agent.health()
+            assert h["queued"] == 0
+            st = aggs[0]._stats
+            assert st["duplicates_total"] == 1
+            assert st["windows_lost_total"] == 0
+            # ingested exactly once: seq tracker saw one real window
+            assert aggs[0]._reports[name].seq == 1
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)
+
+    def test_replica_down_failover_and_recovery(self, tmp_path):
+        """replica.down: a transient 503 outage with no membership
+        change — the agent rotates peers, gets redirected back, spools
+        through the outage, and drains with zero loss on recovery."""
+        servers, aggs, peers, ctxs = make_tier(2, stale_after=1e9)
+        try:
+            ring = aggs[0]._ring
+            name = next(n for n in (f"down-{i}" for i in range(100))
+                        if ring.owner(n) == peers[0])
+            agent = make_agent(name, peers, tmp_path / "sp")
+            # healthy delivery first
+            agent._on_window(make_sample(100.0))
+            agent._drain(None)
+            assert agent.health()["sent_total"] == 1
+            # outage: both replicas' ingest answers 503 twice
+            with fault.installed(FaultPlan([
+                    FaultSpec("replica.down", count=2)])) as plan:
+                agent._on_window(make_sample(105.0))
+                agent._drain(None)
+                assert plan.fired("replica.down") >= 1
+            # recovery: the backlog drains, possibly via a redirect from
+            # the non-owner the failover rotated to
+            for _ in range(4):
+                agent._drain(None)
+                if agent.backlog() == 0:
+                    break
+            h = agent.health()
+            assert h["queued"] == 0, h
+            assert aggs[0]._stats["windows_lost_total"] == 0
+            assert aggs[0]._reports[name].seq == 2
+            agent.shutdown()
+        finally:
+            shutdown_tier(servers, aggs, ctxs)

@@ -42,6 +42,74 @@ def drain(spool):
         spool.ack()
 
 
+class TestRewind:
+    """Hand-off tail replay (ISSUE 11): the ack cursor walks back over
+    already-acknowledged records so a new ingest owner receives the
+    recent stream."""
+
+    def test_rewind_redelivers_acked_tail_in_order(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(6)
+        for p in data:
+            s.append(p)
+        assert drain(s) == data
+        assert s.rewind(3) == 3
+        assert s.pending_records() == 3
+        assert drain(s) == data[3:]
+        assert s.stats()["rewound_total"] == 3
+        s.close()
+
+    def test_rewind_bounded_by_acked_history(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(2)
+        for p in data:
+            s.append(p)
+        drain(s)
+        # asking for more than exists rewinds what the segment holds
+        assert s.rewind(50) == 2
+        assert drain(s) == data
+        s.close()
+
+    def test_rewind_noop_cases(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        assert s.rewind(4) == 0  # empty spool
+        s.append(payloads(1)[0])
+        assert s.rewind(0) == 0  # disabled
+        assert s.rewind(4) == 0  # nothing acked yet
+        assert s.pending_records() == 1
+        s.close()
+
+    def test_rewind_drops_stale_peek(self, tmp_path):
+        """A peeked-but-unacked record from before the rewind must not
+        ack a different record afterwards (cursor-validated ack)."""
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(3)
+        for p in data:
+            s.append(p)
+        assert s.peek().payload == data[0]
+        s.ack()
+        rec = s.peek()
+        assert rec.payload == data[1]
+        assert s.rewind(1) == 1
+        # the stale ack is a no-op; the drain restarts at the rewound tail
+        s.ack(rec)
+        assert drain(s) == data
+        s.close()
+
+    def test_rewind_survives_restart(self, tmp_path):
+        s = Spool(str(tmp_path / "sp"))
+        data = payloads(4)
+        for p in data:
+            s.append(p)
+        drain(s)
+        assert s.rewind(2) == 2
+        s.close()
+        s2 = Spool(str(tmp_path / "sp"))  # persisted rewound cursor
+        assert s2.pending_records() == 2
+        assert drain(s2) == data[2:]
+        s2.close()
+
+
 class TestSpoolBasics:
     def test_append_peek_ack_order(self, tmp_path):
         s = Spool(str(tmp_path / "sp"))
