@@ -26,7 +26,7 @@ test-verbose:
 	$(PYTHON) -m pytest tests/ -v
 
 .PHONY: chaos
-chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines
+chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines, ring kill/rebalance, overload herd
 	$(PYTHON) -m pytest tests/ -q -m chaos
 
 .PHONY: verify

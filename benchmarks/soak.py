@@ -72,9 +72,11 @@ def percentile(sorted_vals: list[float], q: float) -> float:
 def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              interval: float = 5.0, workloads: int = 100,
              model_mode: str | None = "mlp", replicas: int = 1,
-             kill_at: float = 0.0) -> dict:
+             kill_at: float = 0.0, shed: bool = False,
+             rebalance_after: float = 0.0) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
-    from kepler_tpu.fleet.wire import encode_report, restamp_transmit
+    from kepler_tpu.fleet.wire import (encode_report, encode_report_batch,
+                                       restamp_transmit)
     from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
     from kepler_tpu.parallel.mesh import make_mesh
     from kepler_tpu.server.http import APIServer
@@ -85,7 +87,19 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     # redirects and fail over between replicas. --kill-at shuts one
     # replica down mid-soak and rebalances the survivors (epoch 2) —
     # the gate then requires ZERO windows lost across the hand-off.
+    #
+    # --shed (ISSUE 12 herd mode): the replicas run ADMISSION CONTROL
+    # (429 + Retry-After under load) and the agents keep a local
+    # backlog they drain BATCHED through /v1/reports — the soak then
+    # measures the overload plane itself: sheds fired, drain requests
+    # vs records (batching factor), and the survivors' post-kill
+    # ingest p99.
     replicas = max(1, int(replicas))
+    admission_kw = dict(
+        admission_enabled=True, admission_max_inflight=64,
+        admission_latency_budget=0.25, admission_retry_after=0.5,
+        admission_retry_after_max=5.0, admission_jitter_seed=0,
+    ) if shed else {}
     servers: list[APIServer] = []
     for _ in range(replicas):
         s = APIServer(listen_addresses=["127.0.0.1:0"])
@@ -101,7 +115,8 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                          model_mode=model_mode, node_bucket=64,
                          workload_bucket=128, pipeline_depth=2,
                          peers=peers if replicas > 1 else None,
-                         self_peer=peers[i] if replicas > 1 else "")
+                         self_peer=peers[i] if replicas > 1 else "",
+                         **admission_kw)
         agg._mesh = make_mesh()
         agg.init()
         ctx = CancelContext()
@@ -126,6 +141,11 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     rejects = np.zeros(n_agents, np.int64)
     errors = np.zeros(n_agents, np.int64)
     redirects = np.zeros(n_agents, np.int64)
+    throttled = np.zeros(n_agents, np.int64)
+    drain_requests = np.zeros(n_agents, np.int64)
+    drain_records = np.zeros(n_agents, np.int64)
+    drain_batch_peak = np.zeros(n_agents, np.int64)
+    kill_mono = [float("inf")]  # monotonic instant the victim died
     stop = threading.Event()
 
     def agent(idx: int) -> None:
@@ -217,28 +237,216 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             stop.wait(interval)
         conn.close()
 
+    def shed_agent(idx: int) -> None:
+        """Herd-mode sender (--shed): emits on cadence into a local
+        backlog (the spool stand-in) and drains it BATCHED through
+        /v1/reports — 429s honored (bounded), 421s followed, outages
+        survived by the backlog rather than a blocking retry loop."""
+        rng_local = np.random.default_rng(idx)
+        cpu = rng_local.uniform(0.1, 5.0, workloads).astype(np.float32)
+        rep = NodeReport(
+            node_name=f"soak-{idx:04d}",
+            zone_deltas_uj=rng_local.uniform(1e7, 5e8, 4).astype(
+                np.float32),
+            zone_valid=np.ones(4, bool),
+            usage_ratio=0.6,
+            cpu_deltas=cpu,
+            workload_ids=[f"s{idx}-w{k}" for k in range(workloads)],
+            node_cpu_delta=float(cpu.sum()),
+            dt_s=interval,
+            mode=MODE_MODEL if idx % 2 else MODE_RATIO,
+            workload_kinds=np.ones(workloads, np.int8),
+        )
+        t_idx = idx % len(peers)
+
+        def connect():
+            h, _, p = peers[t_idx].rpartition(":")
+            return http.client.HTTPConnection(h, int(p), timeout=30)
+
+        def failover():
+            nonlocal t_idx, conn
+            conn.close()
+            t_idx = (t_idx + 1) % len(peers)
+            conn = connect()
+
+        def follow(owner, adv_epoch):
+            nonlocal t_idx, conn, epoch
+            try:
+                epoch = max(epoch, int(adv_epoch or 0))
+            except (TypeError, ValueError):
+                pass
+            conn.close()
+            t_idx = (peers.index(owner) if owner in peers
+                     else (t_idx + 1) % len(peers))
+            conn = connect()
+
+        conn = connect()
+        seq = 0
+        acked = 0
+        epoch = 0
+        backlog: list[tuple[int, bytes]] = []
+        time.sleep((idx / n_agents) * interval)
+        lat = latencies[idx]
+
+        def drain() -> None:
+            nonlocal acked
+            attempts = 0
+            while backlog and not stop.is_set() and attempts < 8:
+                attempts += 1
+                head_seq = backlog[0][0]
+                bodies = []
+                for k, (s_, base_) in enumerate(backlog[:32]):
+                    # everything but the newest window is a replay —
+                    # under admission pressure the backlog waits while
+                    # fresh ground truth keeps flowing
+                    path = "replay" if s_ < seq else "fresh"
+                    # sent_at is semantically WALL time (skew check)
+                    sent_at = time.time()  # keplint: disable=KTL101
+                    bodies.append(restamp_transmit(
+                        base_, sent_at, delivery_path=path,
+                        owner=peers[t_idx], epoch=epoch,
+                        acked_through=acked))
+                t0 = time.perf_counter()
+                try:
+                    if len(bodies) == 1:
+                        conn.request("POST", "/v1/report", body=bodies[0])
+                    else:
+                        conn.request("POST", "/v1/reports",
+                                     body=encode_report_batch(bodies))
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    status = resp.status
+                except OSError:
+                    errors[idx] += 1
+                    failover()
+                    return
+                lat.append((time.monotonic(),
+                            (time.perf_counter() - t0) * 1e3))
+                if len(bodies) > 1:
+                    drain_requests[idx] += 1
+                if status == 429:
+                    throttled[idx] += 1
+                    try:
+                        retry = float(resp.headers.get("Retry-After", 1))
+                    except (TypeError, ValueError):
+                        retry = 1.0
+                    stop.wait(min(max(retry, 0.05), interval))
+                    return
+                if status == 421:
+                    redirects[idx] += 1
+                    owner = ""
+                    try:
+                        payload = json.loads(data)
+                        owner = payload.get("owner", "")
+                        follow(owner, payload.get("epoch", 0))
+                    except (ValueError, TypeError):
+                        failover()
+                    continue
+                if status >= 500:
+                    errors[idx] += 1
+                    failover()
+                    stop.wait(min(0.25, interval))
+                    return
+                if len(bodies) == 1:
+                    if status == 204:
+                        acked = max(acked, head_seq)
+                    else:
+                        rejects[idx] += 1
+                    backlog.pop(0)
+                    continue
+                # batch response: conclude the per-record prefix
+                try:
+                    rows = json.loads(data).get("results", [])
+                except (ValueError, AttributeError):
+                    rows = []
+                concluded = 0
+                throttled_row = None
+                redirect_row = None
+                for row in rows[:len(bodies)]:
+                    st = (row.get("status")
+                          if isinstance(row, dict) else None)
+                    if isinstance(st, bool) or not isinstance(st, int):
+                        break
+                    if 200 <= st < 300:
+                        acked = max(acked, backlog[concluded][0])
+                        concluded += 1
+                    elif st == 429:
+                        throttled_row = row
+                        break
+                    elif st == 421:
+                        redirect_row = row
+                        break
+                    elif 400 <= st < 500:
+                        rejects[idx] += 1
+                        concluded += 1
+                    else:
+                        break
+                del backlog[:concluded]
+                drain_records[idx] += concluded
+                drain_batch_peak[idx] = max(drain_batch_peak[idx],
+                                            concluded)
+                if throttled_row is not None:
+                    throttled[idx] += 1
+                    try:
+                        retry = float(throttled_row.get("retry_after", 1))
+                    except (TypeError, ValueError):
+                        retry = 1.0
+                    stop.wait(min(max(retry, 0.05), interval))
+                    return
+                if redirect_row is not None:
+                    follow(redirect_row.get("owner", ""),
+                           redirect_row.get("epoch", 0))
+                    continue
+                if concluded == 0:
+                    errors[idx] += 1
+                    failover()
+                    return
+
+        while not stop.is_set():
+            seq += 1
+            backlog.append((seq, encode_report(rep, zones, seq=seq,
+                                               run=f"r{idx}")))
+            drain()
+            stop.wait(interval)
+        conn.close()
+
     del rng  # each agent thread builds its own generator
     rss_boot = rss_mib()
     t_start = time.monotonic()
-    agents = [threading.Thread(target=agent, args=(i,), daemon=True)
+    sender = shed_agent if shed else agent
+    agents = [threading.Thread(target=sender, args=(i,), daemon=True)
               for i in range(n_agents)]
     for t in agents:
         t.start()
 
     killer = None
     if victim >= 0:
+        def rebalance() -> None:
+            surviving = [p for i, p in enumerate(peers) if i != victim]
+            for i in sorted(live):
+                aggs[i].apply_membership(surviving, 2)
+
         def kill_and_rebalance() -> None:
             # the chaos leg: one replica goes dark mid-soak, survivors
             # adopt the shrunken membership at epoch 2 — displaced
             # agents fail over, follow redirects, and the gate proves
-            # no window was lost across the hand-off
+            # no window was lost across the hand-off.
+            # --rebalance-after > 0 (herd mode) delays the membership
+            # change past the kill: until then the ring still names the
+            # dead replica as owner, so displaced agents accumulate a
+            # real backlog — the thundering herd the batched drain and
+            # admission control then have to absorb.
+            kill_mono[0] = time.monotonic()
             ctxs[victim].cancel()
             servers[victim].shutdown()
             aggs[victim].shutdown()
             live.discard(victim)
-            surviving = [p for i, p in enumerate(peers) if i != victim]
-            for i in live:
-                aggs[i].apply_membership(surviving, 2)
+            if rebalance_after > 0:
+                t = threading.Timer(rebalance_after, rebalance)
+                t.daemon = True
+                t.start()
+            else:
+                rebalance()
 
         killer = threading.Timer(max(0.0, kill_at), kill_and_rebalance)
         killer.daemon = True
@@ -293,7 +501,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     flat = sorted(v for t, v in all_samples if t >= steady_mono)
     if not flat:
         flat = sorted(v for _, v in all_samples)
-    return {
+    out = {
         "soak_agents": n_agents,
         "soak_seconds": round(duration, 1),
         "soak_reports_sent": len(all_samples),
@@ -318,6 +526,32 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         "soak_windows_lost": int(stats.get("windows_lost_total", 0)),
         "soak_duplicates": int(stats.get("duplicates_total", 0)),
     }
+    if shed:
+        shed_total = sum(
+            sum(aggs[i]._admission.shed_by_reason().values())
+            for i in sorted(live))
+        survivor = sorted(v for t, v in all_samples
+                          if t >= kill_mono[0])
+        out.update({
+            "soak_shed": True,
+            "soak_shed_total": int(shed_total),
+            "soak_throttled": int(throttled.sum()),
+            "soak_drain_requests": int(drain_requests.sum()),
+            "soak_drain_records": int(drain_records.sum()),
+            "soak_drain_records_per_request": (
+                round(drain_records.sum() / drain_requests.sum(), 2)
+                if drain_requests.sum() else 0.0),
+            # deepest single recovery-replay batch delivered — the
+            # request-count cut vs the PR 11 one-record-per-request
+            # baseline is this over 1
+            "soak_drain_batch_peak": int(drain_batch_peak.max()),
+            # the headline herd number: ingest p99 on the SURVIVORS
+            # after the kill (equals the overall p99 with no kill)
+            "soak_survivor_ingest_p99_ms": round(
+                percentile(survivor, 0.99), 2) if survivor else
+                round(percentile(flat, 0.99), 2),
+        })
+    return out
 
 
 def gate(row: dict, p99_budget_ms: float = 250.0,
@@ -342,6 +576,23 @@ def gate(row: dict, p99_budget_ms: float = 250.0,
         failures.append(
             f"{row['soak_windows_lost']} windows lost across the "
             "replicated ingest tier (hand-off must be replay, not loss)")
+    if row.get("soak_shed"):
+        # herd mode: batched drain must measurably cut request count —
+        # the deep recovery replay ships ≥ 8 records in one request
+        # (the PR 11 baseline was exactly 1 record per request)
+        if row.get("soak_replica_killed") \
+                and row["soak_drain_batch_peak"] < 8:
+            failures.append(
+                f"deepest recovery batch delivered "
+                f"{row['soak_drain_batch_peak']} records (< 8): "
+                "recovery replay is not batching")
+        if row.get("soak_replica_killed") \
+                and row["soak_survivor_ingest_p99_ms"] > p99_budget_ms:
+            failures.append(
+                f"survivor ingest p99 "
+                f"{row['soak_survivor_ingest_p99_ms']} ms > "
+                f"{p99_budget_ms} ms after the kill (admission control "
+                "failed to hold the herd off)")
     return failures
 
 
@@ -356,6 +607,18 @@ def main() -> None:
     p.add_argument("--kill-at", type=float, default=0.0,
                    help="seconds into the soak to kill one replica and "
                         "rebalance (0 = no kill; needs --replicas >= 2)")
+    p.add_argument("--shed", action="store_true",
+                   help="herd mode (ISSUE 12): replicas run admission "
+                        "control (429 + Retry-After) and agents drain "
+                        "their backlog batched through /v1/reports; "
+                        "emits soak_shed_total / soak_drain_requests / "
+                        "soak_survivor_ingest_p99_ms and gates the "
+                        "deepest recovery batch at >= 8 records")
+    p.add_argument("--rebalance-after", type=float, default=None,
+                   help="seconds AFTER the kill before survivors adopt "
+                        "the shrunken membership (ownership-convergence "
+                        "lag; default 0, or 8 intervals in --shed herd "
+                        "mode so displaced agents build a real backlog)")
     p.add_argument("--p99-budget-ms", type=float, default=250.0)
     p.add_argument("--rss-budget-mib", type=float, default=96.0,
                    help="steady-state (post-ramp) RSS growth gate")
@@ -365,9 +628,13 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    rebalance_after = args.rebalance_after
+    if rebalance_after is None:
+        rebalance_after = 8 * args.interval if args.shed else 0.0
     row = run_soak(args.agents, args.seconds, args.interval,
                    args.workloads, replicas=args.replicas,
-                   kill_at=args.kill_at)
+                   kill_at=args.kill_at, shed=args.shed,
+                   rebalance_after=rebalance_after)
     row["soak_rss_growth_budget_mib"] = args.rss_budget_mib
     failures = ([] if args.no_gate
                 else gate(row, args.p99_budget_ms, args.rss_budget_mib))

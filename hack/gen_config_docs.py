@@ -94,6 +94,12 @@ DESCRIPTIONS = {
                        "auth) applied to every listener.",
     "web.listen_addresses": "API server listen addresses (repeatable "
                             "`--web.listen-address`).",
+    "web.max_connections": "Concurrent-connection cap per listener: an "
+                           "accept over the cap is answered `503 + "
+                           "Connection: close` immediately, with NO "
+                           "handler thread spawned — a connection "
+                           "storm can't grow threads without bound "
+                           "(`0` = unbounded).",
     "debug.pprof.enabled": "Mount the pprof-style debug service "
                            "(`/debug/pprof/`: stacks, profile, JAX "
                            "trace).",
@@ -243,6 +249,34 @@ DESCRIPTIONS = {
     "aggregator.ring_vnodes": "Virtual nodes per ring peer: ownership "
                               "granularity (higher = smoother "
                               "distribution, slower ring build).",
+    "aggregator.admission_enabled": "Ingest admission control: shed "
+                                    "with `429 + Retry-After` BEFORE "
+                                    "decode work when the inflight or "
+                                    "latency budget is blown — "
+                                    "priority-aware (replay backlogs "
+                                    "first, live RAPL ground truth "
+                                    "last). Loss-free: shed records "
+                                    "stay spooled on the agent and "
+                                    "replay later.",
+    "aggregator.admission_max_inflight": "Inflight-ingest budget: "
+                                         "admitted requests being "
+                                         "decoded/merged concurrently "
+                                         "before the shed ladder "
+                                         "engages.",
+    "aggregator.admission_latency_budget": "Per-record ingest service-"
+                                           "time budget (EWMA) the "
+                                           "shed ladder is scaled "
+                                           "against (`0` disables the "
+                                           "latency signal).",
+    "aggregator.admission_retry_after": "Base `Retry-After` answered "
+                                        "on a shed; multiplied by the "
+                                        "measured load and jittered "
+                                        "±50% so a throttled herd "
+                                        "doesn't re-arrive in phase.",
+    "aggregator.admission_retry_after_max": "Clamp on the shed "
+                                            "`Retry-After` — the "
+                                            "longest an agent is ever "
+                                            "asked to stay away.",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -261,6 +295,20 @@ DESCRIPTIONS = {
                          "on the per-send path), `always`, or `none`.",
     "agent.spool.fsync_interval": "Minimum spacing between batched spool "
                                   "fsyncs.",
+    "agent.drain.batch_max": "Spooled records shipped per `/v1/reports` "
+                             "request during recovery replay (`1` = "
+                             "the single-record drain; per-record "
+                             "status in the response keeps every "
+                             "dedup/loss invariant record-grained).",
+    "agent.drain.replay_rps": "Token-bucket cap on spool-replay "
+                              "records/second, so a rejoining agent "
+                              "slews its backlog in instead of dumping "
+                              "it on a recovering replica (`0` = "
+                              "unpaced).",
+    "agent.drain.retry_after_max": "Clamp on any server-sent "
+                                   "`Retry-After` the agent honors — "
+                                   "an adversarial owner must not be "
+                                   "able to park an agent forever.",
     "service.restart_max": "Supervised restarts per crashing service "
                            "before the group fails (`0` = reference "
                            "semantics: first crash ends the group).",
@@ -312,6 +360,7 @@ FLAG_OF = {
     "debug.pprof.enabled": "--debug.pprof / --no-debug.pprof",
     "web.config_file": "--web.config-file",
     "web.listen_addresses": "--web.listen-address (repeatable)",
+    "web.max_connections": "--web.max-connections",
     "exporter.stdout.enabled": "--exporter.stdout / --no-exporter.stdout",
     "exporter.prometheus.enabled":
         "--exporter.prometheus / --no-exporter.prometheus",
@@ -344,6 +393,9 @@ FLAG_OF = {
     "aggregator.self_peer": "--aggregator.self-peer",
     "aggregator.ring_epoch": "--aggregator.ring-epoch",
     "aggregator.ring_vnodes": "--aggregator.ring-vnodes",
+    "aggregator.admission_enabled":
+        "--aggregator.admission-enabled / "
+        "--no-aggregator.admission-enabled",
     "agent.spool.dir": "--agent.spool-dir",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
@@ -360,6 +412,10 @@ _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
                    "aggregator.breaker_cooldown", "aggregator.flush_timeout",
                    "aggregator.skew_tolerance", "aggregator.degraded_ttl",
                    "aggregator.dispatch_timeout",
+                   "aggregator.admission_latency_budget",
+                   "aggregator.admission_retry_after",
+                   "aggregator.admission_retry_after_max",
+                   "agent.drain.retry_after_max",
                    "service.restart_backoff_initial",
                    "service.restart_backoff_max"}
 

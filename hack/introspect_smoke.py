@@ -37,6 +37,11 @@ FLEET_REQUIRED = {"cap", "anomaly_z", "flag_ttl_s", "stale_after_s",
 RING_REQUIRED = {"enabled", "epoch", "self", "peers", "vnodes",
                  "ownership_ratio", "owned_nodes", "redirected_total",
                  "last_redirect_age_s"}
+# the fleet-ingest admission probe on /healthz (ISSUE 12): resilience.md
+# "Overload and backpressure"
+INGEST_REQUIRED = {"ok", "shedding", "inflight", "max_inflight",
+                   "latency_ewma_s", "latency_budget_s", "load",
+                   "shed_total", "shed_by_reason"}
 NODE_REQUIRED = {"state", "state_code", "last_seen_age_s", "reports",
                  "duplicates", "windows_lost", "quarantined",
                  "delivery_ewma_s", "power_w", "power_mean_w",
@@ -67,7 +72,8 @@ def main() -> int:
     agg = Aggregator(server, model_mode="mlp", node_bucket=8,
                      workload_bucket=16, stale_after=1e9,
                      peers=["127.0.0.1:28283"],
-                     self_peer="127.0.0.1:28283")
+                     self_peer="127.0.0.1:28283",
+                     admission_enabled=True)
     agg.init()
     server.init()
     ctx = CancelContext()
@@ -142,12 +148,27 @@ def main() -> int:
         _check(ring["redirected_total"] == 0,
                "no redirects on a 1-peer ring")
 
+        with urllib.request.urlopen(f"{base}/healthz",
+                                    timeout=10) as resp:
+            healthz = json.loads(resp.read())
+        ingest = healthz.get("components", {}).get("fleet-ingest")
+        _check(isinstance(ingest, dict),
+               "fleet-ingest probe registered on /healthz")
+        missing = INGEST_REQUIRED - set(ingest)
+        _check(not missing, f"fleet-ingest probe missing keys {missing}")
+        _check(ingest["ok"] is True and ingest["shedding"] is False,
+               "admission idle: not shedding")
+        _check(ingest["shed_total"] == 0, "no sheds on a quiet smoke")
+        _check(set(ingest["shed_by_reason"]) == {"inflight", "latency"},
+               f"shed reasons {sorted(ingest['shed_by_reason'])}")
+
         print(f"introspect smoke OK: rung={window['rung_name']} "
               f"shards={window['shards']} "
               f"programs={len(programs)} "
               f"nodes={len(fleet['nodes'])} "
               f"states={fleet['states']} "
-              f"ring_epoch={ring['epoch']}")
+              f"ring_epoch={ring['epoch']} "
+              f"ingest_load={ingest['load']}")
         return 0
     finally:
         ctx.cancel()

@@ -22,7 +22,7 @@ _UNIT_TOKENS = frozenset({
     "epoch",
 })
 _COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows",
-                           "shards", "windows"})
+                           "shards", "windows", "inflight"})
 # reference-parity names grandfathered in (match the upstream exporter)
 _EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
 

@@ -62,7 +62,8 @@ def main(argv: Sequence[str] | None = None) -> int:
 
     from kepler_tpu.server.webconfig import make_api_server
     server = make_api_server([cfg.aggregator.listen_address],
-                             cfg.web.config_file)
+                             cfg.web.config_file,
+                             max_connections=cfg.web.max_connections)
     aggregator = Aggregator(
         server,
         interval=cfg.aggregator.interval,
@@ -93,6 +94,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         self_peer=cfg.aggregator.self_peer,
         ring_epoch=cfg.aggregator.ring_epoch,
         ring_vnodes=cfg.aggregator.ring_vnodes,
+        admission_enabled=cfg.aggregator.admission_enabled,
+        admission_max_inflight=cfg.aggregator.admission_max_inflight,
+        admission_latency_budget=cfg.aggregator.admission_latency_budget,
+        admission_retry_after=cfg.aggregator.admission_retry_after,
+        admission_retry_after_max=(
+            cfg.aggregator.admission_retry_after_max),
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

@@ -104,7 +104,8 @@ def create_services(cfg: Config) -> list:
         state_path=cfg.monitor.state_path,
         state_max_age=cfg.monitor.state_max_age,
     )
-    server = make_api_server(cfg.web.listen_addresses, cfg.web.config_file)
+    server = make_api_server(cfg.web.listen_addresses, cfg.web.config_file,
+                             max_connections=cfg.web.max_connections)
     # self-telemetry: recent cycle traces (monitor refresh stages, scrape
     # renders, agent delivery legs) as JSON or Chrome trace-event format
     server.register("/debug/traces", "Traces",
@@ -168,6 +169,9 @@ def create_services(cfg: Config) -> list:
             flush_timeout_s=cfg.aggregator.flush_timeout,
             spool=spool,
             peers=cfg.aggregator.peers,
+            drain_batch_max=cfg.agent.drain.batch_max,
+            drain_replay_rps=cfg.agent.drain.replay_rps,
+            drain_retry_after_max=cfg.agent.drain.retry_after_max,
         )
         server.health.register_probe("fleet-agent", agent.health)
         if spool is not None:

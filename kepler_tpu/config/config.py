@@ -160,6 +160,11 @@ class DebugConfig:
 class WebConfig:
     config_file: str = ""
     listen_addresses: list[str] = field(default_factory=lambda: [":28282"])
+    # concurrent-connection cap per listener: an accept over the cap is
+    # answered 503 + Connection: close WITHOUT spawning a handler
+    # thread, so a connection storm (herd after a replica kill) can't
+    # grow threads without bound. 0 = unbounded (pre-cap behavior).
+    max_connections: int = 1024
 
 
 @dataclass
@@ -240,6 +245,23 @@ class SpoolConfig:
 
 
 @dataclass
+class DrainConfig:
+    """Spool-drain overload behavior (``fleet.agent`` batched replay +
+    throttle handling, docs/developer/resilience.md "Overload and
+    backpressure")."""
+
+    # spooled records shipped per /v1/reports request during recovery
+    # replay (1 = the pre-batch single-record drain)
+    batch_max: int = 32
+    # token-bucket cap on replay records/second, so a rejoining agent
+    # slews its backlog in instead of dumping it (0 = unpaced)
+    replay_rps: float = 256.0
+    # clamp on any server-sent Retry-After the agent will honor — an
+    # adversarial owner must not be able to park an agent forever
+    retry_after_max: float = 300.0
+
+
+@dataclass
 class AgentConfig:
     """Node-agent delivery plane (the sender half of the fleet leg).
 
@@ -248,6 +270,7 @@ class AgentConfig:
     """
 
     spool: SpoolConfig = field(default_factory=SpoolConfig)
+    drain: DrainConfig = field(default_factory=DrainConfig)
 
 
 @dataclass
@@ -382,6 +405,19 @@ class AggregatorConfig:
     self_peer: str = ""
     ring_epoch: int = 1
     ring_vnodes: int = 64
+    # -- ingest admission control (docs/developer/resilience.md
+    # "Overload and backpressure"): shed with 429 + Retry-After BEFORE
+    # decode work when the inflight or latency budget is blown —
+    # priority-aware (replay backlogs first, live RAPL ground truth
+    # last). Shedding is loss-free: records stay spooled and replay.
+    admission_enabled: bool = True
+    admission_max_inflight: int = 64
+    # EWMA ingest-latency budget the shed ladder is scaled against
+    admission_latency_budget: float = 0.25
+    # base Retry-After answered on a shed (load-multiplied, jittered)
+    # and the clamp it can never exceed
+    admission_retry_after: float = 1.0
+    admission_retry_after_max: float = 30.0
 
 
 @dataclass
@@ -509,6 +545,34 @@ class Config:
             errs.append("aggregator.ringEpoch must be >= 1")
         if agg.ring_vnodes < 1:
             errs.append("aggregator.ringVnodes must be >= 1")
+        # overload control: admission budgets + agent drain pacing
+        if agg.admission_max_inflight < 1:
+            errs.append("aggregator.admissionMaxInflight must be >= 1")
+        for name, val in (
+                ("aggregator.admissionLatencyBudget",
+                 agg.admission_latency_budget),
+                ("aggregator.admissionRetryAfter",
+                 agg.admission_retry_after),
+                ("aggregator.admissionRetryAfterMax",
+                 agg.admission_retry_after_max)):
+            if val < 0:
+                errs.append(f"{name} must be >= 0")
+        if agg.admission_retry_after_max < agg.admission_retry_after:
+            errs.append("aggregator.admissionRetryAfterMax must be >= "
+                        "aggregator.admissionRetryAfter")
+        drain = self.agent.drain
+        if drain.batch_max < 1:
+            errs.append("agent.drain.batchMax must be >= 1")
+        if drain.replay_rps < 0:
+            errs.append("agent.drain.replayRps must be >= 0 "
+                        "(0 disables replay pacing)")
+        if drain.retry_after_max <= 0:
+            errs.append("agent.drain.retryAfterMax must be > 0 (a zero "
+                        "clamp would turn every 429 into an immediate "
+                        "resend)")
+        if self.web.max_connections < 0:
+            errs.append("web.maxConnections must be >= 0 "
+                        "(0 disables the connection cap)")
         if self.aggregator.dispatch_timeout < 0:
             errs.append("aggregator.dispatchTimeout must be >= 0 "
                         "(0 disables the stall watchdog)")
@@ -625,6 +689,15 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "selfPeer": "self_peer",
     "ringEpoch": "ring_epoch",
     "ringVnodes": "ring_vnodes",
+    "admissionEnabled": "admission_enabled",
+    "admissionMaxInflight": "admission_max_inflight",
+    "admissionLatencyBudget": "admission_latency_budget",
+    "admissionRetryAfter": "admission_retry_after",
+    "admissionRetryAfterMax": "admission_retry_after_max",
+    "batchMax": "batch_max",
+    "replayRps": "replay_rps",
+    "retryAfterMax": "retry_after_max",
+    "maxConnections": "max_connections",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
     "segmentBytes": "segment_bytes",
@@ -648,7 +721,9 @@ _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "backoff_initial", "backoff_max", "breaker_cooldown",
                     "flush_timeout", "skew_tolerance", "degraded_ttl",
                     "restart_backoff_initial", "restart_backoff_max",
-                    "state_max_age", "fsync_interval", "dispatch_timeout"}
+                    "state_max_age", "fsync_interval", "dispatch_timeout",
+                    "admission_latency_budget", "admission_retry_after",
+                    "admission_retry_after_max", "retry_after_max"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -794,6 +869,16 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         default=None, type=float,
         help="rolling z-score threshold flagging a node's reported "
              "power as anomalous (0 disables)")
+    add("--aggregator.admission-enabled",
+        dest="aggregator_admission_enabled", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="shed ingest load with 429 + Retry-After before decode "
+             "when the inflight/latency budget is blown (loss-free: "
+             "shed records stay spooled on the agent and replay)")
+    add("--web.max-connections", dest="web_max_connections", default=None,
+        type=int,
+        help="concurrent-connection cap per listener; overflow is "
+             "answered 503 without spawning a thread (0 = unbounded)")
     add("--aggregator.peers", dest="aggregator_peers", default=None,
         action="append",
         help="repeatable: one ingest-ring replica endpoint per flag "
@@ -873,6 +958,10 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
            args.aggregator_dispatch_timeout, _parse_duration)
     set_if(("aggregator", "scoreboard_cap"), args.aggregator_scoreboard_cap)
     set_if(("aggregator", "anomaly_z"), args.aggregator_anomaly_z)
+    set_if(("aggregator", "admission_enabled"),
+           args.aggregator_admission_enabled)
+    if args.web_max_connections is not None:
+        cfg.web.max_connections = args.web_max_connections
     if args.aggregator_peers:
         cfg.aggregator.peers = list(args.aggregator_peers)
     set_if(("aggregator", "self_peer"), args.aggregator_self_peer)

@@ -59,6 +59,10 @@ KNOWN_SITES = (
     # docs/developer/resilience.md "Ingest hand-off")
     "net.partition",          # agent: report delivered, response dropped
     "replica.down",           # aggregator: ingest answers 503 (replica dead)
+    # overload control (admission + shedding,
+    # docs/developer/resilience.md "Overload and backpressure")
+    "net.throttle",           # agent: send answered 429 (arg = Retry-After)
+    "aggregator.ingest_slow",  # aggregator: ingest stalls `arg` seconds
 )
 
 

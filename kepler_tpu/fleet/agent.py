@@ -58,6 +58,7 @@ import collections
 import http.client
 import json
 import logging
+import math
 import random
 import socket
 import ssl
@@ -65,12 +66,13 @@ import threading
 import time as _time
 import urllib.parse
 import uuid
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.ring import coerce_epoch, sanitize_peer
-from kepler_tpu.fleet.spool import Spool
+from kepler_tpu.fleet.spool import Spool, SpoolRecord
 from kepler_tpu.fleet.wire import (WireError, encode_report,
+                                   encode_report_batch,
                                    peek_identity, restamp_transmit)
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
@@ -117,6 +119,94 @@ class OwnerRedirectError(Exception):
         self.epoch = epoch
 
 
+class ThrottledError(Exception):
+    """429 from the aggregator: a THROTTLE, not a failure. The tier is
+    alive and over its admission budget; the record is safe (spooled or
+    still in hand) and will be accepted later — so a 429 must never
+    feed the circuit breaker, trip peer rotation, count as a send
+    failure, or move the ``_disrupted_at`` replay watermark. The drain
+    loop just waits out the (coerced, jittered) Retry-After."""
+
+    def __init__(self, retry_after: float) -> None:
+        super().__init__(f"aggregator shedding load "
+                         f"(retry after {retry_after:g}s)")
+        self.retry_after = retry_after
+
+
+class _BatchUnsupportedError(Exception):
+    """The batch endpoint is not usable against this target (an older
+    replica's 404/405, or a 400 for an envelope it cannot parse):
+    remember that and fall back to single-record sends — never an
+    outage signal, never a reason to drop records."""
+
+
+# backoff used when a 429 carries no usable Retry-After (absent,
+# non-numeric, negative, bool) — an adversarial owner must not be able
+# to park an agent, so hostile values coerce HERE, not at honor time
+DEFAULT_RETRY_AFTER = 1.0
+
+# byte budget for one batched-drain request: well under the server's
+# 64 MiB report-body cap, with headroom for the restamp's header growth
+# and the envelope framing. Without this bound a backlog of large
+# reports could build a body the server 413s FOREVER — the same batch
+# re-peeked every round, the drain wedged.
+MAX_BATCH_BYTES = 32 << 20
+
+
+def coerce_retry_after(raw: object, default: float = DEFAULT_RETRY_AFTER,
+                       cap: float = 300.0) -> float:
+    """Harden a wire Retry-After (header string or batch-response JSON
+    number): non-numeric/negative/bool/non-finite values fall back to
+    ``default``; everything is clamped to ``cap`` so a hostile replica
+    cannot park an agent forever. Mirrors the run/seq and ring-header
+    coercion discipline (PR 3 / PR 11)."""
+    cap = max(0.0, cap)
+    if isinstance(raw, bool):
+        return min(default, cap)
+    if isinstance(raw, (int, float)):
+        val = float(raw)
+    elif isinstance(raw, str):
+        try:
+            val = float(raw.strip())
+        except ValueError:
+            return min(default, cap)
+    else:
+        return min(default, cap)
+    if not math.isfinite(val) or val < 0.0:
+        return min(default, cap)
+    return min(val, cap)
+
+
+class _TokenBucket:
+    """Replay pacer: at most ``rate`` records/s with a burst of
+    ``burst`` — a rejoining agent slews its spool backlog in instead of
+    dumping it on a replica that just absorbed a herd. Monotonic-clock
+    only (injected seam); single-threaded (the drain loop owns it)."""
+
+    __slots__ = ("_rate", "_burst", "_tokens", "_last", "_monotonic")
+
+    def __init__(self, rate: float, burst: int,
+                 monotonic: Callable[[], float]) -> None:
+        self._rate = max(1e-6, float(rate))
+        self._burst = max(1, int(burst))
+        self._tokens = float(self._burst)
+        self._monotonic = monotonic
+        self._last = monotonic()
+
+    def take(self, want: int) -> tuple[int, float]:
+        """→ ``(granted, wait_s)``: up to ``want`` tokens now, or
+        ``(0, seconds until one accrues)``."""
+        now = self._monotonic()
+        self._tokens = min(float(self._burst),
+                           self._tokens + (now - self._last) * self._rate)
+        self._last = now
+        if self._tokens < 1.0:
+            return 0, (1.0 - self._tokens) / self._rate
+        granted = min(max(1, want), int(self._tokens))
+        self._tokens -= granted
+        return granted, 0.0
+
+
 def _parse_redirect(data: bytes, headers) -> tuple[str | None, int | None]:
     """(owner, epoch) from a 421 response — body JSON first, the
     ``X-Kepler-Owner``/``X-Kepler-Epoch`` headers as fallback. Both
@@ -156,8 +246,8 @@ class _PeerTarget:
     ``owner`` wire header — so an endpoint of the documented
     ``https://user:pw@agg:28283`` form never leaks its password."""
 
-    __slots__ = ("url", "display", "host", "port", "path", "tls",
-                 "auth_header", "tls_ctx")
+    __slots__ = ("url", "display", "host", "port", "path", "batch_path",
+                 "tls", "auth_header", "tls_ctx")
 
     def __init__(self, url: str, display: str, host: str, port: int,
                  path: str, tls: bool, auth_header: str, tls_ctx) -> None:
@@ -166,6 +256,7 @@ class _PeerTarget:
         self.host = host
         self.port = port
         self.path = path
+        self.batch_path = path + "s"  # /v1/report → /v1/reports
         self.tls = tls
         self.auth_header = auth_header
         self.tls_ctx = tls_ctx
@@ -222,6 +313,9 @@ class FleetAgent:
         spool: Spool | None = None,
         peers: Sequence[str] | None = None,
         handoff_replay: int = 8,
+        drain_batch_max: int = 1,
+        drain_replay_rps: float = 0.0,
+        drain_retry_after_max: float = 300.0,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
@@ -275,7 +369,31 @@ class FleetAgent:
                        "connects_total": 0,
                        "breaker_opens": 0, "flushed_on_shutdown": 0,
                        "redirects_followed": 0, "failovers": 0,
-                       "handoffs": 0}
+                       "handoffs": 0, "throttled_total": 0,
+                       "drain_batches": 0, "drain_batch_records": 0}
+        # overload control (ISSUE 12): batched spool drain + throttle
+        # handling. drain_batch_max > 1 ships K spooled records per
+        # /v1/reports request during recovery replay; drain_replay_rps
+        # token-bucket-paces that replay (0 = unpaced) so a rejoining
+        # agent slews its backlog in rather than dumping it; 429
+        # Retry-After values are coerced + clamped (a hostile owner
+        # must not park the agent) and honored with decorrelated jitter.
+        self._drain_batch_max = max(1, int(drain_batch_max))
+        # floored: a zero clamp would turn every 429 into an immediate
+        # resend — a tight hammer loop that defeats admission control
+        self._retry_after_max = max(1e-3, float(drain_retry_after_max))
+        self._pacer: _TokenBucket | None = None
+        if drain_replay_rps > 0.0:
+            self._pacer = _TokenBucket(drain_replay_rps,
+                                       self._drain_batch_max,
+                                       self._monotonic)
+        # decorrelated-jitter state for consecutive throttles (reset on
+        # any successful send)
+        self._throttle_prev: float | None = None
+        self._throttle_logged: float | None = None  # monotonic
+        # targets whose batch endpoint answered 404/405/400 (an older
+        # replica): fall back to single-record sends there
+        self._no_batch_targets: set[str] = set()
         # HA ingest tier: the replica set. With one endpoint this is a
         # 1-peer tier and every ring mechanism below is inert; with
         # ``peers`` (the replicas' aggregator.peers list, basic-auth/TLS
@@ -389,10 +507,24 @@ class FleetAgent:
                     self._send_item(item)
                 except UnsendableRecordError as err:
                     self._finish_item(item)
-                    self._stats["dropped_total"] += 1
+                    if item[0] != "batch":
+                        self._stats["dropped_total"] += 1
                     log.info("shutdown flush: unsendable record (%s)", err)
                     continue
+                except ThrottledError as err:
+                    # the flush is a latency nicety; a shedding tier has
+                    # asked us to go away — the spool keeps everything
+                    if item[0] == "batch":
+                        self._inflight = None
+                    log.info("shutdown flush stopped (throttled): %s", err)
+                    break
+                except _BatchUnsupportedError:
+                    self._no_batch_targets.add(self._target.url)
+                    self._inflight = None
+                    continue
                 except OwnerRedirectError as err:
+                    if item[0] == "batch":
+                        self._inflight = None  # re-peek past acked prefix
                     if self._follow_redirect(err):
                         continue  # retry against the named owner
                     log.info("shutdown flush stopped (unusable "
@@ -525,19 +657,56 @@ class FleetAgent:
                 if item is None:
                     return
                 self._inflight = item
+            if item[0] == "batch" and self._pacer is not None:
+                # replay pacing: the token bucket caps how fast the
+                # backlog slews in — a depleted bucket waits for a
+                # token instead of dumping the spool on the aggregator
+                granted, wait = self._pacer.take(len(item[1]))
+                if granted == 0:
+                    self._inflight = None
+                    if ctx is None or ctx.wait(wait):
+                        return
+                    continue
+                if granted < len(item[1]):
+                    item = ("batch", item[1][:granted])
+                    self._inflight = item
             if self._breaker_state == BREAKER_OPEN:
                 self._breaker_state = BREAKER_HALF_OPEN
                 log.info("circuit breaker half-open: probing aggregator")
             try:
                 sent_seq = self._send_item(item)
+            except ThrottledError as err:
+                # a 429 is a throttle, not a failure: no breaker/
+                # failover/disruption bookkeeping — wait out the
+                # (coerced) Retry-After with decorrelated jitter and
+                # retry. Spooled records stay durable meanwhile.
+                self._stats["throttled_total"] += 1
+                if item[0] == "batch":
+                    # the concluded prefix was acked inside the send;
+                    # re-peek the rest from the cursor next round
+                    self._inflight = None
+                self._log_throttle(err)
+                delay = self._throttle_delay(err.retry_after)
+                if ctx is None or ctx.wait(delay):
+                    return
+                continue
+            except _BatchUnsupportedError:
+                # older replica without /v1/reports: remember and fall
+                # back to single-record sends against this target
+                self._no_batch_targets.add(self._target.url)
+                self._inflight = None
+                continue
             except UnsendableRecordError as err:
                 # poisoned record: ack + drop so the backlog moves on,
                 # but leave the breaker exactly as it was — this proves
                 # nothing about the aggregator (a half-open probe simply
-                # passes to the next record)
+                # passes to the next record). Batch items already acked
+                # and counted their poisoned records internally.
                 self._finish_item(item)
-                self._stats["dropped_total"] += 1
-                log.warning("dropping unsendable spooled record: %s", err)
+                if item[0] != "batch":
+                    self._stats["dropped_total"] += 1
+                    log.warning("dropping unsendable spooled record: %s",
+                                err)
                 continue
             except OwnerRedirectError as err:
                 # this replica answered "not mine": follow the redirect
@@ -545,6 +714,10 @@ class FleetAgent:
                 # unusable redirect (loop, hostile owner) degrades to
                 # the ordinary failure path — backoff + failover decide
                 # the next attempt, the spool keeps the record safe.
+                if item[0] == "batch":
+                    # any concluded prefix was acked in the send;
+                    # re-peek the remainder against the new owner
+                    self._inflight = None
                 if self._follow_redirect(err):
                     continue
                 self._on_send_failure(err)
@@ -569,6 +742,11 @@ class FleetAgent:
                 self._note_send_success()
                 continue
             except (OSError, http.client.HTTPException) as err:
+                if item[0] == "batch":
+                    # records are durable in the spool; re-peek from
+                    # the cursor after backoff (dedup absorbs any
+                    # record the replica processed before dying)
+                    self._inflight = None
                 self._on_send_failure(err)
                 # probe a different replica next: during a replica
                 # outage successive attempts cycle the peer list, and
@@ -598,8 +776,26 @@ class FleetAgent:
     def _next_item(self) -> tuple | None:
         """Next undelivered window: the durable spool backlog first (it
         holds the OLDEST windows, including a previous run's replay),
-        then the in-memory ring."""
+        then the in-memory ring. A backlog deeper than one record
+        drains BATCHED (``("batch", [records])``) when batching is
+        enabled and the current target supports it — recovery replay
+        then ships K records per request instead of one."""
         if self._spool is not None:
+            if (self._drain_batch_max > 1
+                    and self._target.url not in self._no_batch_targets
+                    and self._spool.pending_records() > 1):
+                recs = self._spool.peek_batch(self._drain_batch_max)
+                # byte-bound the request body: truncate (never drop) at
+                # the budget — an over-budget HEAD record falls through
+                # to the single path, which always handled big reports
+                total = 0
+                for k, rec in enumerate(recs):
+                    total += len(rec.payload) + 256
+                    if total > MAX_BATCH_BYTES and k > 0:
+                        recs = recs[:k]
+                        break
+                if len(recs) > 1:
+                    return ("batch", recs)
             rec = self._spool.peek()
             if rec is not None:
                 return ("spool", rec)
@@ -610,7 +806,8 @@ class FleetAgent:
 
     def _finish_item(self, item: tuple) -> None:
         """The item's delivery concluded (2xx or permanent 4xx): advance
-        the spool ack cursor so it is never re-sent."""
+        the spool ack cursor so it is never re-sent. Batch items acked
+        per record inside the send — only the in-flight slot clears."""
         self._inflight = None
         if item[0] == "spool":
             assert self._spool is not None
@@ -624,6 +821,30 @@ class FleetAgent:
         self._breaker_state = BREAKER_CLOSED
         self._consecutive_failures = 0
         self._breaker_backoff = self._breaker_cooldown
+        self._throttle_prev = None  # throttle jitter restarts fresh
+
+    def _throttle_delay(self, retry_after: float) -> float:
+        """Decorrelated jitter over the server's Retry-After hint:
+        consecutive throttles spread a herd of waiting agents apart
+        (``sleep = uniform(hint, prev * 3)``, clamped) instead of
+        re-synchronizing them on the hint's exact value."""
+        base = max(1e-3, retry_after)
+        prev = self._throttle_prev if self._throttle_prev else base
+        delay = min(max(self._retry_after_max, base),
+                    self._rng.uniform(base, max(base, prev * 3.0)))
+        self._throttle_prev = delay
+        return delay
+
+    def _log_throttle(self, err: ThrottledError) -> None:
+        # same monotonic rate-limit SHAPE as send failures, but its OWN
+        # timestamp and INFO level — sustained throttling must not
+        # suppress the data-loss WARNING (_log_drop), which is the
+        # operator's only loss signal exactly during overload
+        now = self._monotonic()
+        if self._throttle_logged is None \
+                or now - self._throttle_logged >= 30.0:
+            self._throttle_logged = now
+            log.info("aggregator throttled this agent (429): %s", err)
 
     def _on_send_failure(self, err: Exception) -> None:
         self._stats["send_failures"] += 1
@@ -808,6 +1029,8 @@ class FleetAgent:
         replayed seqs must not inflate this run's delivered watermark,
         or they could mask the new run's own leading-window loss) so
         the caller can advance ``acked_through`` after the ack."""
+        if item[0] == "batch":
+            return self._send_batch(item[1])
         if item[0] == "spool":
             rec = item[1]
             path = self._delivery_path(rec.appended_at, rec.recovered)
@@ -834,8 +1057,10 @@ class FleetAgent:
                                 trace_id=uuid.uuid4().hex[:16],
                                 emitted_at=self._clock()))
 
-    def _post(self, body: bytes, path: str = "fresh",
-              appended_at: float | None = None) -> None:
+    def _fire_presend_faults(self) -> None:
+        """Connection-level fault sites, consulted once per send attempt
+        BEFORE any payload work — exactly where a real refused connect,
+        slow network, or shedding replica would interpose."""
         spec = fault.fire("net.refuse")
         if spec is not None:
             self._close_conn()
@@ -843,6 +1068,56 @@ class FleetAgent:
         spec = fault.fire("net.slow")
         if spec is not None:
             _time.sleep(min(spec.arg or 0.05, self._timeout))
+        spec = fault.fire("net.throttle")
+        if spec is not None:
+            # chaos stand-in for an admission-shedding replica: the send
+            # is answered 429 before any bytes move (arg = Retry-After)
+            raise ThrottledError(coerce_retry_after(
+                spec.arg if spec.arg is not None else DEFAULT_RETRY_AFTER,
+                cap=self._retry_after_max))
+
+    def _transport_post(self, url_path: str,
+                        body: bytes) -> tuple[Any, bytes]:
+        """One POST over the persistent connection (fault sites fired
+        by the caller via :meth:`_fire_presend_faults`; the one-way
+        ``net.partition`` fires after the response). → (response,
+        body bytes)."""
+        headers = {"Content-Type": "application/octet-stream"}
+        if self._auth_header:
+            headers["Authorization"] = self._auth_header
+        conn = self._connection()
+        try:
+            conn.request("POST", url_path, body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+        except Exception:
+            # a dead persistent connection is not reusable — reconnect on
+            # the next attempt
+            self._close_conn()
+            raise
+        if fault.fire("net.partition") is not None:
+            # one-way partition: the replica processed the report but
+            # its response never made it back — the agent must treat
+            # the send as failed and re-deliver later (the dedup window
+            # absorbs the duplicate)
+            self._close_conn()
+            raise OSError("fault-injected one-way partition "
+                          "(response lost)")
+        if resp.status >= 300 or resp.will_close:
+            self._close_conn()
+        return resp, data
+
+    def _learn_epoch(self, headers: Any) -> None:
+        """Lazy epoch learning: accepts advertise the ring epoch too,
+        so a settled agent still notices a membership bump."""
+        epoch = coerce_epoch(
+            _epoch_from_header(headers.get("X-Kepler-Epoch")))
+        if epoch is not None and epoch > self._ring_epoch:
+            self._ring_epoch = epoch
+
+    def _post(self, body: bytes, path: str = "fresh",
+              appended_at: float | None = None) -> None:
+        self._fire_presend_faults()
         sent_at = self._clock()
         spec = fault.fire("report.clock_skew")
         if spec is not None:
@@ -865,43 +1140,147 @@ class FleetAgent:
             # drop the tail: header (and node name) stay parseable, the
             # array manifest overruns → deterministic WireError server-side
             body = body[:-4]
-        headers = {"Content-Type": "application/octet-stream"}
-        if self._auth_header:
-            headers["Authorization"] = self._auth_header
-        conn = self._connection()
-        try:
-            conn.request("POST", self._path, body=body, headers=headers)
-            resp = conn.getresponse()
-            data = resp.read()
-        except Exception:
-            # a dead persistent connection is not reusable — reconnect on
-            # the next attempt
-            self._close_conn()
-            raise
-        if fault.fire("net.partition") is not None:
-            # one-way partition: the replica processed the report but
-            # its response never made it back — the agent must treat
-            # the send as failed and re-deliver later (the dedup window
-            # absorbs the duplicate)
-            self._close_conn()
-            raise OSError("fault-injected one-way partition "
-                          "(response lost)")
-        if resp.status >= 300 or resp.will_close:
-            self._close_conn()
+        resp, data = self._transport_post(self._path, body)
         if resp.status == 421:
             owner, epoch = _parse_redirect(data, resp.headers)
             raise OwnerRedirectError(owner, epoch)
+        if resp.status == 429:
+            # a throttle, never a failure: the Retry-After is hostile
+            # wire input until coerced (clamped so an adversarial owner
+            # can't park this agent forever)
+            raise ThrottledError(coerce_retry_after(
+                resp.headers.get("Retry-After"),
+                cap=self._retry_after_max))
         if 400 <= resp.status < 500:
             raise AggregatorRejectedError(resp.status)
         if resp.status >= 300:
             raise http.client.HTTPException(
                 f"aggregator returned {resp.status}")
-        # lazy epoch learning: accepts advertise the ring epoch too, so
-        # a settled agent still notices a membership bump
-        epoch = coerce_epoch(
-            _epoch_from_header(resp.headers.get("X-Kepler-Epoch")))
-        if epoch is not None and epoch > self._ring_epoch:
-            self._ring_epoch = epoch
+        self._learn_epoch(resp.headers)
+
+    def _send_batch(self, recs: "list[SpoolRecord]") -> int:
+        """Ship consecutive spooled records as ONE ``/v1/reports``
+        request (batched recovery drain) and conclude each according to
+        its per-record status. Records are acked IN ORDER as their
+        statuses conclude; the first throttle/redirect stops the walk —
+        the concluded prefix stays acked, the rest re-peeks from the
+        cursor. Returns the highest acked seq of the CURRENT run (the
+        ``acked_through`` watermark input). Every per-record status is
+        hostile wire input: malformed rows conclude nothing."""
+        assert self._spool is not None
+        self._fire_presend_faults()
+        sent_at = self._clock()
+        spec = fault.fire("report.clock_skew")
+        if spec is not None:
+            sent_at += spec.arg if spec.arg is not None else 300.0
+        bodies: list[bytes] = []
+        batch: list[SpoolRecord] = []
+        for rec in recs:
+            path = self._delivery_path(rec.appended_at, rec.recovered)
+            try:
+                bodies.append(restamp_transmit(
+                    rec.payload, sent_at, delivery_path=path,
+                    appended_at=rec.appended_at,
+                    owner=self._target.display,
+                    epoch=self._ring_epoch,
+                    acked_through=self._acked_through))
+            except WireError as err:
+                if bodies:
+                    # truncate: the poisoned record surfaces as the
+                    # batch head next round and is dropped there
+                    break
+                # poisoned head: ack + drop exactly like the single
+                # path (no network contact — evidence of nothing)
+                self._spool.ack(rec)
+                self._stats["dropped_total"] += 1
+                log.warning("dropping unsendable spooled record: %s", err)
+                continue
+            batch.append(rec)
+        if not bodies:
+            raise UnsendableRecordError(
+                "entire batch head was unsendable (already dropped)")
+        with telemetry.span("agent.send"):
+            resp, data = self._transport_post(
+                self._target.batch_path, encode_report_batch(bodies))
+        status = resp.status
+        if status in (400, 404, 405, 413):
+            # an older replica without /v1/reports, one that cannot
+            # parse the envelope, or a smaller body cap than ours
+            # (413): fall back to single-record sends against this
+            # target — nothing concluded, nothing dropped
+            raise _BatchUnsupportedError(
+                f"batch endpoint answered {status}")
+        if status == 421:
+            owner, epoch = _parse_redirect(data, resp.headers)
+            raise OwnerRedirectError(owner, epoch)
+        if status == 429:
+            raise ThrottledError(coerce_retry_after(
+                resp.headers.get("Retry-After"),
+                cap=self._retry_after_max))
+        if status != 200:
+            raise http.client.HTTPException(
+                f"aggregator returned {status}")
+        self._learn_epoch(resp.headers)
+        try:
+            payload = json.loads(data)
+        except (ValueError, UnicodeDecodeError):
+            payload = None
+        results = (payload.get("results")
+                   if isinstance(payload, dict) else None)
+        if not isinstance(results, list):
+            # hostile/garbled response: nothing provably concluded —
+            # the records stay spooled and the failure path sets pace
+            raise http.client.HTTPException(
+                "unparseable batch response body")
+        self._stats["drain_batches"] += 1
+        top_seq = 0
+        concluded = 0
+        throttle: float | None = None
+        redirect: "tuple | None" = None
+        for rec, row in zip(batch, results):
+            st = row.get("status") if isinstance(row, dict) else None
+            if isinstance(st, bool) or not isinstance(st, int):
+                break  # hostile row: stop concluding records here
+            if 200 <= st < 300:
+                self._spool.ack(rec)
+                concluded += 1
+                run, seq = peek_identity(rec.payload)
+                if run == self._run_nonce:
+                    top_seq = max(top_seq, seq)
+                continue
+            if st == 429:
+                throttle = coerce_retry_after(
+                    row.get("retry_after"), cap=self._retry_after_max)
+                break
+            if st == 421:
+                redirect = (sanitize_peer(row.get("owner")),
+                            coerce_epoch(row.get("epoch")))
+                break
+            if 400 <= st < 500:
+                # per-record permanent reject: ack + drop so the rest
+                # of the backlog never wedges behind it (single-path
+                # semantics, record by record)
+                self._spool.ack(rec)
+                concluded += 1
+                self._stats["dropped_total"] += 1
+                self._stats["server_rejections"] += 1
+                continue
+            break  # per-record 5xx: not concluded; retries later
+        self._stats["drain_batch_records"] += concluded
+        if top_seq:
+            self._acked_through = max(self._acked_through, top_seq)
+        if throttle is not None:
+            raise ThrottledError(throttle)
+        if redirect is not None:
+            raise OwnerRedirectError(*redirect)
+        if concluded == 0:
+            # a 200 that concluded NOTHING (hostile rows, short/empty
+            # results, per-record 5xx) must not read as success — the
+            # drain would re-peek the identical batch and spin. The
+            # failure path's backoff sets the retry pace instead.
+            raise http.client.HTTPException(
+                "batch response concluded no records")
+        return top_seq
 
     def _log_drop(self, err: Exception) -> None:
         # rate-limit to one warning per 30 s of MONOTONIC time (not sample

@@ -45,11 +45,19 @@ from typing import Any, Callable, Iterator, Mapping, Sequence
 import numpy as np
 
 from kepler_tpu import fault, telemetry
+from kepler_tpu.fleet.admission import (
+    PRIORITY_FRESH_GROUND,
+    PRIORITY_FRESH_MODEL,
+    PRIORITY_REPLAY_GROUND,
+    AdmissionController,
+)
 from kepler_tpu.fleet.ring import HashRing, coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.wire import (
     WireError,
     decode_report,
+    decode_report_batch,
     peek_node_name,
+    peek_routing,
     sanitize_node_name,
 )
 from kepler_tpu.fleet.scoreboard import STATE_NAMES, FleetScoreboard
@@ -412,6 +420,12 @@ class Aggregator:
         self_peer: str = "",
         ring_epoch: int = 1,
         ring_vnodes: int = 64,
+        admission_enabled: bool = False,
+        admission_max_inflight: int = 64,
+        admission_latency_budget: float = 0.25,
+        admission_retry_after: float = 1.0,
+        admission_retry_after_max: float = 30.0,
+        admission_jitter_seed: int | None = None,
         clock: Callable[[], float] | None = None,
         mesh: Any = None,
     ) -> None:
@@ -522,6 +536,21 @@ class Aggregator:
                     f"aggregator.peers {list(self._ring.peers)!r}")
         self._last_redirect_at: float | None = None  # keplint: guarded-by=_lock
         self._last_membership_at: float | None = None  # keplint: guarded-by=_lock
+        # overload control (ISSUE 12): an AdmissionController in front of
+        # the ingest path sheds with 429 + Retry-After BEFORE decode work
+        # when the inflight or latency budget is blown — priority-aware,
+        # so replay backlogs wait first and live RAPL ground truth sheds
+        # last. Disabled (None) keeps the pre-admission ingest path
+        # byte-for-byte: shedding off ≡ old behavior.
+        self._admission: AdmissionController | None = None
+        if admission_enabled:
+            self._admission = AdmissionController(
+                max_inflight=admission_max_inflight,
+                latency_budget=admission_latency_budget,
+                retry_after=admission_retry_after,
+                retry_after_max=admission_retry_after_max,
+                degraded_ttl=degraded_ttl,
+                jitter_seed=admission_jitter_seed)
         self._results_lock = threading.Lock()
         self._results: FleetResults | None = None  # keplint: guarded-by=_results_lock
         self._last_window_at: float | None = None
@@ -656,6 +685,13 @@ class Aggregator:
         self._server.register("/v1/report", "Fleet ingest",
                               "POST node window reports", self._handle_report,
                               max_body=MAX_REPORT_BYTES)
+        self._server.register("/v1/reports", "Fleet batch ingest",
+                              "POST a batch of node window reports "
+                              "(length-prefixed envelope; per-record "
+                              "status in the JSON response) — the "
+                              "spool-drain replay path",
+                              self._handle_report_batch,
+                              max_body=MAX_REPORT_BYTES)
         self._server.register("/v1/results", "Fleet results",
                               "attributed watts per node", self._handle_results)
         self._server.register("/debug/window", "Window introspection",
@@ -676,6 +712,11 @@ class Aggregator:
             health.register_probe("fleet-window", self.window_health)
             if self._ring is not None:
                 health.register_probe("fleet-ring", self.ring_health)
+            if self._admission is not None:
+                # degraded while shedding — the "ingest tier is actively
+                # re-pacing its agents" signal; recovers on its own
+                health.register_probe("fleet-ingest",
+                                      self._admission.health)
             # ready once init completed: endpoints registered, mesh built,
             # params validated — an empty fleet is still a ready aggregator
             health.register_readiness("fleet-aggregator",
@@ -732,7 +773,111 @@ class Aggregator:
         # legs as stages — the receive half of the delivery trace the
         # agent opened at window emit
         with telemetry.span("aggregator.ingest"):
-            return self._ingest_report(request)
+            ctrl = self._admission
+            if ctrl is None or request.command != "POST":
+                return self._ingest_report(request)
+            # admission runs BEFORE any decode work: over budget the
+            # request is turned away at header-peek cost, and the spool
+            # on the agent side makes that loss-free — the record stays
+            # durable and replays after the Retry-After hint
+            retry = ctrl.admit(self._priority_of(request.body))
+            if retry is not None:
+                return self._throttle_response(retry)
+            t0 = _time.perf_counter()
+            try:
+                return self._ingest_report(request)
+            finally:
+                ctrl.done(_time.perf_counter() - t0)
+
+    def _handle_report_batch(
+            self, request: Any) -> tuple[int, dict[str, str], bytes]:
+        """``POST /v1/reports``: the batched spool-drain path. Each
+        record runs through the SAME single-report ingest internals
+        (per-record admission, dedup, quarantine, redirect), and the
+        response carries a per-record status list — so one request
+        replays K spooled records while every delivery/loss/dedup
+        invariant stays per-record. Once admission sheds mid-batch, the
+        remaining records are answered 429 without being looked at (the
+        whole point is to stop paying decode cost)."""
+        with telemetry.span("aggregator.ingest"):
+            if request.command != "POST":
+                return 405, {"Content-Type": "text/plain"}, b"POST only\n"
+            if fault.fire("replica.down") is not None:
+                return (503, {"Content-Type": "text/plain"},
+                        b"replica down (fault injection)\n")
+            try:
+                payloads = decode_report_batch(request.body)
+            except WireError as err:
+                with self._lock:
+                    self._stats["rejected_total"] += 1
+                    self._stats["malformed_total"] += 1
+                return (400, {"Content-Type": "text/plain"},
+                        f"{err}\n".encode())
+            ctrl = self._admission
+            results: list[dict[str, Any]] = []
+            shed_retry: float | None = None
+            for body in payloads:
+                if shed_retry is not None:
+                    # stop paying even peek cost once shedding started
+                    results.append({"status": 429,
+                                    "retry_after": shed_retry})
+                    continue
+                if ctrl is not None:
+                    retry = ctrl.admit(self._priority_of(body))
+                    if retry is not None:
+                        shed_retry = retry
+                        results.append({"status": 429,
+                                        "retry_after": retry})
+                        continue
+                t0 = _time.perf_counter()
+                try:
+                    status, _headers, resp_body = \
+                        self._ingest_payload(body)
+                finally:
+                    if ctrl is not None:
+                        ctrl.done(_time.perf_counter() - t0)
+                row: dict[str, Any] = {"status": status}
+                if status == 421:
+                    try:
+                        row.update(json.loads(resp_body))
+                    except ValueError:
+                        pass
+                elif status >= 400:
+                    row["error"] = resp_body.decode(
+                        errors="replace").strip()[:200]
+                results.append(row)
+            headers = {"Content-Type": "application/json",
+                       **self._epoch_headers()}
+            if shed_retry is not None:
+                headers["Retry-After"] = f"{shed_retry:g}"
+            return (200, headers,
+                    json.dumps({"results": results}).encode())
+
+    def _throttle_response(
+            self, retry: float) -> tuple[int, dict[str, str], bytes]:
+        body = json.dumps({"retry_after": retry}).encode()
+        return (429, {"Content-Type": "application/json",
+                      "Retry-After": f"{retry:g}",
+                      **self._epoch_headers()}, body)
+
+    def _priority_of(self, body: bytes) -> int:
+        """Admission priority from a CHEAP header peek (no array decode):
+        replay backlogs behind fresh windows, model-estimated nodes
+        behind RAPL ground truth, scoreboard-flagged reporters behind
+        healthy ones — live attribution accuracy degrades last."""
+        name, path, mode = peek_routing(body)
+        if path == "replay":
+            p = PRIORITY_REPLAY_GROUND
+        else:
+            p = PRIORITY_FRESH_GROUND
+        if mode == MODE_MODEL:
+            p += 1
+        if p == PRIORITY_FRESH_GROUND and name:
+            with self._lock:
+                flagged = self._scoreboard.flagged(name, self._clock())
+            if flagged:
+                p = PRIORITY_FRESH_MODEL
+        return p
 
     def _ingest_report(
             self, request: Any) -> tuple[int, dict[str, str], bytes]:
@@ -744,9 +889,19 @@ class Aggregator:
             # as a permanent rejection
             return (503, {"Content-Type": "text/plain"},
                     b"replica down (fault injection)\n")
+        return self._ingest_payload(request.body)
+
+    def _ingest_payload(
+            self, body: bytes) -> tuple[int, dict[str, str], bytes]:
+        spec = fault.fire("aggregator.ingest_slow")
+        if spec is not None:
+            # chaos stand-in for a sinking ingest path (GC stall, slow
+            # disk, CPU-starved replica): inflates the admission
+            # controller's latency EWMA the honest way — by being slow
+            _time.sleep(float(spec.arg or 0.05))
         try:
             with telemetry.span("aggregator.decode"):
-                report, header = decode_report(request.body)
+                report, header = decode_report(body)
         except (WireError, ValueError) as err:
             # quarantine, charged to the sender when the header survives.
             # The header re-parse runs OFF the store lock — a burst of
@@ -754,7 +909,7 @@ class Aggregator:
             # The peeked name is UNVALIDATED wire input (the body already
             # failed decoding): sanitize before it becomes a degradation
             # key, scoreboard row, metric label, or log field (KTL112)
-            node = sanitize_node_name(peek_node_name(request.body) or "")
+            node = sanitize_node_name(peek_node_name(body) or "")
             with self._lock:
                 self._stats["rejected_total"] += 1
                 self._stats["quarantined_total"] += 1
@@ -2261,6 +2416,31 @@ class Aggregator:
             "another ring replica; the agent follows to the owner)")
         redirected.add_metric([], stats["reports_redirected_total"])
         yield redirected
+        ctrl = self._admission
+        shed = CounterMetricFamily(
+            "kepler_fleet_reports_shed_total",
+            "Reports shed by ingest admission control (429 + "
+            "Retry-After before decode), by budget signal — loss-free: "
+            "shed records stay spooled on the agent and replay later",
+            labels=["reason"])
+        for reason, count in sorted((ctrl.shed_by_reason() if ctrl
+                                     else {}).items()):
+            shed.add_metric([reason], count)
+        yield shed
+        inflight = GaugeMetricFamily(
+            "kepler_fleet_ingest_inflight",
+            "Admitted ingest requests currently being decoded/merged "
+            "(admission sheds at a load-derived multiple of "
+            "aggregator.admissionMaxInflight; 0 with admission off)")
+        inflight.add_metric([], ctrl.inflight() if ctrl else 0)
+        yield inflight
+        ingest_lat = GaugeMetricFamily(
+            "kepler_fleet_ingest_latency_seconds",
+            "EWMA of per-record ingest service time — the admission "
+            "controller's latency-budget signal (decays while shedding "
+            "so recovery probes always resume; 0 with admission off)")
+        ingest_lat.add_metric([], ctrl.latency_ewma() if ctrl else 0.0)
+        yield ingest_lat
         ring = self._ring
         ring_epoch = GaugeMetricFamily(
             "kepler_fleet_ring_epoch",

@@ -272,6 +272,19 @@ class FleetScoreboard:
             return STATE_LOSSY
         return STATE_HEALTHY
 
+    def flagged(self, node: str, now: float) -> bool:
+        """Cheap read (caller holds the aggregator's store lock): does
+        the node currently carry a live quarantine/anomaly/loss flag?
+        The admission controller's priority input — flagged reporters'
+        fresh windows queue behind clean ground truth under overload.
+        Staleness is deliberately NOT a flag here: "hasn't reported
+        lately" describes every node at the front of a recovery burst,
+        not a quality problem. Unknown nodes are unflagged."""
+        e = self._nodes.get(node[:self._name_cap])
+        if e is None:
+            return False
+        return self._state_of(e, now, float("inf")) != STATE_HEALTHY
+
     def states(self, now: float, stale_after: float) -> dict[str, int]:
         """node → state code (the enum gauge's samples)."""
         self._expire_junk(now)

@@ -468,6 +468,62 @@ class Spool:
                 self._cursor_off = 0
                 self._close_read_locked()
 
+    def peek_batch(self, max_records: int) -> "list[SpoolRecord]":
+        """Up to ``max_records`` consecutive unacked records starting at
+        the cursor, WITHOUT advancing it — the batched-drain read
+        (``/v1/reports``): recovery replay ships K records per request
+        instead of one. The first element always equals :meth:`peek`'s
+        record, and acking the returned records in order walks the
+        cursor past exactly this batch.
+
+        Deliberately side-effect-free (unlike :meth:`peek`, it never
+        hops the cursor or recounts the backlog): the scan simply STOPS
+        at the first unreadable/corrupt point and the single-record
+        path deals with it when the cursor arrives there — a read-ahead
+        must never mutate durability state."""
+        if max_records <= 0:
+            return []
+        with self._lock:
+            return self._scan_ahead_locked(self._cursor_seg,
+                                           self._cursor_off, max_records)
+
+    # keplint: requires-lock=_lock
+    def _scan_ahead_locked(self, seg: int, offset: int,
+                           max_records: int) -> "list[SpoolRecord]":
+        out: list[SpoolRecord] = []
+        while len(out) < max_records:
+            end = (self._active_bytes if seg == self._active
+                   else self._segments.get(seg, (0, 0))[1])
+            try:
+                with open(self._seg_path(seg), "rb") as fh:
+                    while len(out) < max_records \
+                            and offset + _FRAME.size <= end:
+                        fh.seek(offset)
+                        header = fh.read(_FRAME.size)
+                        if len(header) < _FRAME.size:
+                            return out
+                        length, crc, ts = _FRAME.unpack(header)
+                        if offset + _FRAME.size + length > end:
+                            return out
+                        payload = fh.read(length)
+                        if len(payload) < length \
+                                or zlib.crc32(payload) != crc:
+                            return out  # corrupt: stop the read-ahead
+                        out.append(SpoolRecord(
+                            payload=payload, appended_at=ts,
+                            segment=seg, offset=offset,
+                            recovered=(seg, offset) < self._open_tail))
+                        offset += _FRAME.size + length
+            except OSError:
+                return out  # unreadable: the drain head will report it
+            if len(out) >= max_records or seg >= self._active:
+                return out
+            nxt = [i for i in [*self._segments, self._active] if i > seg]
+            if not nxt:
+                return out
+            seg, offset = min(nxt), 0
+        return out
+
     # keplint: requires-lock=_lock
     def _read_at_locked(self, seg: int, offset: int) -> SpoolRecord | None:
         if self._read_fh is None or self._read_seg != seg:
@@ -539,10 +595,24 @@ class Spool:
                 return
             if (rec.segment, rec.offset) != (self._cursor_seg,
                                              self._cursor_off):
-                # the cursor moved underneath us (cap eviction, or a
-                # concurrent reader re-peeked after eviction): this
-                # record's slot is gone — never skip a different record
-                return
+                # batched acks (peek_batch) walk records the cursor has
+                # not peeked: crossing a rotation leaves the cursor at a
+                # sealed segment's END while the record is the FIRST
+                # frame of the next segment — the hop peek() would have
+                # performed. Accept exactly that case; anything else
+                # means the cursor moved underneath us (cap eviction, a
+                # concurrent re-peek) and acking would skip a different
+                # record.
+                end = (self._active_bytes
+                       if self._cursor_seg == self._active
+                       else self._segments.get(self._cursor_seg,
+                                               (0, 0))[1])
+                nxt = [i for i in [*self._segments, self._active]
+                       if i > self._cursor_seg]
+                if not (self._cursor_off >= end and nxt
+                        and rec.segment == min(nxt)
+                        and rec.offset == 0):
+                    return
             self._peeked = None
             self._cursor_seg = rec.segment
             self._cursor_off = (rec.offset + _FRAME.size

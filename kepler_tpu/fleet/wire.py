@@ -27,6 +27,15 @@ MAGIC = b"KTPUFL1\n"
 _HEADER_LEN = struct.Struct("<I")
 MAX_HEADER_BYTES = 16 << 20
 MAX_ARRAY_BYTES = 256 << 20
+# batch envelope (ISSUE 12 batched spool drain): a length-prefixed
+# multi-report request so recovery replay ships K spooled records per
+# POST instead of one. Each inner record is a full encode_report payload
+# — no per-record format fork, and the aggregator runs each through the
+# SAME single-report ingest (per-record dedup, quarantine, admission).
+BATCH_MAGIC = b"KTPUFB1\n"
+_BATCH_COUNT = struct.Struct("<I")
+_RECORD_LEN = struct.Struct("<I")
+MAX_BATCH_RECORDS = 1024
 # node names become Prometheus label values, scoreboard/tracker keys, and
 # log fields; the cap matches the scoreboard's name_cap so one contract
 # bounds every store keyed on the name
@@ -102,6 +111,88 @@ def encode_report(report: NodeReport, zone_names: list[str],
 
 class WireError(ValueError):
     pass
+
+
+def encode_report_batch(payloads: "list[bytes]") -> bytes:
+    """Wrap encoded report payloads in the batch envelope for
+    ``POST /v1/reports`` (batched spool drain). Bounded: callers must
+    keep batches within :data:`MAX_BATCH_RECORDS`."""
+    if not payloads:
+        raise WireError("empty report batch")
+    if len(payloads) > MAX_BATCH_RECORDS:
+        raise WireError(
+            f"batch of {len(payloads)} exceeds {MAX_BATCH_RECORDS}")
+    parts = [BATCH_MAGIC, _BATCH_COUNT.pack(len(payloads))]
+    for p in payloads:
+        parts.append(_RECORD_LEN.pack(len(p)))
+        parts.append(p)
+    return b"".join(parts)
+
+
+def decode_report_batch(data: bytes) -> "list[bytes]":
+    """Split a batch envelope into its per-record payloads (each still
+    an opaque ``encode_report`` blob the caller decodes individually).
+    The payload arrives over the network: every length is bounds-checked
+    before a slice, the record count is capped, and trailing garbage is
+    rejected — a malformed envelope is a :class:`WireError`, never an
+    allocation or an index error."""
+    if len(data) < len(BATCH_MAGIC) + _BATCH_COUNT.size:
+        raise WireError("short batch payload")
+    if data[: len(BATCH_MAGIC)] != BATCH_MAGIC:
+        raise WireError("bad batch magic")
+    off = len(BATCH_MAGIC)
+    (count,) = _BATCH_COUNT.unpack_from(data, off)
+    off += _BATCH_COUNT.size
+    if count < 1 or count > MAX_BATCH_RECORDS:
+        raise WireError(f"batch count {count} out of range "
+                        f"[1, {MAX_BATCH_RECORDS}]")
+    out: list[bytes] = []
+    for i in range(count):
+        if off + _RECORD_LEN.size > len(data):
+            raise WireError(f"batch record {i} truncated")
+        (rlen,) = _RECORD_LEN.unpack_from(data, off)
+        off += _RECORD_LEN.size
+        if rlen > MAX_HEADER_BYTES + MAX_ARRAY_BYTES \
+                or off + rlen > len(data):
+            raise WireError(f"batch record {i} overruns payload")
+        out.append(data[off: off + rlen])
+        off += rlen
+    if off != len(data):
+        raise WireError("trailing bytes after batch records")
+    return out
+
+
+# keplint: sanitizes — the node name is laundered through
+# sanitize_node_name before it leaves; path/mode collapse to a bounded
+# enum, so nothing here can mint hostile store keys or labels
+def peek_routing(data: bytes) -> tuple[str, str, int]:
+    """Best-effort ``(node_name, delivery_path, mode)`` from a payload —
+    the admission controller's pre-decode priority inputs. The name is
+    sanitized, the path clamped to ``fresh``/``replay``, the mode to a
+    plain int. Never raises; garbage reads as the HIGHEST priority
+    class (``("", "fresh", 0)``) so a mangled header is judged by the
+    real decode, not shed on a guess."""
+    try:
+        if data[: len(MAGIC)] != MAGIC:
+            return "", "fresh", 0
+        off = len(MAGIC)
+        (hlen,) = _HEADER_LEN.unpack_from(data, off)
+        off += _HEADER_LEN.size
+        if hlen > MAX_HEADER_BYTES or off + hlen > len(data):
+            return "", "fresh", 0
+        header = json.loads(data[off: off + hlen])
+        if not isinstance(header, dict):
+            return "", "fresh", 0
+        name = header.get("node_name")
+        name = sanitize_node_name(name) if isinstance(name, str) else ""
+        path = ("replay" if header.get("delivery_path") == "replay"
+                else "fresh")
+        mode = header.get("mode")
+        if isinstance(mode, bool) or not isinstance(mode, int):
+            mode = 0
+        return name, path, mode
+    except Exception:
+        return "", "fresh", 0
 
 
 def restamp_transmit(data: bytes, sent_at: float,

@@ -48,6 +48,69 @@ class Endpoint:
     max_body: int = 1 << 20
 
 
+_OVERFLOW_BODY = b"connection cap reached\n"
+_OVERFLOW_RESPONSE = (b"HTTP/1.1 503 Service Unavailable\r\n"
+                      b"Content-Type: text/plain\r\n"
+                      b"Content-Length: "
+                      + str(len(_OVERFLOW_BODY)).encode() + b"\r\n"
+                      b"Retry-After: 1\r\n"
+                      b"Connection: close\r\n"
+                      b"\r\n"
+                      + _OVERFLOW_BODY)
+
+
+class _CappedThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a bound on concurrent connections.
+
+    The stock mixin spawns one handler thread per accepted connection,
+    unboundedly — a connection storm (thundering herd after a replica
+    kill, a misbehaving client) grows threads and their stacks without
+    limit. With ``max_connections`` set, an accept over the cap is
+    answered ``503 + Connection: close`` IMMEDIATELY on the accepting
+    thread and closed — no handler thread is ever spawned for it — and
+    the client retries against a replica with headroom (or the same one,
+    later). 0 keeps the unbounded stock behavior."""
+
+    daemon_threads = True
+
+    def __init__(self, server_address, handler_cls,
+                 max_connections: int = 0) -> None:
+        super().__init__(server_address, handler_cls)
+        self._conn_sema = (threading.BoundedSemaphore(max_connections)
+                           if max_connections > 0 else None)
+        self.max_connections = max_connections
+        self._conn_lock = threading.Lock()
+        self.active_connections = 0  # keplint: guarded-by=_conn_lock
+        self.rejected_connections_total = 0  # keplint: guarded-by=_conn_lock
+
+    def process_request(self, request, client_address):
+        if self._conn_sema is not None \
+                and not self._conn_sema.acquire(blocking=False):
+            with self._conn_lock:
+                self.rejected_connections_total += 1
+            try:
+                # best-effort: over TLS the handshake may not have run,
+                # so the bytes can be unreadable to the client — the
+                # close alone still sheds the connection without a thread
+                request.sendall(_OVERFLOW_RESPONSE)
+            except OSError:
+                pass
+            self.shutdown_request(request)
+            return
+        with self._conn_lock:
+            self.active_connections += 1
+        super().process_request(request, client_address)
+
+    def process_request_thread(self, request, client_address):
+        try:
+            super().process_request_thread(request, client_address)
+        finally:
+            with self._conn_lock:
+                self.active_connections -= 1
+            if self._conn_sema is not None:
+                self._conn_sema.release()
+
+
 class APIServer:
     def __init__(
         self,
@@ -55,13 +118,18 @@ class APIServer:
         tls_cert: str = "",
         tls_key: str = "",
         basic_auth_check: Callable[[str | None], bool] | None = None,
+        max_connections: int = 0,
     ) -> None:
         self._addresses = listen_addresses or [":28282"]
         self._tls_cert = tls_cert
         self._tls_key = tls_key
         self._auth_check = basic_auth_check
+        # concurrent-connection cap shared across all listen addresses'
+        # servers is deliberately NOT pooled: each listener gets the
+        # full cap (operators size per listener; 0 = unbounded)
+        self._max_connections = max(0, int(max_connections))
         self._endpoints: dict[str, Endpoint] = {}
-        self._servers: list[ThreadingHTTPServer] = []
+        self._servers: list[_CappedThreadingHTTPServer] = []
         self._threads: list[threading.Thread] = []
         # flipped by shutdown(): established keep-alive connections get
         # one 503 + close instead of being served forever by their
@@ -180,8 +248,9 @@ class APIServer:
                       self.health.handle_readyz)
         for addr in self._addresses:
             host, _, port = addr.rpartition(":")
-            server = ThreadingHTTPServer(
-                (host or "0.0.0.0", int(port)), RequestHandler)
+            server = _CappedThreadingHTTPServer(
+                (host or "0.0.0.0", int(port)), RequestHandler,
+                max_connections=self._max_connections)
             if self._tls_cert and self._tls_key:
                 ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
                 ctx.load_cert_chain(self._tls_cert, self._tls_key)
@@ -233,6 +302,19 @@ class APIServer:
             "<h1>kepler-tpu</h1><ul>" + rows + "</ul></body></html>"
         ).encode()
         return 200, {"Content-Type": "text/html; charset=utf-8"}, body
+
+    def connection_stats(self) -> dict:
+        """Connection-cap accounting across listeners (operator/test
+        introspection; ``rejected_total`` counts accepts answered 503
+        at the cap without ever spawning a handler thread)."""
+        active = rejected = 0
+        for s in self._servers:
+            with s._conn_lock:
+                active += s.active_connections
+                rejected += s.rejected_connections_total
+        return {"max_connections": self._max_connections,
+                "active_connections": active,
+                "rejected_total": rejected}
 
     @property
     def addresses(self) -> list[tuple[str, int]]:

@@ -179,10 +179,12 @@ def make_authenticator(users: Mapping[str, str]
     return check
 
 
-def make_api_server(listen_addresses: list[str], config_file: str = ""):
+def make_api_server(listen_addresses: list[str], config_file: str = "",
+                    max_connections: int = 0):
     """API server honouring a web config file (TLS + basic auth) —
     reference ``server.go:136-156`` via exporter-toolkit. Shared by the
-    node-agent and aggregator entry points."""
+    node-agent and aggregator entry points. ``max_connections`` caps
+    concurrent handler threads (``web.maxConnections``; 0 = unbounded)."""
     from kepler_tpu.server.http import APIServer
 
     web = load_web_config(config_file) if config_file else None
@@ -192,4 +194,5 @@ def make_api_server(listen_addresses: list[str], config_file: str = ""):
         tls_key=web.key_file if web else "",
         basic_auth_check=(make_authenticator(web.basic_auth_users)
                           if web else None),
+        max_connections=max_connections,
     )

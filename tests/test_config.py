@@ -416,6 +416,28 @@ FIELD_MATRIX = [
     FieldCase("aggregator.ring_vnodes",
               "aggregator: {ringVnodes: 32}", 32,
               ["--aggregator.ring-vnodes", "16"], 16),
+    # overload control (ISSUE 12): admission budgets are YAML-tuned
+    # resilience knobs; only the enable switch gets a flag
+    FieldCase("aggregator.admission_enabled",
+              "aggregator: {admissionEnabled: false}", False,
+              ["--aggregator.admission-enabled"], True),
+    FieldCase("aggregator.admission_max_inflight",
+              "aggregator: {admissionMaxInflight: 16}", 16),
+    FieldCase("aggregator.admission_latency_budget",
+              "aggregator: {admissionLatencyBudget: 100ms}", 0.1),
+    FieldCase("aggregator.admission_retry_after",
+              "aggregator: {admissionRetryAfter: 2s}", 2.0),
+    FieldCase("aggregator.admission_retry_after_max",
+              "aggregator: {admissionRetryAfterMax: 1m}", 60.0),
+    FieldCase("agent.drain.batch_max",
+              "agent: {drain: {batchMax: 8}}", 8),
+    FieldCase("agent.drain.replay_rps",
+              "agent: {drain: {replayRps: 64}}", 64.0),
+    FieldCase("agent.drain.retry_after_max",
+              "agent: {drain: {retryAfterMax: 2m}}", 120.0),
+    FieldCase("web.max_connections",
+              "web: {maxConnections: 64}", 64,
+              ["--web.max-connections", "32"], 32),
     FieldCase("monitor.state_path",
               "monitor: {statePath: /var/lib/kepler/state.json}",
               "/var/lib/kepler/state.json",
@@ -542,6 +564,15 @@ class TestYAMLSpellings:
         "selfPeer": "aggregator",
         "ringEpoch": "aggregator",
         "ringVnodes": "aggregator",
+        "admissionEnabled": "aggregator",
+        "admissionMaxInflight": "aggregator",
+        "admissionLatencyBudget": "aggregator",
+        "admissionRetryAfter": "aggregator",
+        "admissionRetryAfterMax": "aggregator",
+        "batchMax": ("agent", "drain"),
+        "replayRps": ("agent", "drain"),
+        "retryAfterMax": ("agent", "drain"),
+        "maxConnections": "web",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
         "segmentBytes": ("agent", "spool"),
@@ -599,6 +630,15 @@ class TestYAMLSpellings:
         "selfPeer": ("'a:1'", "a:1"),
         "ringEpoch": ("3", 3),
         "ringVnodes": ("16", 16),
+        "admissionEnabled": ("false", False),
+        "admissionMaxInflight": ("16", 16),
+        "admissionLatencyBudget": ("100ms", 0.1),
+        "admissionRetryAfter": ("2s", 2.0),
+        "admissionRetryAfterMax": ("1m", 60.0),
+        "batchMax": ("8", 8),
+        "replayRps": ("64", 64.0),
+        "retryAfterMax": ("2m", 120.0),
+        "maxConnections": ("64", 64),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
         "segmentBytes": ("65536", 65536),
@@ -730,6 +770,30 @@ class TestValidationMatrix:
         ("aggregator.ringVnodes",
          lambda c: setattr(c.aggregator, "ring_vnodes", 0),
          "ringVnodes"),
+        ("aggregator.admissionMaxInflight",
+         lambda c: setattr(c.aggregator, "admission_max_inflight", 0),
+         "admissionMaxInflight"),
+        ("aggregator.admissionLatencyBudget",
+         lambda c: setattr(c.aggregator, "admission_latency_budget", -1),
+         "admissionLatencyBudget"),
+        ("aggregator.admissionRetryAfter",
+         lambda c: setattr(c.aggregator, "admission_retry_after", -1),
+         "admissionRetryAfter"),
+        ("aggregator.admissionRetryAfterMax.inverted",
+         lambda c: (setattr(c.aggregator, "admission_retry_after", 10.0),
+                    setattr(c.aggregator, "admission_retry_after_max",
+                            1.0)),
+         "admissionRetryAfterMax must be >="),
+        ("agent.drain.batchMax",
+         lambda c: setattr(c.agent.drain, "batch_max", 0), "batchMax"),
+        ("agent.drain.replayRps",
+         lambda c: setattr(c.agent.drain, "replay_rps", -1), "replayRps"),
+        ("agent.drain.retryAfterMax",
+         lambda c: setattr(c.agent.drain, "retry_after_max", -1),
+         "retryAfterMax"),
+        ("web.maxConnections",
+         lambda c: setattr(c.web, "max_connections", -1),
+         "maxConnections"),
         ("fault.specs",
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),

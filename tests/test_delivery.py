@@ -212,6 +212,175 @@ class TestRingHeaderCoercion:
         assert agg._stats["windows_lost_total"] == 4
 
 
+class TestThrottleHeaderCoercion:
+    """Satellite (ISSUE 12): throttle-control values from the wire —
+    the 429 ``Retry-After`` header and the batch response's per-record
+    status fields — are hardened exactly like run/seq and the ring
+    headers: non-numeric/negative/bool → default backoff, huge values
+    clamped to a max. An adversarial owner must not be able to park an
+    agent forever or trick it into acking unconcluded records."""
+
+    @pytest.mark.parametrize("hostile", [
+        None, "", "soon", "12h", "1e", True, False, "-5", -5, -0.01,
+        float("nan"), float("inf"), "nan", "-inf", [], {}, b"2",
+    ])
+    def test_hostile_retry_after_coerces_to_default(self, hostile):
+        from kepler_tpu.fleet.agent import coerce_retry_after
+        assert coerce_retry_after(hostile, default=2.0, cap=300.0) == 2.0
+
+    @pytest.mark.parametrize("huge", [10_000, "10000", 1e12, "9e9"])
+    def test_huge_retry_after_clamped(self, huge):
+        from kepler_tpu.fleet.agent import coerce_retry_after
+        assert coerce_retry_after(huge, default=2.0, cap=300.0) == 300.0
+
+    @pytest.mark.parametrize("good,expected", [
+        ("0", 0.0), ("1", 1.0), ("2.5", 2.5), (" 3 ", 3.0),
+        (7, 7.0), (0.25, 0.25), ("299.9", 299.9),
+    ])
+    def test_numeric_retry_after_honored(self, good, expected):
+        from kepler_tpu.fleet.agent import coerce_retry_after
+        assert coerce_retry_after(good, default=2.0, cap=300.0) \
+            == expected
+
+    def test_hostile_429_header_never_parks_the_drain(self, tmp_path):
+        """End to end: a 429 whose Retry-After is a hostile huge string
+        waits the agent-side clamp, not the adversarial value — and
+        leaves the breaker/rotation/disruption state untouched."""
+        from kepler_tpu.fleet.agent import BREAKER_CLOSED
+
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        calls = {"n": 0}
+
+        def hostile(request):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return (429, {"Retry-After": "99999999"}, b"shed\n")
+            return 204, {}, b""
+
+        s.register("/v1/report", "evil", "hostile throttler", hostile,
+                   max_body=64 << 20)
+        ctx = CancelContext()
+        t = threading.Thread(target=s.run, args=(ctx,), daemon=True)
+        t.start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="clamp-node", jitter_seed=0,
+                               spool=Spool(str(tmp_path / "sp")),
+                               drain_retry_after_max=0.05)
+            agent.init()
+            agent._on_window(make_sample())
+            drain_ctx = CancelContext()
+            t0 = time.monotonic()
+            agent._drain(drain_ctx)  # clamped wait, then delivery
+            assert time.monotonic() - t0 < 2.0
+            h = agent.health()
+            assert h["queued"] == 0 and h["sent_total"] == 1
+            assert h["throttled_total"] == 1
+            assert h["breaker"] == BREAKER_CLOSED
+            assert h["send_failures"] == 0
+            assert agent._disrupted_at is None
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    @pytest.mark.parametrize("rows", [
+        "not-a-list",
+        [{"status": True}],
+        [{"status": "204"}],
+        [{"status": 2.04}],
+        [{"no_status": 1}],
+        ["bare-string"],
+    ])
+    def test_hostile_batch_statuses_conclude_nothing(self, rows,
+                                                     tmp_path):
+        """Per-record status fields are wire input: any malformed row
+        stops the conclusion walk — no ack, no drop, the record stays
+        spooled for the failure path to retry."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        body = json.dumps({"results": rows}).encode()
+        s.register("/v1/reports", "evil", "hostile batch",
+                   lambda r: (200, {"Content-Type": "application/json"},
+                              body),
+                   max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            spool = Spool(str(tmp_path / "sp"))
+            for i in range(1, 4):
+                spool.append(encode_report(
+                    make_report("hb-node"), ["package", "dram"],
+                    seq=i, run="r1"))
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="hb-node", jitter_seed=0,
+                               spool=spool, drain_batch_max=4)
+            agent.init()
+            agent._drain(None)  # one attempt: fails, concludes nothing
+            assert spool.stats()["acked_total"] == 0
+            assert agent.backlog() == 3
+            assert agent._stats["dropped_total"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_hostile_batch_retry_after_field_clamped(self, tmp_path):
+        """The per-record 429 row's retry_after is coerced exactly like
+        the header (huge → clamp; the concluded prefix stays acked)."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        body = json.dumps({"results": [
+            {"status": 204},
+            {"status": 429, "retry_after": "99999999"},
+        ]}).encode()
+        s.register("/v1/reports", "evil", "throttling batch",
+                   lambda r: (200, {"Content-Type": "application/json"},
+                              body),
+                   max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            spool = Spool(str(tmp_path / "sp"))
+            for i in range(1, 4):
+                spool.append(encode_report(
+                    make_report("tb-node"), ["package", "dram"],
+                    seq=i, run="r1"))
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="tb-node", jitter_seed=0,
+                               spool=spool, drain_batch_max=4,
+                               drain_retry_after_max=0.05)
+            agent.init()
+            drain_ctx = CancelContext()
+            t0 = time.monotonic()
+
+            def cancel_soon():
+                time.sleep(1.0)
+                drain_ctx.cancel()
+
+            threading.Thread(target=cancel_soon, daemon=True).start()
+            agent._drain(drain_ctx)
+            # record 1 concluded; the throttle wait was the CLAMP, so
+            # several retries fit into the second before cancellation
+            assert spool.stats()["acked_total"] >= 1
+            assert agent._stats["throttled_total"] >= 2
+            assert time.monotonic() - t0 < 5.0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+
 class TestDedupWindow:
     def test_duplicate_run_seq_absorbed(self, server):
         agg = make_agg(server)

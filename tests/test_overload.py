@@ -1,0 +1,998 @@
+"""Overload-resilient ingest (ISSUE 12): admission control + adaptive
+shedding on the aggregator, throttle-is-not-a-failure + batched paced
+spool drain on the agent, the HTTP server's connection cap, and the
+chaos-marked thundering-herd scenario — kill 1 of 3 replicas mid-soak
+with admission on, assert sheds fire, ``windows_lost`` stays 0, and the
+fleet fully drains within a bounded number of intervals."""
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from kepler_tpu import fault
+from kepler_tpu.fault import FaultPlan, FaultSpec
+from kepler_tpu.fleet import Aggregator, FleetAgent, Spool, encode_report
+from kepler_tpu.fleet.admission import (
+    PRIORITY_FRESH_GROUND,
+    PRIORITY_FRESH_MODEL,
+    PRIORITY_REPLAY_GROUND,
+    PRIORITY_REPLAY_MODEL,
+    AdmissionController,
+)
+from kepler_tpu.fleet.agent import (
+    BREAKER_CLOSED,
+    ThrottledError,
+    _TokenBucket,
+    coerce_retry_after,
+)
+from kepler_tpu.fleet.wire import (
+    WireError,
+    decode_report_batch,
+    encode_report_batch,
+    peek_routing,
+    restamp_transmit,
+)
+from kepler_tpu.parallel.fleet import MODE_MODEL
+from kepler_tpu.server.http import APIServer
+from kepler_tpu.service.lifecycle import CancelContext
+
+from tests.test_fleet import (
+    FakeMeterMonitor,
+    make_report,
+    make_sample,
+    post_report,
+)
+from tests.test_ring_handoff import (
+    drive_interval,
+    kill_replica,
+    make_tier,
+    names_owned_by,
+    shutdown_tier,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    fault.uninstall()
+    yield
+    fault.uninstall()
+
+
+class _FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def step(self, dt):
+        self.t += dt
+
+
+def make_ctrl(**kw):
+    kw.setdefault("max_inflight", 4)
+    kw.setdefault("latency_budget", 0.1)
+    kw.setdefault("retry_after", 1.0)
+    kw.setdefault("retry_after_max", 30.0)
+    kw.setdefault("jitter_seed", 0)
+    clock = kw.pop("clock", _FakeClock())
+    return AdmissionController(monotonic=clock, **kw), clock
+
+
+class TestAdmissionController:
+    def test_under_budget_admits_everything(self):
+        ctrl, _ = make_ctrl()
+        for p in range(4):
+            assert ctrl.admit(p) is None
+            ctrl.done(0.01)
+        assert ctrl.shed_by_reason() == {"inflight": 0, "latency": 0}
+
+    def test_inflight_cap_sheds_lowest_priority_first(self):
+        ctrl, _ = make_ctrl(max_inflight=4)
+        for _ in range(4):
+            assert ctrl.admit(PRIORITY_FRESH_GROUND) is None
+        # load 1.0: replay+model sheds, everything else still admitted
+        assert ctrl.admit(PRIORITY_REPLAY_MODEL) is not None
+        assert ctrl.admit(PRIORITY_REPLAY_GROUND) is None  # load 1.0 < 1.25
+        assert ctrl.shed_by_reason()["inflight"] == 1
+
+    def test_latency_ladder_priorities(self):
+        # EWMA pinned via one huge observation: alpha 0.2 × 0.65 s over
+        # a 0.1 s budget → load 1.3: replay classes shed, fresh admitted
+        ctrl, _ = make_ctrl(latency_budget=0.1)
+        ctrl.admit(0)
+        ctrl.done(0.65)
+        assert 1.25 < ctrl.load() < 1.5
+        assert ctrl.admit(PRIORITY_REPLAY_MODEL) is not None
+        retry = ctrl.admit(PRIORITY_REPLAY_GROUND)
+        assert retry is not None
+        assert ctrl.admit(PRIORITY_FRESH_MODEL) is None
+        ctrl.done(0.0)
+        assert ctrl.admit(PRIORITY_FRESH_GROUND) is None
+        ctrl.done(0.0)
+        assert ctrl.shed_by_reason()["latency"] == 2
+
+    def test_ground_truth_sheds_last(self):
+        ctrl, _ = make_ctrl(latency_budget=0.1)
+        ctrl.admit(0)
+        ctrl.done(0.9)  # EWMA 0.18 → load 1.8: only priority 0 admitted
+        assert ctrl.admit(PRIORITY_FRESH_MODEL) is not None
+        assert ctrl.admit(PRIORITY_FRESH_GROUND) is None
+        ctrl.done(0.0)
+
+    def test_retry_after_load_derived_jittered_clamped(self):
+        ctrl, _ = make_ctrl(retry_after=1.0, retry_after_max=5.0,
+                            latency_budget=0.1)
+        ctrl.admit(0)
+        ctrl.done(5.0)  # EWMA 1.0 → load 10: base × 10 clamps to max
+        for _ in range(20):
+            retry = ctrl.admit(PRIORITY_FRESH_GROUND)
+            assert retry is not None
+            # jitter ±50% around the clamped base, never over the cap
+            assert 0.05 <= retry <= 5.0
+
+    def test_ewma_decays_while_shedding(self):
+        clock = _FakeClock()
+        ctrl, _ = make_ctrl(latency_budget=0.1, clock=clock)
+        ctrl.admit(0)
+        ctrl.done(1.0)  # EWMA 0.2 → load 2.0: full shed
+        assert ctrl.admit(PRIORITY_FRESH_GROUND) is not None
+        # idle decay: the halved EWMA re-admits without any observation
+        clock.step(30.0)
+        assert ctrl.load() < 1.0
+        assert ctrl.admit(PRIORITY_REPLAY_MODEL) is None
+        ctrl.done(0.0)
+
+    def test_health_probe_degrades_while_shedding(self):
+        clock = _FakeClock()
+        ctrl, _ = make_ctrl(latency_budget=0.1, degraded_ttl=10.0,
+                            clock=clock)
+        assert ctrl.health()["ok"]
+        ctrl.admit(0)
+        ctrl.done(1.0)
+        assert ctrl.admit(PRIORITY_FRESH_GROUND) is not None
+        h = ctrl.health()
+        assert not h["ok"] and h["shedding"]
+        assert h["shed_total"] == 1
+        assert h["latency_budget_s"] == 0.1
+        clock.step(60.0)  # past the ttl: recovered on its own
+        assert ctrl.health()["ok"]
+
+    def test_hostile_priority_clamped(self):
+        ctrl, _ = make_ctrl()
+        for bogus in (-5, 99, True, None, "2"):
+            assert ctrl.admit(bogus) is None
+            ctrl.done(0.0)
+
+
+class TestBatchWire:
+    def test_roundtrip(self):
+        payloads = [encode_report(make_report(f"n{i}"),
+                                  ["package", "dram"], seq=i + 1,
+                                  run="r") for i in range(5)]
+        assert decode_report_batch(encode_report_batch(payloads)) \
+            == payloads
+
+    def test_rejects_malformed(self):
+        good = encode_report_batch([b"abc", b"defg"])
+        for bad in (b"", b"XXXXXXXX" + good[8:], good[:-2],
+                    good + b"trailing"):
+            with pytest.raises(WireError):
+                decode_report_batch(bad)
+
+    def test_count_bounds(self):
+        import struct
+        with pytest.raises(WireError):
+            encode_report_batch([])
+        with pytest.raises(WireError):
+            encode_report_batch([b"x"] * 1025)
+        # a forged huge count must bounds-fail, not allocate
+        forged = b"KTPUFB1\n" + struct.pack("<I", 2 ** 31) + b"\x00" * 64
+        with pytest.raises(WireError):
+            decode_report_batch(forged)
+
+    def test_peek_routing(self):
+        blob = encode_report(make_report("route-node", mode=MODE_MODEL),
+                             ["package", "dram"], seq=3, run="r")
+        assert peek_routing(blob) == ("route-node", "fresh", MODE_MODEL)
+        stamped = restamp_transmit(blob, 5.0, delivery_path="replay")
+        assert peek_routing(stamped)[1] == "replay"
+        assert peek_routing(b"garbage") == ("", "fresh", 0)
+
+
+class TestRetryAfterCoercion:
+    """Hostile throttle values coerce to the default and clamp to the
+    cap — an adversarial owner must not be able to park an agent."""
+
+    @pytest.mark.parametrize("hostile", [
+        None, "", "soon", "1e", [], {}, True, False, "-3", -3, -0.1,
+        float("nan"), float("inf"), "nan", "inf",
+    ])
+    def test_hostile_values_fall_back_to_default(self, hostile):
+        assert coerce_retry_after(hostile, default=1.5, cap=300.0) == 1.5
+
+    def test_huge_values_clamp(self):
+        assert coerce_retry_after(10_000, cap=300.0) == 300.0
+        assert coerce_retry_after("99999999", cap=60.0) == 60.0
+
+    def test_good_values_pass(self):
+        assert coerce_retry_after("2.5", cap=300.0) == 2.5
+        assert coerce_retry_after(0, cap=300.0) == 0.0
+        assert coerce_retry_after(7, cap=300.0) == 7.0
+
+
+class TestTokenBucket:
+    def test_pacing_is_deterministic(self):
+        clock = _FakeClock(0.0)
+        bucket = _TokenBucket(10.0, 8, clock)  # 10 rps, burst 8
+        granted, wait = bucket.take(8)
+        assert (granted, wait) == (8, 0.0)
+        granted, wait = bucket.take(8)
+        assert granted == 0 and wait == pytest.approx(0.1)
+        clock.step(0.45)  # 4.5 tokens accrue
+        granted, _ = bucket.take(8)
+        assert granted == 4
+        clock.step(100.0)  # accrual caps at the burst
+        granted, _ = bucket.take(100)
+        assert granted == 8
+
+
+def _throttling_server(retry_after="0.05", times=1, status=429):
+    """An APIServer whose /v1/report answers `status` `times` times,
+    then 204. Returns (server, ctx, calls)."""
+    s = APIServer(listen_addresses=["127.0.0.1:0"])
+    s.init()
+    calls = {"n": 0}
+
+    def handler(request):
+        calls["n"] += 1
+        if calls["n"] <= times:
+            headers = {"Content-Type": "text/plain"}
+            if retry_after is not None:
+                headers["Retry-After"] = retry_after
+            return status, headers, b"shed\n"
+        return 204, {}, b""
+
+    s.register("/v1/report", "t", "throttling ingest", handler,
+               max_body=64 << 20)
+    ctx = CancelContext()
+    threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+    time.sleep(0.05)
+    return s, ctx, calls
+
+
+class TestThrottleIsNotAFailure:
+    """Acceptance pin: a 429 never increments breaker, peer-rotation,
+    or ``_disrupted_at`` state."""
+
+    def test_429_leaves_breaker_rotation_disruption_untouched(
+            self, tmp_path):
+        s, ctx, calls = _throttling_server(times=1)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="thr-node", jitter_seed=0,
+                               spool=Spool(str(tmp_path / "sp")))
+            agent.init()
+            agent._on_window(make_sample())
+            target_before = agent._target
+            agent._drain(None)  # throttled: returns, record stays spooled
+            h = agent.health()
+            assert h["throttled_total"] == 1
+            assert h["breaker"] == BREAKER_CLOSED
+            assert h["consecutive_failures"] == 0
+            assert h["send_failures"] == 0
+            assert h["failovers"] == 0
+            assert agent._target is target_before  # no peer rotation
+            assert agent._disrupted_at is None  # not a disruption
+            assert agent.backlog() == 1  # safe in the spool
+            agent._drain(None)  # server recovered → delivers
+            assert agent.health()["queued"] == 0
+            assert agent.health()["sent_total"] == 1
+            # delivered AFTER a throttle (not a disruption): still fresh
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_drain_honors_retry_after_with_jitter(self, tmp_path):
+        """With a live CancelContext the drain waits out the coerced
+        Retry-After (decorrelated jitter ≥ the hint) and then retries
+        WITHOUT counting a failure."""
+        s, ctx, calls = _throttling_server(retry_after="0.05", times=2)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="pace-node", jitter_seed=0,
+                               spool=Spool(str(tmp_path / "sp")))
+            agent.init()
+            agent._on_window(make_sample())
+            drain_ctx = CancelContext()
+            t0 = time.monotonic()
+            agent._drain(drain_ctx)
+            elapsed = time.monotonic() - t0
+            h = agent.health()
+            assert h["queued"] == 0 and h["sent_total"] == 1
+            assert h["throttled_total"] == 2
+            assert h["send_failures"] == 0
+            assert elapsed >= 0.1  # two waits ≥ the 0.05 s hint each
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_hostile_retry_after_does_not_park_agent(self, tmp_path):
+        """A huge Retry-After clamps to drain_retry_after_max — the
+        drain waits the clamp, not the adversarial value."""
+        s, ctx, _ = _throttling_server(retry_after="99999999", times=1)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="park-node", jitter_seed=0,
+                               spool=Spool(str(tmp_path / "sp")),
+                               drain_retry_after_max=0.05)
+            agent.init()
+            agent._on_window(make_sample())
+            drain_ctx = CancelContext()
+            t0 = time.monotonic()
+            agent._drain(drain_ctx)
+            assert time.monotonic() - t0 < 2.0  # clamped, not parked
+            assert agent.health()["queued"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_net_throttle_fault_site(self, tmp_path):
+        """The chaos stand-in behaves exactly like a server 429."""
+        s, ctx, _ = _throttling_server(times=0)  # server always accepts
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="fault-node", jitter_seed=0,
+                               spool=Spool(str(tmp_path / "sp")))
+            agent.init()
+            with fault.installed(FaultPlan([
+                    FaultSpec("net.throttle", count=1, arg=0.01)])) as plan:
+                agent._on_window(make_sample())
+                agent._drain(None)
+                assert plan.fired("net.throttle") == 1
+            h = agent.health()
+            assert h["throttled_total"] == 1
+            assert h["breaker"] == BREAKER_CLOSED
+            assert agent.backlog() == 1
+            agent._drain(None)
+            assert agent.health()["queued"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+
+class TestIngestShedding:
+    """Aggregator-side: 429 before decode, not charged to the node,
+    recovery on its own."""
+
+    def make_admitting_agg(self, **kw):
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        kw.setdefault("model_mode", None)
+        kw.setdefault("node_bucket", 8)
+        kw.setdefault("workload_bucket", 16)
+        kw.setdefault("admission_enabled", True)
+        kw.setdefault("admission_jitter_seed", 0)
+        agg = Aggregator(s, **kw)
+        agg.init()
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        return s, agg, ctx
+
+    def test_shed_is_429_with_retry_after_uncharged(self):
+        s, agg, ctx = self.make_admitting_agg(
+            admission_latency_budget=0.01)
+        try:
+            import urllib.error
+            import urllib.request
+            agg._admission.done(1.0)  # pin the EWMA over budget
+            host, port = s.addresses[0]
+            blob = encode_report(make_report("shed-node"),
+                                 ["package", "dram"], seq=1, run="r")
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1/report", data=blob,
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5)
+            assert err.value.code == 429
+            retry = float(err.value.headers["Retry-After"])
+            assert retry > 0
+            assert json.loads(err.value.read())["retry_after"] == retry
+            # shed ≠ quarantine: nothing charged, stored, or tracked
+            assert agg._stats["reports_total"] == 0
+            assert agg._stats["rejected_total"] == 0
+            assert "shed-node" not in agg.degraded_nodes()
+            assert "shed-node" not in agg._reports
+            assert sum(agg._admission.shed_by_reason().values()) == 1
+            fams = {f.name: f for f in agg.collect()}
+            shed = {s.labels["reason"]: s.value
+                    for s in fams["kepler_fleet_reports_shed"].samples}
+            assert shed["latency"] == 1
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_ingest_slow_fault_drives_shedding_then_recovers(self):
+        s, agg, ctx = self.make_admitting_agg(
+            admission_latency_budget=0.02, degraded_ttl=0.2)
+        try:
+            with fault.installed(FaultPlan([
+                    FaultSpec("aggregator.ingest_slow", count=1,
+                              arg=0.5)])):
+                post_report(s, make_report("slow-node"), seq=1, run="r")
+            # the slow ingest pushed the EWMA over budget → next sheds
+            assert agg._admission.load() >= 2.0
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post_report(s, make_report("slow-node"), seq=2, run="r")
+            assert err.value.code == 429
+            assert not agg._admission.health()["ok"]
+            # EWMA decays on its own (no operator action) → re-admits
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and agg._admission.load() >= 1.0:
+                time.sleep(0.25)
+            post_report(s, make_report("slow-node"), seq=3, run="r")
+            assert agg._reports["slow-node"].seq == 3
+            time.sleep(0.25)  # past degradedTtl of shed silence
+            assert agg._admission.health()["ok"]
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_admission_disabled_is_old_behavior(self):
+        """admissionEnabled: false ≡ PR 11: no controller, no probe, no
+        429 path, shed families export zeros."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        agg = Aggregator(s, model_mode=None, node_bucket=8,
+                         workload_bucket=16)
+        agg.init()
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            assert agg._admission is None
+            for i in range(1, 9):
+                post_report(s, make_report("plain"), seq=i, run="r")
+            assert agg._stats["reports_total"] == 8
+            ok, components = s.health.check_health()
+            assert "fleet-ingest" not in components
+            fams = {f.name: f for f in agg.collect()}
+            assert all(x.value == 0 for x in
+                       fams["kepler_fleet_reports_shed"].samples)
+            assert fams["kepler_fleet_ingest_inflight"].samples[0].value \
+                == 0
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+
+class TestBatchedDrain:
+    def seed_spool(self, tmp_path, name, n, run="rb"):
+        spool = Spool(str(tmp_path / name))
+        for i in range(1, n + 1):
+            spool.append(encode_report(make_report(name),
+                                       ["package", "dram"], seq=i,
+                                       run=run))
+        spool.close()
+        return Spool(str(tmp_path / name))
+
+    def make_live_agg(self, **kw):
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        kw.setdefault("model_mode", None)
+        kw.setdefault("node_bucket", 8)
+        kw.setdefault("workload_bucket", 16)
+        kw.setdefault("stale_after", 1e9)
+        agg = Aggregator(s, **kw)
+        agg.init()
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        return s, agg, ctx
+
+    def test_recovery_replay_ships_batches(self, tmp_path):
+        s, agg, ctx = self.make_live_agg()
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="bd-node", jitter_seed=0,
+                               spool=self.seed_spool(tmp_path, "bd-node",
+                                                     20),
+                               drain_batch_max=8)
+            # the crash backlog belongs to THIS agent run (a restart
+            # would mint a fresh nonce and the watermark would — by
+            # design — not advance; see the old-run pin in
+            # test_ring_handoff)
+            agent._run_nonce = "rb"
+            agent.init()
+            agent._drain(None)
+            h = agent.health()
+            assert h["queued"] == 0
+            assert h["drain_batch_records"] == 20
+            # ≥ 8 records per request while the backlog is deep
+            assert h["drain_batches"] <= 3
+            assert agg._stats["reports_total"] == 20
+            assert agg._stats["windows_lost_total"] == 0
+            assert agg._stats["duplicates_total"] == 0
+            # the watermark advanced to the run's top acked seq
+            assert agent._acked_through == 20
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_batch_records_dedup_per_record(self, tmp_path):
+        """Rewinding and re-draining the same records as a batch is
+        absorbed record-by-record (204 per duplicate, counted)."""
+        s, agg, ctx = self.make_live_agg()
+        try:
+            host, port = s.addresses[0]
+            spool = self.seed_spool(tmp_path, "dup-node", 6)
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="dup-node", jitter_seed=0,
+                               spool=spool, drain_batch_max=8)
+            agent.init()
+            agent._drain(None)
+            assert agg._stats["reports_total"] == 6
+            spool.rewind(4)
+            agent._drain(None)
+            assert agent.health()["queued"] == 0
+            assert agg._stats["duplicates_total"] == 4
+            assert agg._stats["windows_lost_total"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_batch_unsupported_target_falls_back_to_single(self,
+                                                           tmp_path):
+        """An old replica without /v1/reports (404) downgrades this
+        target to single-record sends — nothing dropped, nothing
+        counted as an outage."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        accepted = {"n": 0}
+
+        def single_only(request):
+            accepted["n"] += 1
+            return 204, {}, b""
+
+        # only the single endpoint exists (no /v1/reports registration);
+        # the server's 404 for the batch path is the real signal
+        s.register("/v1/report", "old", "single-record ingest",
+                   single_only, max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="old-node", jitter_seed=0,
+                               spool=self.seed_spool(tmp_path, "old-node",
+                                                     5),
+                               drain_batch_max=8)
+            agent.init()
+            agent._drain(None)
+            h = agent.health()
+            assert h["queued"] == 0
+            assert accepted["n"] == 5  # delivered singly
+            assert h["drain_batches"] == 0
+            assert h["send_failures"] == 0
+            assert h["breaker"] == BREAKER_CLOSED
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_hostile_batch_response_concludes_nothing(self, tmp_path):
+        """Garbled/malicious per-record statuses must not ack records:
+        non-JSON bodies, non-list results, bool statuses, and empty
+        lists each count as a FAILED attempt (backoff path) that
+        concludes nothing — never a silent ack, never a spin."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        hostile = [b"not json", b'{"results": "yes"}',
+                   b'{"results": [{"status": true}]}',
+                   b'{"results": []}']
+        calls = {"n": 0}
+
+        def batch_handler(request):
+            calls["n"] += 1
+            if calls["n"] <= len(hostile):
+                body = hostile[calls["n"] - 1]
+            else:  # recovered: conclude all four records
+                body = json.dumps(
+                    {"results": [{"status": 204}] * 4}).encode()
+            return 200, {"Content-Type": "application/json"}, body
+
+        s.register("/v1/reports", "evil", "hostile batch", batch_handler,
+                   max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="hx-node", jitter_seed=0,
+                               backoff_initial=0.001, backoff_max=0.002,
+                               breaker_threshold=100,
+                               spool=self.seed_spool(tmp_path, "hx-node",
+                                                     4),
+                               drain_batch_max=4)
+            agent.init()
+            for _ in range(len(hostile)):  # one failed attempt each
+                agent._drain(None)
+            assert agent._spool.stats()["acked_total"] == 0
+            assert agent.backlog() == 4  # nothing concluded, nothing lost
+            assert agent.health()["send_failures"] == len(hostile)
+            agent._drain(None)  # server recovered → all four conclude
+            assert agent.health()["queued"] == 0
+            assert agent._spool.stats()["acked_total"] == 4
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_batch_byte_budget_splits_large_backlogs(self, tmp_path,
+                                                     monkeypatch):
+        """A backlog of fat records never builds a request body the
+        server would 413 forever: batches truncate at MAX_BATCH_BYTES
+        and everything still drains."""
+        from kepler_tpu.fleet import agent as agent_mod
+
+        s, agg, ctx = self.make_live_agg()
+        try:
+            host, port = s.addresses[0]
+            spool = Spool(str(tmp_path / "fat-node"))
+            blobs = [encode_report(
+                make_report("fat-node", meta_pad="x" * 4096),
+                ["package", "dram"], seq=i, run="rf")
+                for i in range(1, 11)]
+            for b in blobs:
+                spool.append(b)
+            # budget ≈ 2 records per batch (payload lengths differ by a
+            # byte across seq widths — size off the largest, plus slack)
+            monkeypatch.setattr(agent_mod, "MAX_BATCH_BYTES",
+                                2 * (max(len(b) for b in blobs) + 256)
+                                + 16)
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="fat-node", jitter_seed=0,
+                               spool=spool, drain_batch_max=8)
+            agent.init()
+            agent._drain(None)
+            h = agent.health()
+            assert h["queued"] == 0
+            assert h["drain_batches"] == 5  # 10 records / 2 per batch
+            assert agg._stats["reports_total"] == 10
+            assert agg._stats["windows_lost_total"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+    def test_413_downgrades_to_single_sends(self, tmp_path):
+        """A target whose body cap is smaller than ours answers 413 for
+        the batch: fall back to singles instead of wedging on the same
+        over-cap batch forever."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"])
+        s.init()
+        accepted = {"n": 0}
+
+        def single_ok(request):
+            accepted["n"] += 1
+            return 204, {}, b""
+
+        # tiny batch-body cap: every batch POST gets the server's 413;
+        # the single endpoint accepts normally
+        s.register("/v1/reports", "tiny", "cap-limited batch ingest",
+                   lambda r: (200, {}, b"{}"), max_body=64)
+        s.register("/v1/report", "ok", "single", single_ok,
+                   max_body=64 << 20)
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="cap-node", jitter_seed=0,
+                               spool=self.seed_spool(tmp_path,
+                                                     "cap-node", 4),
+                               drain_batch_max=4)
+            agent.init()
+            agent._drain(None)
+            h = agent.health()
+            assert h["queued"] == 0
+            assert accepted["n"] == 4  # delivered singly after the 413
+            assert h["drain_batches"] == 0
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+
+    def test_replay_pacing_caps_rate(self, tmp_path):
+        """With drain_replay_rps set, a deep backlog drains at the
+        bucket's pace instead of as fast as the socket allows."""
+        s, agg, ctx = self.make_live_agg()
+        try:
+            host, port = s.addresses[0]
+            agent = FleetAgent(FakeMeterMonitor(),
+                               endpoint=f"http://{host}:{port}",
+                               node_name="pace2-node", jitter_seed=0,
+                               spool=self.seed_spool(tmp_path,
+                                                     "pace2-node", 24),
+                               drain_batch_max=8,
+                               drain_replay_rps=100.0)
+            agent.init()
+            drain_ctx = CancelContext()
+            t0 = time.monotonic()
+            agent._drain(drain_ctx)
+            elapsed = time.monotonic() - t0
+            assert agent.health()["queued"] == 0
+            # burst of 8 goes immediately; the remaining 16 records at
+            # 100 rps cost ≥ 0.16 s of bucket waits
+            assert elapsed >= 0.15
+            assert agg._stats["reports_total"] == 24
+            agent.shutdown()
+        finally:
+            ctx.cancel()
+            s.shutdown()
+            agg.shutdown()
+
+
+class TestConnectionCap:
+    def _occupy(self, addr, path="/slow"):
+        conn = http.client.HTTPConnection(*addr, timeout=10)
+        t = threading.Thread(
+            target=lambda: (conn.request("GET", path),
+                            conn.getresponse().read()),
+            daemon=True)
+        t.start()
+        return conn, t
+
+    def test_overflow_answered_503_without_thread(self):
+        s = APIServer(listen_addresses=["127.0.0.1:0"],
+                      max_connections=2)
+        s.init()
+        gate = threading.Event()
+        s.register("/slow", "slow", "holds the connection",
+                   lambda req: (gate.wait(5.0), (200, {}, b"ok\n"))[1])
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            addr = s.addresses[0]
+            before = threading.active_count()
+            held = [self._occupy(addr) for _ in range(2)]
+            time.sleep(0.2)  # both slots occupied inside the handler
+            # overflow: raw socket so the immediate 503 + close is
+            # observable byte-for-byte
+            raw = socket.create_connection(addr, timeout=5)
+            data = raw.recv(4096)
+            assert data.startswith(b"HTTP/1.1 503")
+            assert b"Connection: close" in data
+            assert raw.recv(4096) == b""  # server closed it
+            raw.close()
+            stats = s.connection_stats()
+            assert stats["rejected_total"] == 1
+            assert stats["active_connections"] == 2
+            # no handler thread was spawned for the overflow accept:
+            # the 2 held connections cost 2 client + 2 handler threads,
+            # the rejected one costs zero
+            assert threading.active_count() <= before + 4
+            gate.set()
+            for conn, t in held:
+                t.join(timeout=5)
+                conn.close()
+        finally:
+            gate.set()
+            ctx.cancel()
+            s.shutdown()
+
+    def test_cap_holds_under_connection_storm(self):
+        s = APIServer(listen_addresses=["127.0.0.1:0"],
+                      max_connections=4)
+        s.init()
+        gate = threading.Event()
+        s.register("/slow", "slow", "holds the connection",
+                   lambda req: (gate.wait(5.0), (200, {}, b"ok\n"))[1])
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        try:
+            addr = s.addresses[0]
+            held = [self._occupy(addr) for _ in range(4)]
+            time.sleep(0.3)
+            rejected = 0
+            for _ in range(20):  # the storm
+                raw = socket.create_connection(addr, timeout=5)
+                data = raw.recv(4096)
+                if data.startswith(b"HTTP/1.1 503"):
+                    rejected += 1
+                raw.close()
+            assert rejected == 20
+            stats = s.connection_stats()
+            assert stats["rejected_total"] == 20
+            assert stats["active_connections"] <= 4
+            gate.set()
+            for conn, t in held:
+                t.join(timeout=5)
+                conn.close()
+        finally:
+            gate.set()
+            ctx.cancel()
+            s.shutdown()
+
+    def test_shutdown_drain_still_works_at_the_cap(self):
+        """PR 11's drain semantics hold with every slot occupied: a
+        keep-alive connection's next request gets 503 + close."""
+        s = APIServer(listen_addresses=["127.0.0.1:0"],
+                      max_connections=2)
+        s.init()
+        s.register("/ping", "ping", "fast", lambda r: (200, {}, b"pong\n"))
+        ctx = CancelContext()
+        threading.Thread(target=s.run, args=(ctx,), daemon=True).start()
+        time.sleep(0.05)
+        addr = s.addresses[0]
+        conns = []
+        for _ in range(2):  # fill the cap with idle keep-alive conns
+            conn = http.client.HTTPConnection(*addr, timeout=5)
+            conn.request("GET", "/ping")
+            assert conn.getresponse().read() == b"pong\n"
+            conns.append(conn)
+        ctx.cancel()
+        s.shutdown()  # returns: the cap never wedges the drain
+        for conn in conns:
+            conn.request("GET", "/ping")
+            resp = conn.getresponse()
+            assert resp.status == 503  # draining, severed
+            resp.read()
+            conn.close()
+
+
+@pytest.mark.chaos
+class TestHerdChaos:
+    """The headline scenario: kill 1 of 3 replicas mid-soak with
+    admission on → the displaced herd is shed-and-re-paced (shed
+    counter fires), windows_lost stays 0 (shed records stay spooled
+    and deliver after recovery), batched drain carries the replay, and
+    the fleet fully drains within a bounded number of intervals."""
+
+    ADMISSION = dict(
+        admission_enabled=True,
+        admission_max_inflight=32,
+        admission_latency_budget=0.05,
+        admission_retry_after=0.02,
+        admission_retry_after_max=0.1,
+        admission_jitter_seed=0,
+    )
+
+    def test_kill_one_of_three_with_admission_on(self, tmp_path):
+        servers, aggs, peers, ctxs = make_tier(
+            3, stale_after=1e9, degraded_ttl=0.4, **self.ADMISSION)
+        victim = 1
+        agents = []
+        try:
+            ring = aggs[0]._ring
+            owned = names_owned_by(ring, peers, per_peer=2)
+            displaced = list(owned[peers[victim]])
+            agents = [
+                FleetAgent(FakeMeterMonitor(),
+                           endpoint=f"http://{peers[0]}",
+                           node_name=name,
+                           peers=[f"http://{p}" for p in peers],
+                           spool=Spool(str(tmp_path / name)),
+                           backoff_initial=0.001, backoff_max=0.002,
+                           jitter_seed=0, timeout_s=5.0,
+                           drain_batch_max=8,
+                           drain_retry_after_max=0.2)
+                for name in sum(owned.values(), [])]
+            for a in agents:
+                a.init()
+            live = [0, 1, 2]
+
+            # pre-kill soak: healthy tier, nothing shed
+            ts = 100.0
+            for _ in range(4):
+                ts += 5.0
+                drive_interval(agents, aggs, live, ts)
+            assert all(sum(aggs[i]._admission.shed_by_reason().values())
+                       == 0 for i in live)
+
+            # kill one replica; survivors adopt epoch 2 AND get slow
+            # (the herd lands on a tier that cannot absorb it at full
+            # speed — exactly the scenario admission control exists for)
+            kill_replica(servers, aggs, ctxs, victim)
+            live = [0, 2]
+            for i in live:
+                aggs[i].apply_membership([peers[0], peers[2]], 2)
+            with fault.installed(FaultPlan([
+                    FaultSpec("aggregator.ingest_slow", count=4,
+                              arg=0.3)])):
+                for _ in range(2):
+                    ts += 5.0
+                    drive_interval(agents, aggs, live, ts)
+            shed_total = sum(
+                sum(aggs[i]._admission.shed_by_reason().values())
+                for i in live)
+            assert shed_total > 0, "the herd was never shed"
+            # shedding is visible on /healthz while it is happening or
+            # just happened (degradedTtl window)
+            assert any(not aggs[i]._admission.health()["ok"]
+                       for i in live)
+
+            # recovery: the fault is exhausted and the EWMA decays —
+            # every shed record drains from the spool within 3 intervals
+            drained_at = None
+            for k in range(3):
+                time.sleep(0.8)  # EWMA decay + Retry-After expiry
+                ts += 5.0
+                drive_interval(agents, aggs, live, ts)
+                if all(a.backlog() == 0 for a in agents):
+                    drained_at = k
+                    break
+            assert drained_at is not None, [a.backlog() for a in agents]
+
+            # ZERO loss: every shed/displaced window was replay, never
+            # a seq gap
+            for i in live:
+                assert aggs[i]._stats["windows_lost_total"] == 0, \
+                    aggs[i]._lost_by_node
+            # a 429 never opened a breaker or rotated a peer spuriously
+            for a in agents:
+                h = a.health()
+                assert h["breaker"] == BREAKER_CLOSED
+                assert h["queued"] == 0
+            # the displaced herd's replay ran BATCHED
+            assert any(a.health()["drain_batches"] >= 1
+                       for a in agents
+                       if a._node_name in displaced)
+            # survivor ingest stayed within budget once shedding kicked
+            # in: the EWMA the controller steers by is back under it
+            for i in live:
+                assert (aggs[i]._admission.latency_ewma()
+                        < self.ADMISSION["admission_latency_budget"])
+            # every displaced node is healthy on its new owner
+            new_ring = aggs[0]._ring
+            for name in displaced:
+                agg = aggs[peers.index(new_ring.owner(name))]
+                snap = agg._scoreboard.snapshot(agg._clock(), 15.0)
+                assert name in snap["nodes"]
+                assert snap["nodes"][name]["state"] == "healthy"
+            # and the ingest probes recover on their own
+            time.sleep(0.6)
+            for i in live:
+                assert aggs[i]._admission.health()["ok"]
+        finally:
+            for a in agents:
+                a.shutdown()
+            shutdown_tier(servers, aggs, ctxs, dead=(victim,))
