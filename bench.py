@@ -76,13 +76,15 @@ DETAIL_PATH = os.environ.get("KEPLER_BENCH_DETAIL_PATH",
 # gate booleans surfaced in the headline (when their leg ran)
 GATE_KEYS = ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
              "aggwin_within_budget", "aggwin_pipeline_ok",
-             "aggwin_sharded_ok", "node_scrape_ok")
+             "aggwin_sharded_ok", "node_scrape_ok", "ingest_ok",
+             "ingest_zero_copy_ok")
 # an errored leg (subprocess died, no row, timeout) fails these gates
 LEG_ERROR_GATES = {
     "node_scrape_error": ("node_scrape_ok",),
     "aggwin_error": ("aggwin_within_budget", "aggwin_pipeline_ok",
                      "aggwin_sharded_ok"),
     "soak_error": ("soak_ok",),
+    "ingest_error": ("ingest_ok", "ingest_zero_copy_ok"),
 }
 
 
@@ -131,6 +133,15 @@ def evaluate_gates(result: dict, on_tpu: bool) -> tuple[bool, list]:
             f"{result.get('aggwin_pipeline_ratio')}x the serial "
             f"window {result.get('aggwin_serial_p50_ms')} ms "
             f"(budget {result.get('aggwin_pipeline_ratio_budget')}x)")
+        failed = True
+    if (result.get("ingest_ok") is False
+            and "ingest_ok" not in forced):
+        messages.append(
+            f"GATE: wire-v2 ingest decode ratio "
+            f"{result.get('ingest_decode_ratio')}x under budget "
+            f"{result.get('ingest_decode_ratio_budget')}x, or the "
+            f"zero-copy pin failed "
+            f"({result.get('ingest_zero_copy_ok')})")
         failed = True
     if (result.get("aggwin_sharded_ok") is False
             and "aggwin_sharded_ok" not in forced):
@@ -458,6 +469,13 @@ def main() -> None:
     aggwin_fields = {(k if k.startswith("aggwin_") else f"aggwin_{k}"): v
                      for k, v in row.items() if k != "scenario"}
 
+    # ---- wire-v2 ingest fast path (decode ratio + zero-copy pin +
+    # live-HTTP reports/s; v2 delta steady state vs v1 full frames) ----
+    ingest_fields = host_leg(
+        "benchmarks.scenarios", ["--only", "ingest", "--iters", "10"],
+        600, "ingest_error")
+    ingest_fields.pop("scenario", None)
+
     # ---- aggregator ingest soak (live service, 1000 agents, 60 s) ------
     soak_fields = host_leg(
         "benchmarks.soak",
@@ -503,6 +521,7 @@ def main() -> None:
                    for k, v in acc_fields.items()})
     result.update(node_fields)
     result.update(aggwin_fields)
+    result.update(ingest_fields)
     result.update(soak_fields)
     # gates with teeth: accuracy everywhere; the pipelined-vs-floor
     # ratio on real TPU (on a CPU host the "floor" is µs-scale noise,

@@ -277,6 +277,12 @@ DESCRIPTIONS = {
                                             "`Retry-After` — the "
                                             "longest an agent is ever "
                                             "asked to stay away.",
+    "aggregator.base_row_cache": "Wire-v2 delta-base LRU size: per-"
+                                 "node last-keyframe state the delta "
+                                 "frames merge against. Eviction "
+                                 "costs the node one structured 409 "
+                                 "needs-keyframe round-trip (it "
+                                 "resends full), never data.",
     "agent.spool.dir": "Crash-safe report spool directory: windows are "
                        "appended (CRC-framed) before any send and only "
                        "acked on 2xx, so crashes/outages replay instead "
@@ -309,6 +315,22 @@ DESCRIPTIONS = {
                                    "`Retry-After` the agent honors — "
                                    "an adversarial owner must not be "
                                    "able to park an agent forever.",
+    "agent.wire.version": "Report wire format: `2` (default) = binary "
+                          "delta-encoded v2 frames (struct-packed "
+                          "header, changed workload rows only in "
+                          "steady state); `1` pins the legacy "
+                          "JSON-headered frames (rollout escape "
+                          "hatch).",
+    "agent.wire.keyframe_every": "Send a full keyframe every N windows "
+                                 "even when a delta would do — bounds "
+                                 "the state a fresh owner must request "
+                                 "(409 needs-keyframe) after a "
+                                 "hand-off.",
+    "agent.wire.degraded_ttl": "How long a replica that answered "
+                               "415/400 to v2 bytes is remembered as "
+                               "v1-only before the agent re-probes v2 "
+                               "(the wire-version analog of the batch "
+                               "404/405 downgrade).",
     "service.restart_max": "Supervised restarts per crashing service "
                            "before the group fails (`0` = reference "
                            "semantics: first crash ends the group).",
@@ -397,6 +419,8 @@ FLAG_OF = {
         "--aggregator.admission-enabled / "
         "--no-aggregator.admission-enabled",
     "agent.spool.dir": "--agent.spool-dir",
+    "agent.wire.version": "--agent.wire-version",
+    "aggregator.base_row_cache": "--aggregator.base-row-cache",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
     "telemetry.enabled": "--telemetry.enable / --no-telemetry.enable",
@@ -416,6 +440,7 @@ _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
                    "aggregator.admission_retry_after",
                    "aggregator.admission_retry_after_max",
                    "agent.drain.retry_after_max",
+                   "agent.wire.degraded_ttl",
                    "service.restart_backoff_initial",
                    "service.restart_backoff_max"}
 

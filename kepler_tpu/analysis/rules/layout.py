@@ -19,11 +19,12 @@ from typing import Iterable, Iterator
 
 from kepler_tpu.analysis.engine import Diagnostic, FileContext, Rule, register
 
-# the modules that read/write packed rows; everything else never sees
-# the layout and stays out of scope
+# the modules that read/write packed rows or wire-frame offsets;
+# everything else never sees a layout and stays out of scope
 _LAYOUT_SCOPE = (
     "kepler_tpu/parallel/packed.py",
     "kepler_tpu/fleet/window.py",
+    "kepler_tpu/fleet/wire.py",
 )
 
 
@@ -76,9 +77,10 @@ def _index_exprs(sl: ast.expr) -> Iterator[ast.expr]:
 class PackedLayoutRule(Rule):
     id = "KTL114"
     name = "packed-layout"
-    summary = ("packed row-layout offsets come from PackedLayout; raw "
-               "additive-literal index arithmetic is forbidden outside "
-               "the `layout-definition` scope")
+    summary = ("packed row/wire-frame offsets come from a "
+               "`layout-definition` scope (PackedLayout, WireLayoutV2); "
+               "raw additive-literal index arithmetic is forbidden "
+               "outside it")
     rationale = (
         "The packed fleet row is one wire format with three independent "
         "consumers: the jitted device programs (`parallel/packed.py`), "
@@ -93,7 +95,11 @@ class PackedLayoutRule(Rule):
         "packed/window modules, subscripts carrying additive literal "
         "offsets (`name + 2 * name + const` forms) are findings. Row and "
         "shard indexing (`base + sb`, `k * mb + len(...)`) carries no "
-        "literal offsets and stays legal.")
+        "literal offsets and stays legal. The wire v2 binary frame "
+        "(`fleet/wire.py`) is the same hazard one layer down — its "
+        "struct offsets live in the `WireLayoutV2` "
+        "`layout-definition` scope, and the encoder/decoder/restamp "
+        "paths slice only through names derived from it.")
 
     def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
         if not ctx.rel_path.startswith(_LAYOUT_SCOPE):

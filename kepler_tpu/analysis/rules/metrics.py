@@ -19,7 +19,7 @@ _METRIC_NAME = re.compile(r"^kepler_[a-z][a-z0-9_]*$")
 _UNIT_TOKENS = frozenset({
     "total", "joules", "watts", "seconds", "ratio", "ms", "bytes",
     "celsius", "info", "healthy", "degraded", "flops", "state",
-    "epoch",
+    "epoch", "version",
 })
 _COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows",
                            "shards", "windows", "inflight"})

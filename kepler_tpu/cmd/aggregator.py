@@ -100,6 +100,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         admission_retry_after=cfg.aggregator.admission_retry_after,
         admission_retry_after_max=(
             cfg.aggregator.admission_retry_after_max),
+        base_row_cache=cfg.aggregator.base_row_cache,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

@@ -172,6 +172,9 @@ def create_services(cfg: Config) -> list:
             drain_batch_max=cfg.agent.drain.batch_max,
             drain_replay_rps=cfg.agent.drain.replay_rps,
             drain_retry_after_max=cfg.agent.drain.retry_after_max,
+            wire_version=cfg.agent.wire.version,
+            keyframe_every=cfg.agent.wire.keyframe_every,
+            wire_degraded_ttl=cfg.agent.wire.degraded_ttl,
         )
         server.health.register_probe("fleet-agent", agent.health)
         if spool is not None:

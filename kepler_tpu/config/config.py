@@ -262,6 +262,23 @@ class DrainConfig:
 
 
 @dataclass
+class WireConfig:
+    """Wire-format behavior of the agent's report stream
+    (``fleet.wire`` v2 fast path, docs/user/fleet.md "Wire format
+    v2")."""
+
+    # 2 (default) = binary v2 frames with delta encoding; 1 pins the
+    # legacy JSON-headered v1 frames (rollout escape hatch)
+    version: int = 2
+    # a full keyframe every N windows even when deltas would do — bounds
+    # how much state a new owner must request after a hand-off
+    keyframe_every: int = 16
+    # how long a replica that answered 415/400 to v2 bytes stays
+    # remembered as v1-only before the agent re-probes v2
+    degraded_ttl: float = 60.0
+
+
+@dataclass
 class AgentConfig:
     """Node-agent delivery plane (the sender half of the fleet leg).
 
@@ -271,6 +288,7 @@ class AgentConfig:
 
     spool: SpoolConfig = field(default_factory=SpoolConfig)
     drain: DrainConfig = field(default_factory=DrainConfig)
+    wire: WireConfig = field(default_factory=WireConfig)
 
 
 @dataclass
@@ -418,6 +436,10 @@ class AggregatorConfig:
     # and the clamp it can never exceed
     admission_retry_after: float = 1.0
     admission_retry_after_max: float = 30.0
+    # -- wire v2 delta bases (docs/user/fleet.md "Wire format v2"):
+    # per-node last-keyframe LRU the delta frames merge against; an
+    # evicted base costs one 409 needs-keyframe round-trip, never loss
+    base_row_cache: int = 1024
 
 
 @dataclass
@@ -560,6 +582,15 @@ class Config:
         if agg.admission_retry_after_max < agg.admission_retry_after:
             errs.append("aggregator.admissionRetryAfterMax must be >= "
                         "aggregator.admissionRetryAfter")
+        if agg.base_row_cache < 1:
+            errs.append("aggregator.baseRowCache must be >= 1")
+        wire = self.agent.wire
+        if wire.version not in (1, 2):
+            errs.append("agent.wire.version must be 1 or 2")
+        if wire.keyframe_every < 1:
+            errs.append("agent.wire.keyframeEvery must be >= 1")
+        if wire.degraded_ttl <= 0:
+            errs.append("agent.wire.degradedTtl must be > 0")
         drain = self.agent.drain
         if drain.batch_max < 1:
             errs.append("agent.drain.batchMax must be >= 1")
@@ -697,6 +728,8 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "batchMax": "batch_max",
     "replayRps": "replay_rps",
     "retryAfterMax": "retry_after_max",
+    "keyframeEvery": "keyframe_every",
+    "baseRowCache": "base_row_cache",
     "maxConnections": "max_connections",
     "maxBytes": "max_bytes",
     "maxRecords": "max_records",
@@ -895,6 +928,14 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         help="virtual nodes per ring peer (ownership granularity)")
     add("--agent.spool-dir", dest="agent_spool_dir", default=None,
         help="crash-safe report spool directory (empty disables)")
+    add("--agent.wire-version", dest="agent_wire_version", default=None,
+        type=int, choices=[1, 2],
+        help="report wire format: 2 = binary delta-encoded v2 "
+             "(default), 1 = legacy JSON-headered frames")
+    add("--aggregator.base-row-cache",
+        dest="aggregator_base_row_cache", default=None, type=int,
+        help="wire-v2 delta-base LRU size (per-node last keyframes; "
+             "eviction costs a 409 needs-keyframe round-trip)")
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
@@ -969,6 +1010,10 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("aggregator", "ring_vnodes"), args.aggregator_ring_vnodes)
     if args.agent_spool_dir is not None:
         cfg.agent.spool.dir = args.agent_spool_dir
+    if args.agent_wire_version is not None:
+        cfg.agent.wire.version = args.agent_wire_version
+    set_if(("aggregator", "base_row_cache"),
+           args.aggregator_base_row_cache)
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     set_if(("telemetry", "enabled"), args.telemetry_enable)

@@ -71,9 +71,11 @@ from typing import Any, Callable, Sequence
 from kepler_tpu import fault, telemetry
 from kepler_tpu.fleet.ring import coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.spool import Spool, SpoolRecord
-from kepler_tpu.fleet.wire import (WireError, encode_report,
+from kepler_tpu.fleet.wire import (WireError, WireLayoutV2,
+                                   encode_delta_v2, encode_report,
                                    encode_report_batch,
-                                   peek_identity, restamp_transmit)
+                                   encode_report_v2, peek_identity,
+                                   restamp_transmit, transcode_to_v1)
 from kepler_tpu.monitor.monitor import PowerMonitor, WindowSample
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
 from kepler_tpu.service.lifecycle import CancelContext, backoff_with_jitter
@@ -138,6 +140,22 @@ class _BatchUnsupportedError(Exception):
     replica's 404/405, or a 400 for an envelope it cannot parse):
     remember that and fall back to single-record sends — never an
     outage signal, never a reason to drop records."""
+
+
+class NeedsKeyframeError(Exception):
+    """Structured 409 from the aggregator to a wire-v2 DELTA frame: it
+    holds no matching base for this node (fresh owner after a hand-off,
+    evicted base row, run change). Treated like a 421 — the tier is
+    alive and the payload is fine, so the drain loop resends the SAME
+    window as a full keyframe: never a failure, never breaker food."""
+
+
+class _WireDowngradeError(Exception):
+    """A 415/400 answered to a v2-encoded frame: an old replica that
+    cannot speak wire v2. The target is remembered as v1-only for
+    ``wire_degraded_ttl`` (the PR 12 batch 404/405 downgrade, wire-
+    shaped) and the SAME record retries as v1 — nothing dropped,
+    nothing counted as an outage."""
 
 
 # backoff used when a 429 carries no usable Retry-After (absent,
@@ -316,6 +334,9 @@ class FleetAgent:
         drain_batch_max: int = 1,
         drain_replay_rps: float = 0.0,
         drain_retry_after_max: float = 300.0,
+        wire_version: int = 2,
+        keyframe_every: int = 16,
+        wire_degraded_ttl: float = 60.0,
     ) -> None:
         self._monitor = monitor
         self._endpoint = endpoint
@@ -370,7 +391,25 @@ class FleetAgent:
                        "breaker_opens": 0, "flushed_on_shutdown": 0,
                        "redirects_followed": 0, "failovers": 0,
                        "handoffs": 0, "throttled_total": 0,
-                       "drain_batches": 0, "drain_batch_records": 0}
+                       "drain_batches": 0, "drain_batch_records": 0,
+                       "keyframes_sent": 0, "deltas_sent": 0,
+                       "keyframe_resends": 0, "wire_downgrades": 0}
+        # wire v2 fast path (ISSUE 14): windows encode as binary v2
+        # KEYFRAMES (what the spool stores — replay/hand-off needs no
+        # base state); at TRANSMIT time a fresh window whose identity
+        # planes match the last ACKED keyframe ships as a delta frame
+        # instead (changed rows only; FLAG_SAME when nothing moved).
+        # Every `keyframe_every`-th window resends full, a structured
+        # 409 needs-keyframe forces the next send full, and a replica
+        # answering 415/400 to v2 bytes is remembered as v1-only for
+        # `wire_degraded_ttl` then re-probed.
+        self._wire_version = 2 if int(wire_version) >= 2 else 1
+        self._keyframe_every = max(1, int(keyframe_every))
+        self._wire_degraded_ttl = max(1e-3, float(wire_degraded_ttl))
+        self._kf_base: "tuple[int, bytes] | None" = None  # (seq, bytes)
+        self._since_keyframe = 0
+        self._needs_keyframe = False
+        self._v1_until: dict[str, float] = {}  # target url → monotonic
         # overload control (ISSUE 12): batched spool drain + throttle
         # handling. drain_batch_max > 1 ships K spooled records per
         # /v1/reports request during recovery replay; drain_replay_rps
@@ -518,6 +557,15 @@ class FleetAgent:
                         self._inflight = None
                     log.info("shutdown flush stopped (throttled): %s", err)
                     break
+                except NeedsKeyframeError:
+                    self._needs_keyframe = True
+                    self._stats["keyframe_resends"] += 1
+                    continue
+                except _WireDowngradeError:
+                    self._v1_until[self._target.url] = \
+                        self._monotonic() + self._wire_degraded_ttl
+                    self._stats["wire_downgrades"] += 1
+                    continue
                 except _BatchUnsupportedError:
                     self._no_batch_targets.add(self._target.url)
                     self._inflight = None
@@ -558,6 +606,9 @@ class FleetAgent:
             "target": self._target.display,
             "ring_epoch": self._ring_epoch,
             "acked_through": self._acked_through,
+            "wire_version": (1 if self._wire_version < 2
+                             or self._target_downgraded()
+                             else 2),
             **self._stats,
         }
         if self._spool is not None:
@@ -689,6 +740,25 @@ class FleetAgent:
                 delay = self._throttle_delay(err.retry_after)
                 if ctx is None or ctx.wait(delay):
                     return
+                continue
+            except NeedsKeyframeError:
+                # the SAME window retries as a full keyframe: the tier
+                # answered (breaker-closing evidence), nothing dropped,
+                # nothing counted as a failure — a 421 in wire clothing
+                self._needs_keyframe = True
+                self._stats["keyframe_resends"] += 1
+                self._note_send_success()
+                continue
+            except _WireDowngradeError:
+                # old replica: remember it as v1-only for the TTL and
+                # retry the SAME record transcoded down
+                self._v1_until[self._target.url] = \
+                    self._monotonic() + self._wire_degraded_ttl
+                self._stats["wire_downgrades"] += 1
+                self._note_send_success()
+                log.info("target %s cannot parse wire v2; downgrading "
+                         "to v1 for %.0fs", self._target.display,
+                         self._wire_degraded_ttl)
                 continue
             except _BatchUnsupportedError:
                 # older replica without /v1/reports: remember and fall
@@ -1008,9 +1078,71 @@ class FleetAgent:
             mode=self._mode,
             workload_kinds=batch.kinds,
         )
+        if self._wire_version >= 2:
+            # binary v2 keyframe — the durable form (spooled records
+            # are ALWAYS keyframes; the delta rewrite happens at
+            # transmit against the last acked keyframe)
+            return encode_report_v2(report, list(sample.zone_names),
+                                    seq=seq, run=self._run_nonce,
+                                    trace_id=trace_id,
+                                    emitted_at=emitted_at)
         return encode_report(report, list(sample.zone_names), seq=seq,
                              run=self._run_nonce, trace_id=trace_id,
                              emitted_at=emitted_at)
+
+    def _target_downgraded(self) -> bool:
+        """True while the current target is remembered as v1-only; an
+        elapsed ``wire_degraded_ttl`` clears the mark so the next send
+        re-probes v2."""
+        until = self._v1_until.get(self._target.url)
+        if until is None:
+            return False
+        if self._monotonic() >= until:
+            del self._v1_until[self._target.url]
+            return False
+        return True
+
+    def _prepare_wire(self, body: bytes,
+                      path: str) -> "tuple[bytes, tuple | None]":
+        """Pick this send's wire form → ``(frame, info)``.
+
+        v1 bodies pass through. A v2 keyframe against a v1-downgraded
+        target transcodes down (raising WireError → the caller's
+        unsendable path). Otherwise a FRESH window with a usable acked
+        base ships as a delta (``info = ("delta",)``); everything else
+        stays a keyframe (``info = ("kf", seq, body)`` when it can
+        become the next base). Replays always ship full — a hand-off's
+        new owner has no base state, and the spool holds keyframes."""
+        if body[: len(WireLayoutV2.MAGIC)] != WireLayoutV2.MAGIC:
+            return body, None
+        if self._wire_version < 2 or self._target_downgraded():
+            return transcode_to_v1(body), None
+        run, seq = peek_identity(body)
+        want_kf = (self._needs_keyframe or path != "fresh"
+                   or self._kf_base is None
+                   or run != self._run_nonce
+                   or self._since_keyframe + 1 >= self._keyframe_every)
+        if not want_kf:
+            delta = encode_delta_v2(body, self._kf_base[1])
+            if delta is not None:
+                return delta, ("delta",)
+        if run == self._run_nonce and seq > 0:
+            return body, ("kf", seq, body)
+        return body, None
+
+    def _after_wire_success(self, info: "tuple | None") -> None:
+        """A 2xx landed: adopt the keyframe as the delta base, or tick
+        the delta cadence toward the next scheduled keyframe."""
+        if info is None:
+            return
+        if info[0] == "kf":
+            self._kf_base = (info[1], info[2])
+            self._since_keyframe = 0
+            self._needs_keyframe = False
+            self._stats["keyframes_sent"] += 1
+        else:
+            self._since_keyframe += 1
+            self._stats["deltas_sent"] += 1
 
     def _delivery_path(self, origin_wall: float, recovered: bool) -> str:
         """Label for the delivery-latency histogram: a crash-backlog
@@ -1123,11 +1255,12 @@ class FleetAgent:
         if spec is not None:
             sent_at += spec.arg if spec.arg is not None else 300.0
         try:
-            body = restamp_transmit(body, sent_at, delivery_path=path,
-                                    appended_at=appended_at,
-                                    owner=self._target.display,
-                                    epoch=self._ring_epoch,
-                                    acked_through=self._acked_through)
+            frame, wire_info = self._prepare_wire(body, path)
+            frame = restamp_transmit(frame, sent_at, delivery_path=path,
+                                     appended_at=appended_at,
+                                     owner=self._target.display,
+                                     epoch=self._ring_epoch,
+                                     acked_through=self._acked_through)
         except WireError as err:
             # a spooled record that no longer parses (disk corruption the
             # CRC missed, or a format change across restart) can never be
@@ -1135,15 +1268,32 @@ class FleetAgent:
             # through a path that does NOT masquerade as an aggregator
             # response (no network contact happened)
             raise UnsendableRecordError(str(err)) from err
+        sent_v2 = frame[: len(WireLayoutV2.MAGIC)] == WireLayoutV2.MAGIC
+        sent_delta = wire_info is not None and wire_info[0] == "delta"
         spec = fault.fire("net.corrupt_body")
         if spec is not None:
             # drop the tail: header (and node name) stay parseable, the
             # array manifest overruns → deterministic WireError server-side
-            body = body[:-4]
-        resp, data = self._transport_post(self._path, body)
+            frame = frame[:-4]
+        resp, data = self._transport_post(self._path, frame)
         if resp.status == 421:
             owner, epoch = _parse_redirect(data, resp.headers)
             raise OwnerRedirectError(owner, epoch)
+        if resp.status == 409 and sent_delta \
+                and resp.headers.get("X-Kepler-Needs-Keyframe"):
+            # only a DELTA can legitimately need a keyframe; the marker
+            # on anything else is a hostile/buggy server and falls
+            # through to the permanent-reject path (no resend loop)
+            raise NeedsKeyframeError()
+        if sent_v2 and (resp.status == 415 or (
+                resp.status == 400
+                and (b"bad magic" in data or b"unsupported" in data))):
+            # an old replica that can't parse v2 bytes at all (its v1
+            # decoder answers "bad magic"/"unsupported version"):
+            # downgrade this target and retry the SAME record as v1. A
+            # 400 naming any OTHER defect is a real quarantine of a
+            # corrupt record and keeps its permanent-reject semantics.
+            raise _WireDowngradeError()
         if resp.status == 429:
             # a throttle, never a failure: the Retry-After is hostile
             # wire input until coerced (clamped so an adversarial owner
@@ -1157,6 +1307,7 @@ class FleetAgent:
             raise http.client.HTTPException(
                 f"aggregator returned {resp.status}")
         self._learn_epoch(resp.headers)
+        self._after_wire_success(wire_info)
 
     def _send_batch(self, recs: "list[SpoolRecord]") -> int:
         """Ship consecutive spooled records as ONE ``/v1/reports``
@@ -1175,11 +1326,17 @@ class FleetAgent:
             sent_at += spec.arg if spec.arg is not None else 300.0
         bodies: list[bytes] = []
         batch: list[SpoolRecord] = []
+        downgraded = self._wire_version < 2 or self._target_downgraded()
         for rec in recs:
             path = self._delivery_path(rec.appended_at, rec.recovered)
             try:
+                payload = rec.payload
+                if downgraded:
+                    # v1-only target: spooled v2 keyframes transcode
+                    # down per record (v1 payloads pass through)
+                    payload = transcode_to_v1(payload)
                 bodies.append(restamp_transmit(
-                    rec.payload, sent_at, delivery_path=path,
+                    payload, sent_at, delivery_path=path,
                     appended_at=rec.appended_at,
                     owner=self._target.display,
                     epoch=self._ring_epoch,
@@ -1237,17 +1394,46 @@ class FleetAgent:
         concluded = 0
         throttle: float | None = None
         redirect: "tuple | None" = None
+        kf_base: "tuple[int, bytes] | None" = None
+        wire_downgrade = False
         for rec, row in zip(batch, results):
             st = row.get("status") if isinstance(row, dict) else None
             if isinstance(st, bool) or not isinstance(st, int):
                 break  # hostile row: stop concluding records here
+            if (st in (400, 415) and not downgraded
+                    and rec.payload[: len(WireLayoutV2.MAGIC)]
+                    == WireLayoutV2.MAGIC):
+                err_txt = row.get("error")
+                if isinstance(err_txt, str) and (
+                        "bad magic" in err_txt
+                        or "unsupported" in err_txt):
+                    # a pre-v2 replica whose batch endpoint exists but
+                    # whose v1 decoder rejects every v2 record: this is
+                    # the wire-downgrade signature, NOT a permanent
+                    # reject — stop concluding WITHOUT acking so the
+                    # durable backlog retries transcoded to v1
+                    wire_downgrade = True
+                    break
             if 200 <= st < 300:
                 self._spool.ack(rec)
                 concluded += 1
                 run, seq = peek_identity(rec.payload)
                 if run == self._run_nonce:
                     top_seq = max(top_seq, seq)
+                    if (seq > 0 and not downgraded
+                            and rec.payload[: len(WireLayoutV2.MAGIC)]
+                            == WireLayoutV2.MAGIC):
+                        # a spooled keyframe the owner just accepted is
+                        # a fresh delta base — after a herd replay the
+                        # agent resumes deltas immediately
+                        kf_base = (seq, rec.payload)
                 continue
+            if st == 409 and isinstance(row.get("needs_keyframe"),
+                                        bool) and row["needs_keyframe"]:
+                # spooled records are already keyframes, so this can
+                # only be a hostile/buggy server: stop concluding
+                # WITHOUT acking (never drop a durable record on it)
+                break
             if st == 429:
                 throttle = coerce_retry_after(
                     row.get("retry_after"), cap=self._retry_after_max)
@@ -1269,6 +1455,21 @@ class FleetAgent:
         self._stats["drain_batch_records"] += concluded
         if top_seq:
             self._acked_through = max(self._acked_through, top_seq)
+        if kf_base is not None:
+            self._kf_base = kf_base
+            self._since_keyframe = 0
+            self._needs_keyframe = False
+        if wire_downgrade and concluded == 0:
+            # nothing concluded: surface the downgrade so the drain
+            # marks the target v1-only and retries the SAME batch
+            # transcoded — never the failure path (the replica is up)
+            raise _WireDowngradeError()
+        if wire_downgrade:
+            # a prefix concluded before the v2 wall: mark the target
+            # here so the next peek already transcodes
+            self._v1_until[self._target.url] = \
+                self._monotonic() + self._wire_degraded_ttl
+            self._stats["wire_downgrades"] += 1
         if throttle is not None:
             raise ThrottledError(throttle)
         if redirect is not None:

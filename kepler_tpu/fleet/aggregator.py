@@ -53,12 +53,15 @@ from kepler_tpu.fleet.admission import (
 )
 from kepler_tpu.fleet.ring import HashRing, coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.wire import (
+    ParsedHeader,
     WireError,
+    decode_delta,
     decode_report,
     decode_report_batch,
     peek_node_name,
     peek_routing,
     sanitize_node_name,
+    try_parse_header,
 )
 from kepler_tpu.fleet.scoreboard import STATE_NAMES, FleetScoreboard
 from kepler_tpu.fleet.window import (DeviceWindowError, PackedWindowEngine,
@@ -126,6 +129,25 @@ class _Stored:
     received: float
     seq: int
     run: str = ""  # agent-run nonce (empty for pre-nonce agents)
+    # seq at which the report CONTENT last changed (wire v2 FLAG_SAME
+    # deltas bump seq but keep this, so the window engine's per-row
+    # identity short-circuits to zero staged bytes for unchanged nodes);
+    # 0 = unknown → fall back to seq (v1 agents restage every window)
+    content_seq: int = 0
+    wire_version: int = 1
+
+
+@dataclass
+class _BaseRow:
+    """One node's resident delta base: the last v2 keyframe accepted
+    from it (count-capped LRU beside the seq trackers). Immutable once
+    stored — replaced wholesale by the next keyframe, so delta merges
+    read it without the store lock."""
+
+    run: str
+    seq: int
+    report: NodeReport
+    zone_names: tuple[str, ...]
 
 
 def _primary_introspect(snap: Mapping[str, dict]) -> dict | None:
@@ -426,6 +448,7 @@ class Aggregator:
         admission_retry_after: float = 1.0,
         admission_retry_after_max: float = 30.0,
         admission_jitter_seed: int | None = None,
+        base_row_cache: int = 1024,
         clock: Callable[[], float] | None = None,
         mesh: Any = None,
     ) -> None:
@@ -506,6 +529,14 @@ class Aggregator:
             for path in ("fresh", "replay")}
         self._seq_trackers: dict[str, _SeqTracker] = {}  # keplint: guarded-by=_lock
         self._tracker_cap = 512
+        # wire v2 delta bases: per-node last accepted keyframe, the
+        # state a delta frame merges against. Count-capped LRU (dict
+        # order = recency; oldest evicted) beside the seq trackers — a
+        # delta whose base was evicted is answered with a structured
+        # 409 needs-keyframe and the agent resends full, so eviction is
+        # a round-trip, never corruption or loss.
+        self._base_rows: dict[str, _BaseRow] = {}  # keplint: guarded-by=_lock
+        self._base_row_cache = max(1, int(base_row_cache))
         self._lost_by_node: dict[str, int] = {}  # keplint: guarded-by=_lock
         self._lost_node_cap = 256
         # fleet scoreboard: one synthesized health row per node (state
@@ -558,6 +589,9 @@ class Aggregator:
                        "quarantined_total": 0, "malformed_total": 0,
                        "clock_skew_total": 0,
                        "reports_redirected_total": 0,
+                       # wire v2: deltas answered 409 needs-keyframe
+                       # (missing/mismatched base — agent resends full)
+                       "keyframe_requests_total": 0,
                        "duplicates_total": 0, "windows_lost_total": 0,
                        "attributions_total": 0, "last_batch_nodes": 0,
                        "last_batch_workloads": 0,
@@ -586,6 +620,9 @@ class Aggregator:
                        "window_rung": 0,
                        "window_demotions_total": 0,
                        "window_repromotions_total": 0}
+        # ingest payload bytes by wire version (the v1↔v2 byte-savings
+        # evidence: kepler_fleet_ingest_bytes_total{version})
+        self._ingest_bytes: dict[int, int] = {1: 0, 2: 0}  # keplint: guarded-by=_lock
         # cumulative per-node energy for _total counters: a shared dense
         # RowStore (the same machinery as the monitor's per-workload
         # accumulators) whose columns follow the canonical zone axis and
@@ -774,18 +811,24 @@ class Aggregator:
         # agent opened at window emit
         with telemetry.span("aggregator.ingest"):
             ctrl = self._admission
-            if ctrl is None or request.command != "POST":
+            if request.command != "POST":
                 return self._ingest_report(request)
+            # ONE header parse per record, carried from the admission
+            # peek through _ingest_payload (v1 used to re-parse the
+            # same JSON up to four times; v2 makes this a struct read)
+            parsed = try_parse_header(request.body)
+            if ctrl is None:
+                return self._ingest_report(request, parsed)
             # admission runs BEFORE any decode work: over budget the
             # request is turned away at header-peek cost, and the spool
             # on the agent side makes that loss-free — the record stays
             # durable and replays after the Retry-After hint
-            retry = ctrl.admit(self._priority_of(request.body))
+            retry = ctrl.admit(self._priority_of(request.body, parsed))
             if retry is not None:
                 return self._throttle_response(retry)
             t0 = _time.perf_counter()
             try:
-                return self._ingest_report(request)
+                return self._ingest_report(request, parsed)
             finally:
                 ctrl.done(_time.perf_counter() - t0)
 
@@ -822,8 +865,9 @@ class Aggregator:
                     results.append({"status": 429,
                                     "retry_after": shed_retry})
                     continue
+                parsed = try_parse_header(body)
                 if ctrl is not None:
-                    retry = ctrl.admit(self._priority_of(body))
+                    retry = ctrl.admit(self._priority_of(body, parsed))
                     if retry is not None:
                         shed_retry = retry
                         results.append({"status": 429,
@@ -831,13 +875,20 @@ class Aggregator:
                         continue
                 t0 = _time.perf_counter()
                 try:
-                    status, _headers, resp_body = \
-                        self._ingest_payload(body)
+                    status, resp_headers, resp_body = \
+                        self._ingest_payload(body, parsed)
                 finally:
                     if ctrl is not None:
                         ctrl.done(_time.perf_counter() - t0)
                 row: dict[str, Any] = {"status": status}
-                if status == 421:
+                if status == 421 or (
+                        status == 409
+                        and resp_headers.get(
+                            "X-Kepler-Needs-Keyframe")):
+                    # structured responses (owner redirect, needs-
+                    # keyframe) keep their JSON shape per record, so
+                    # the agent's guards see the same fields as on the
+                    # single-record path
                     try:
                         row.update(json.loads(resp_body))
                     except ValueError:
@@ -860,12 +911,17 @@ class Aggregator:
                       "Retry-After": f"{retry:g}",
                       **self._epoch_headers()}, body)
 
-    def _priority_of(self, body: bytes) -> int:
+    def _priority_of(self, body: bytes,
+                     parsed: "ParsedHeader | None" = None) -> int:
         """Admission priority from a CHEAP header peek (no array decode):
         replay backlogs behind fresh windows, model-estimated nodes
         behind RAPL ground truth, scoreboard-flagged reporters behind
-        healthy ones — live attribution accuracy degrades last."""
-        name, path, mode = peek_routing(body)
+        healthy ones — live attribution accuracy degrades last. With a
+        ``parsed`` memo the peek is a dict read, not a re-parse."""
+        if parsed is not None:
+            name, path, mode = parsed.routing()
+        else:
+            name, path, mode = peek_routing(body)
         if path == "replay":
             p = PRIORITY_REPLAY_GROUND
         else:
@@ -880,7 +936,9 @@ class Aggregator:
         return p
 
     def _ingest_report(
-            self, request: Any) -> tuple[int, dict[str, str], bytes]:
+            self, request: Any,
+            parsed: "ParsedHeader | None" = None
+            ) -> tuple[int, dict[str, str], bytes]:
         if request.command != "POST":
             return 405, {"Content-Type": "text/plain"}, b"POST only\n"
         if fault.fire("replica.down") is not None:
@@ -889,27 +947,102 @@ class Aggregator:
             # as a permanent rejection
             return (503, {"Content-Type": "text/plain"},
                     b"replica down (fault injection)\n")
-        return self._ingest_payload(request.body)
+        return self._ingest_payload(request.body, parsed)
+
+    def _delta_base_for(self, parsed: "ParsedHeader"
+                        ) -> "_BaseRow | None":
+        """Resolve a v2 delta frame's base keyframe. None = answer a
+        structured 409 needs-keyframe (missing base after hand-off or
+        eviction, run change, base-seq mismatch) — the agent resends
+        full, nothing is charged or stored. A hostile node name raises
+        into the ordinary quarantine path instead."""
+        raw = parsed.header.get("node_name")
+        name = sanitize_node_name(raw) if isinstance(raw, str) else ""
+        if not name or name != raw:
+            raise WireError("node_name must be 1-128 printable ASCII "
+                            "chars")
+        run = parsed.header.get("run")
+        with self._lock:
+            base = self._base_rows.get(name)
+            if (base is None or base.run != run
+                    or base.seq != parsed.base_seq):
+                self._stats["keyframe_requests_total"] += 1
+                return None
+            self._base_rows[name] = self._base_rows.pop(name)  # LRU touch
+        return base
+
+    def _needs_keyframe_response(
+            self, parsed: "ParsedHeader"
+            ) -> tuple[int, dict[str, str], bytes]:
+        body = json.dumps({"needs_keyframe": True,
+                           "base_seq": parsed.base_seq}).encode()
+        return (409, {"Content-Type": "application/json",
+                      "X-Kepler-Needs-Keyframe": "1",
+                      **self._epoch_headers()}, body)
+
+    # keplint: requires-lock=_lock
+    def _store_base_locked(self, name: str, run: str, seq: int,
+                           report: NodeReport,
+                           zones: tuple[str, ...]) -> None:
+        """Adopt a decoded v2 keyframe as the node's delta base (LRU:
+        dict order = recency, oldest evicted at the cap). Runs for
+        DUPLICATE keyframes too: a hand-off replay judged dup by the
+        seeded tracker must still plant the base, or the agent's next
+        delta would 409 forever."""
+        self._base_rows.pop(name, None)
+        while len(self._base_rows) >= self._base_row_cache:
+            self._base_rows.pop(next(iter(self._base_rows)))
+        self._base_rows[name] = _BaseRow(run=run, seq=seq,
+                                         report=report,
+                                         zone_names=zones)
 
     def _ingest_payload(
-            self, body: bytes) -> tuple[int, dict[str, str], bytes]:
+            self, body: bytes,
+            parsed: "ParsedHeader | None" = None
+            ) -> tuple[int, dict[str, str], bytes]:
         spec = fault.fire("aggregator.ingest_slow")
         if spec is not None:
             # chaos stand-in for a sinking ingest path (GC stall, slow
             # disk, CPU-starved replica): inflates the admission
             # controller's latency EWMA the honest way — by being slow
             _time.sleep(float(spec.arg or 0.05))
+        if parsed is None:
+            parsed = try_parse_header(body)
+        if parsed is not None:
+            # clamp to the two known versions: the counter keys a metric
+            # label and must never grow with hostile frame contents
+            version = 2 if parsed.version == 2 else 1
+            with self._lock:
+                self._ingest_bytes[version] = \
+                    self._ingest_bytes.get(version, 0) + len(body)
+        content_changed = True
         try:
             with telemetry.span("aggregator.decode"):
-                report, header = decode_report(body)
+                if (parsed is not None and parsed.version == 2
+                        and parsed.is_delta):
+                    base = self._delta_base_for(parsed)
+                    if base is None:
+                        return self._needs_keyframe_response(parsed)
+                    report, header, content_changed = decode_delta(
+                        body, parsed, base.report, base.zone_names)
+                else:
+                    # v1 (the pinned JSON path — decoded off the ONE
+                    # parse_header memo) or a v2 keyframe (zero-copy
+                    # frombuffer views over the request body)
+                    report, header = decode_report(body, parsed)
         except (WireError, ValueError) as err:
             # quarantine, charged to the sender when the header survives.
-            # The header re-parse runs OFF the store lock — a burst of
+            # The header work runs OFF the store lock — a burst of
             # large malformed bodies must not stall ingest/aggregation.
             # The peeked name is UNVALIDATED wire input (the body already
             # failed decoding): sanitize before it becomes a degradation
             # key, scoreboard row, metric label, or log field (KTL112)
-            node = sanitize_node_name(peek_node_name(body) or "")
+            if parsed is not None:
+                raw = parsed.header.get("node_name")
+                node = (sanitize_node_name(raw)
+                        if isinstance(raw, str) else "")
+            else:
+                node = sanitize_node_name(peek_node_name(body) or "")
             with self._lock:
                 self._stats["rejected_total"] += 1
                 self._stats["quarantined_total"] += 1
@@ -994,7 +1127,10 @@ class Aggregator:
                          zone_names=tuple(header["zone_names"]),
                          received=received,
                          seq=seq_raw,
-                         run=run_raw)
+                         run=run_raw,
+                         content_seq=seq_raw,
+                         wire_version=(2 if parsed is not None
+                                       and parsed.version == 2 else 1))
         # scoreboard input, computed OFF the store lock: the node's
         # self-reported power this window (valid zone energy over dt)
         report_power_w = _report_power_w(report)
@@ -1016,6 +1152,26 @@ class Aggregator:
             has_nonces = (prev is not None and bool(stored.run)
                           and bool(prev.run))
             restarted = has_nonces and stored.run != prev.run
+            # wire v2: adopt an accepted keyframe as the node's delta
+            # base BEFORE dedup (a duplicate keyframe is still a valid
+            # base — see _store_base_locked) but AFTER the superseded-
+            # run check, so a dead run can never plant base state
+            if (parsed is not None and parsed.version == 2
+                    and not parsed.is_delta and stored.run
+                    and stored.seq > 0):
+                self._store_base_locked(
+                    report.node_name, stored.run, stored.seq, report,
+                    stored.zone_names)
+            # content identity: a FLAG_SAME delta asserts (and decode
+            # verified) that this window's content EQUALS the base
+            # keyframe's — so the content seq is the BASE's seq, not
+            # this window's. Steady state pins every unchanged window
+            # to the keyframe identity (zero staged rows); a node that
+            # changed and then reverted gets the keyframe identity
+            # back, which correctly restages it over the changed row.
+            if (not content_changed and parsed is not None
+                    and parsed.is_delta and parsed.base_seq > 0):
+                stored.content_seq = parsed.base_seq
             if restarted:
                 runs = self._superseded_runs.setdefault(
                     report.node_name, [])
@@ -1156,6 +1312,9 @@ class Aggregator:
                 del self._reports[name]
                 self._history.pop(name, None)
                 self._superseded_runs.pop(name, None)
+                # the new owner holds no base for it either — dropping
+                # ours keeps "409 → keyframe" the one hand-off story
+                self._base_rows.pop(name, None)
                 # the node reports to its NEW owner now — a row left
                 # here would age into a permanent false 'stale' signal
                 self._scoreboard.drop(name)
@@ -1639,8 +1798,11 @@ class Aggregator:
         rows = [
             RowInput(name=s.report.node_name, report=s.report,
                      zone_names=s.zone_names,
-                     ident=((s.run, s.seq) if s.run and s.seq > 0
-                            else None))
+                     # CONTENT identity, not delivery identity: a v2
+                     # FLAG_SAME delta bumps seq but not content_seq,
+                     # so an unchanged node stages zero rows end to end
+                     ident=((s.run, s.content_seq or s.seq)
+                            if s.run and s.seq > 0 else None))
             for s in stored_sorted]
         params = self._params_for_zones(len(zone_names))
         if params is None:
@@ -2416,6 +2578,35 @@ class Aggregator:
             "another ring replica; the agent follows to the owner)")
         redirected.add_metric([], stats["reports_redirected_total"])
         yield redirected
+        keyframes = CounterMetricFamily(
+            "kepler_fleet_reports_keyframe_requests_total",
+            "Wire-v2 delta frames answered with a structured 409 "
+            "needs-keyframe (base missing after hand-off/eviction or "
+            "run/seq mismatch) — the agent resends full, never a loss")
+        keyframes.add_metric([], stats["keyframe_requests_total"])
+        yield keyframes
+        with self._lock:
+            ingest_bytes_snap = sorted(self._ingest_bytes.items())
+            version_rollup: dict[int, int] = {1: 0, 2: 0}
+            for s in self._reports.values():
+                version_rollup[s.wire_version] = \
+                    version_rollup.get(s.wire_version, 0) + 1
+        ingest_bytes = CounterMetricFamily(
+            "kepler_fleet_ingest_bytes_total",
+            "Report payload bytes ingested, by wire version (v2 delta "
+            "steady state runs far below v1's JSON-framed bytes)",
+            labels=["version"])
+        for version, count in ingest_bytes_snap:
+            ingest_bytes.add_metric([str(version)], count)
+        yield ingest_bytes
+        wire_version = GaugeMetricFamily(
+            "kepler_fleet_wire_version",
+            "Live nodes by the wire version of their last stored "
+            "report (the v1→v2 fleet-rollout progress rollup)",
+            labels=["version"])
+        for version, count in sorted(version_rollup.items()):
+            wire_version.add_metric([str(version)], count)
+        yield wire_version
         ctrl = self._admission
         shed = CounterMetricFamily(
             "kepler_fleet_reports_shed_total",

@@ -59,6 +59,13 @@ def fat_result(**overrides) -> dict:
         "aggwin_sharded_device_ratio": 0.5,
         "aggwin_sharded_ratio_budget": 0.6,
         "aggwin_sharded_bit_consistent": True,
+        "ingest_ok": True,
+        "ingest_zero_copy_ok": True,
+        "ingest_decode_ratio": 4.9,
+        "ingest_decode_ratio_budget": 4.0,
+        "ingest_reports_per_s": 1100.0,
+        "ingest_bytes_per_report_v1": 2234.7,
+        "ingest_bytes_per_report_v2": 143.0,
         "e2e_pipelined_p99_ms": 7.1,
         "sync_floor_p50_ms": 66.0,
         # pathological bulk: thousands of chars of per-leg detail
@@ -185,6 +192,35 @@ class TestErroredLegGates:
         head = json.loads(line)
         assert head["aggwin_sharded_ok"] is False
         assert head["ok"] is False
+
+    def test_ingest_gate_violation_gates_and_survives_headline(self):
+        """The ISSUE-14 wire-v2 ingest gate: a measured decode-ratio
+        violation fails the run, lands False in the headline, and the
+        headline still honors the size contract."""
+        result = fat_result(ingest_ok=False, ingest_decode_ratio=2.1)
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert any("ingest" in m for m in messages)
+        result["ok"] = not failed
+        line = bench.build_headline(result, "BENCH_DETAIL.json")
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["ingest_ok"] is False
+        assert head["ingest_zero_copy_ok"] is True
+        assert head["ok"] is False
+
+    def test_absent_ingest_leg_does_not_gate(self):
+        """A detail row without the ingest leg (older capture replayed
+        through the gate logic) must not fire the new gate on absence."""
+        result = fat_result()
+        for key in list(result):
+            if key.startswith("ingest_"):
+                del result[key]
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert not failed
+        assert messages == []
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert "ingest_ok" not in head
 
     def test_absent_sharded_leg_does_not_gate(self):
         """A single-device host (standalone scenarios run) emits no

@@ -435,6 +435,16 @@ FIELD_MATRIX = [
               "agent: {drain: {replayRps: 64}}", 64.0),
     FieldCase("agent.drain.retry_after_max",
               "agent: {drain: {retryAfterMax: 2m}}", 120.0),
+    FieldCase("agent.wire.version",
+              "agent: {wire: {version: 1}}", 1,
+              ["--agent.wire-version", "2"], 2),
+    FieldCase("agent.wire.keyframe_every",
+              "agent: {wire: {keyframeEvery: 4}}", 4),
+    FieldCase("agent.wire.degraded_ttl",
+              "agent: {wire: {degradedTtl: 2m}}", 120.0),
+    FieldCase("aggregator.base_row_cache",
+              "aggregator: {baseRowCache: 64}", 64,
+              ["--aggregator.base-row-cache", "32"], 32),
     FieldCase("web.max_connections",
               "web: {maxConnections: 64}", 64,
               ["--web.max-connections", "32"], 32),
@@ -572,6 +582,8 @@ class TestYAMLSpellings:
         "batchMax": ("agent", "drain"),
         "replayRps": ("agent", "drain"),
         "retryAfterMax": ("agent", "drain"),
+        "keyframeEvery": ("agent", "wire"),
+        "baseRowCache": "aggregator",
         "maxConnections": "web",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
@@ -638,6 +650,8 @@ class TestYAMLSpellings:
         "batchMax": ("8", 8),
         "replayRps": ("64", 64.0),
         "retryAfterMax": ("2m", 120.0),
+        "keyframeEvery": ("4", 4),
+        "baseRowCache": ("64", 64),
         "maxConnections": ("64", 64),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
@@ -791,6 +805,18 @@ class TestValidationMatrix:
         ("agent.drain.retryAfterMax",
          lambda c: setattr(c.agent.drain, "retry_after_max", -1),
          "retryAfterMax"),
+        ("agent.wire.version",
+         lambda c: setattr(c.agent.wire, "version", 3),
+         "wire.version"),
+        ("agent.wire.keyframeEvery",
+         lambda c: setattr(c.agent.wire, "keyframe_every", 0),
+         "keyframeEvery"),
+        ("agent.wire.degradedTtl",
+         lambda c: setattr(c.agent.wire, "degraded_ttl", 0),
+         "degradedTtl"),
+        ("aggregator.baseRowCache",
+         lambda c: setattr(c.aggregator, "base_row_cache", 0),
+         "baseRowCache"),
         ("web.maxConnections",
          lambda c: setattr(c.web, "max_connections", -1),
          "maxConnections"),

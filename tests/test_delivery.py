@@ -212,6 +212,191 @@ class TestRingHeaderCoercion:
         assert agg._stats["windows_lost_total"] == 4
 
 
+class TestWireV2HeaderCoercion:
+    """ISSUE 14: the hostile-field discipline re-run against the BINARY
+    v2 header — non-printable/overlong name, hostile owner, hostile
+    delta payloads — always a 400 quarantine (charged to the node when
+    the name survives sanitization), never a 500."""
+
+    def _kf(self, name="v2coerce", seq=1, run="r1"):
+        from kepler_tpu.fleet.wire import encode_report_v2
+
+        return encode_report_v2(make_report(name), ["package", "dram"],
+                                seq=seq, run=run)
+
+    def _patch_str(self, blob: bytes, field: str, value: bytes) -> bytes:
+        """Rewrite one var-length header string in place (same length —
+        the attacker's minimal bit-flip view of the wire)."""
+        import struct as _s
+
+        from kepler_tpu.fleet.wire import WireLayoutV2 as L
+
+        fixed = L.FIXED.unpack_from(blob, len(L.MAGIC))
+        name_len, run_len = fixed[14], fixed[15]
+        off = L.fixed_end()
+        offs = {"name": off, "run": off + name_len}
+        start = offs[field]
+        assert len(value) == (name_len if field == "name" else run_len)
+        out = bytearray(blob)
+        out[start: start + len(value)] = value
+        return bytes(out)
+
+    def test_nonprintable_name_quarantined(self, server):
+        agg = make_agg(server)
+        blob = self._patch_str(self._kf("victim01"), "name",
+                               b"victim\n1")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_raw(server, blob)
+        assert err.value.code == 400
+        assert agg._stats["malformed_total"] == 1
+        # charged to the SANITIZED name, never the raw bytes
+        assert "victim1" in agg.degraded_nodes()
+        assert not agg._reports
+
+    def test_hostile_owner_quarantined(self, server):
+        from kepler_tpu.fleet.wire import restamp_transmit
+
+        agg = make_agg(server)
+        blob = restamp_transmit(self._kf(), time.time(),
+                                owner="evil owner\x01")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_raw(server, blob)
+        assert err.value.code == 400
+        assert b"owner" in err.value.read()
+        assert agg._stats["malformed_total"] == 1
+        assert "v2coerce" in agg.degraded_nodes()
+
+    def test_overlong_owner_rejected(self, server):
+        """An owner past the layout cap can't even be framed by the
+        encoder; a hand-built frame claiming one fails the header
+        parse → 400, no allocation."""
+        import struct as _s
+
+        from kepler_tpu.fleet.wire import WireLayoutV2 as L
+
+        agg = make_agg(server)
+        blob = bytearray(self._kf())
+        # owner_len is the last u16 of the fixed block
+        off = len(L.MAGIC) + L.FIXED.size - _s.calcsize("<H")
+        _s.pack_into("<H", blob, off, L.MAX_OWNER + 1)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_raw(server, bytes(blob))
+        assert err.value.code == 400
+        assert agg._stats["malformed_total"] == 1
+
+    def test_skew_and_dedup_semantics_unchanged(self, server):
+        """Admission/dedup/quarantine semantics hold under v2: skewed
+        sent_at quarantines (422), a redelivered (run, seq) dedups
+        (204, duplicates_total)."""
+        from kepler_tpu.fleet.wire import restamp_transmit
+
+        agg = make_agg(server)
+        skewed = restamp_transmit(self._kf(), time.time() + 10_000)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            post_raw(server, skewed)
+        assert err.value.code == 422
+        assert agg._stats["clock_skew_total"] == 1
+        ok = restamp_transmit(self._kf(), time.time())
+        assert post_raw(server, ok).status == 204
+        assert post_raw(server, ok).status == 204  # redelivery
+        assert agg._stats["duplicates_total"] == 1
+        assert agg._stats["reports_total"] == 2
+
+
+class TestWireVersionFallback:
+    """ISSUE 14 satellite: an old replica answering 415/400 ("bad
+    magic") to a v2 frame downgrades that target to v1 — the SAME
+    record retries transcoded, nothing dropped, nothing breaker-fed —
+    and the agent re-probes v2 after ``wire_degraded_ttl``."""
+
+    def _old_replica(self, agg):
+        """Make the live aggregator answer v2 bytes exactly like a
+        pre-v2 build: its v1 decoder's 400 "bad magic"."""
+        from kepler_tpu.fleet.wire import WireLayoutV2
+
+        real = agg._ingest_payload
+
+        def v1_only(body, parsed=None):
+            if body[: len(WireLayoutV2.MAGIC)] == WireLayoutV2.MAGIC:
+                return (400, {"Content-Type": "text/plain"},
+                        b"bad magic\n")
+            return real(body, parsed=None)
+
+        agg._ingest_payload = v1_only
+        return real
+
+    def test_downgrade_then_reprobe(self, server):
+        agg = make_agg(server)
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor, wire_degraded_ttl=0.2)
+        real = self._old_replica(agg)
+        agent._on_window(make_sample())
+        agent._drain(None)
+        # delivered as v1 on the SAME drain pass: one downgrade, no
+        # failures, no breaker movement, nothing dropped
+        assert agent._stats["wire_downgrades"] == 1
+        assert agent._stats["sent_total"] == 1
+        assert agent._stats["send_failures"] == 0
+        assert agent._stats["dropped_total"] == 0
+        assert agent._breaker_state == BREAKER_CLOSED
+        assert agg._reports["dur-node"].wire_version == 1
+        assert agent.health()["wire_version"] == 1
+        # the replica upgrades; before the TTL the agent still sends v1
+        agg._ingest_payload = real
+        agent._on_window(make_sample())
+        agent._drain(None)
+        assert agg._reports["dur-node"].wire_version == 1
+        # after the TTL it re-probes v2 and sticks
+        time.sleep(0.25)
+        agent._on_window(make_sample())
+        agent._drain(None)
+        assert agg._reports["dur-node"].wire_version == 2
+        assert agent._stats["wire_downgrades"] == 1
+        assert agent.health()["wire_version"] == 2
+        agent.shutdown()
+
+    def test_batch_drain_downgrades_without_loss(self, server,
+                                                 tmp_path):
+        """A spooled v2 backlog drained BATCHED into a v1-only replica
+        (per-row 400 "bad magic") must never conclude/drop records —
+        the target downgrades and the same batch retries transcoded."""
+        agg = make_agg(server)
+        spool = Spool(str(tmp_path / "sp"))
+        agent = make_agent(server, FakeMeterMonitor(), spool=spool,
+                           drain_batch_max=8)
+        self._old_replica(agg)
+        for _ in range(4):
+            agent._on_window(make_sample())
+        agent._drain(None)
+        assert agent._stats["dropped_total"] == 0
+        assert agent._stats["server_rejections"] == 0
+        assert agent._stats["wire_downgrades"] == 1
+        assert spool.pending_records() == 0
+        assert agg._reports["dur-node"].seq == 4
+        assert agg._reports["dur-node"].wire_version == 1
+        agent.shutdown()
+
+    def test_genuine_400_still_drops(self, server):
+        """A 400 naming any other defect keeps permanent-reject
+        semantics — no downgrade loop, the record drops once."""
+        agg = make_agg(server)
+        monitor = FakeMeterMonitor()
+        agent = make_agent(server, monitor)
+
+        def reject(body, parsed=None):
+            return (400, {"Content-Type": "text/plain"},
+                    b"seq must be a non-negative integer\n")
+
+        agg._ingest_payload = reject
+        agent._on_window(make_sample())
+        agent._drain(None)
+        assert agent._stats["wire_downgrades"] == 0
+        assert agent._stats["dropped_total"] == 1
+        assert agent._stats["server_rejections"] == 1
+        assert agent.backlog() == 0
+        agent.shutdown()
+
+
 class TestThrottleHeaderCoercion:
     """Satellite (ISSUE 12): throttle-control values from the wire —
     the 429 ``Retry-After`` header and the batch response's per-record

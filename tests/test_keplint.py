@@ -1050,6 +1050,24 @@ class TestPackedLayoutRule:
         """, rel="kepler_tpu/fleet/window.py")
         assert ids(diags) == ["KTL114"]
 
+    def test_bad_in_wire_module_too(self, lint):
+        """ISSUE 14: the v2 binary frame brings the same hazard to
+        fleet/wire.py — raw offsets there are findings too."""
+        diags = lint("""
+            def peek(data, name_len):
+                return data[8 + 2 * name_len + 4]
+        """, rel="kepler_tpu/fleet/wire.py")
+        assert ids(diags) == ["KTL114"]
+
+    def test_good_wire_layout_definition_scope_is_exempt(self, lint):
+        diags = lint("""
+            # keplint: layout-definition
+            class WireLayoutV2:
+                def field(self, data, name_len):
+                    return data[8 + 2 * name_len + 4]
+        """, rel="kepler_tpu/fleet/wire.py")
+        assert diags == []
+
     def test_good_layout_definition_scope_is_exempt(self, lint):
         diags = lint("""
             # keplint: layout-definition
