@@ -30,7 +30,7 @@ chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, 
 	$(PYTHON) -m pytest tests/ -q -m chaos
 
 .PHONY: verify
-verify: lint chaos ## the lint surface plus the chaos subset — the PR gate's sibling path
+verify: lint chaos multihost ## the lint surface plus the chaos subset and the multi-host dryrun — the PR gate's sibling path
 
 .PHONY: bench
 bench: ## north-star benchmark; prints one JSON line (BASELINE.json metric)
@@ -47,6 +47,10 @@ dryrun: ## compile-check driver entry points on a virtual 8-device mesh
 .PHONY: multichip
 multichip: ## node-sharded fleet window dryrun on 8 simulated devices (bit-equal vs single-device)
 	$(PYTHON) -c "from __graft_entry__ import dryrun_fleet_sharded; dryrun_fleet_sharded(8)"
+
+.PHONY: multihost
+multihost: ## multi-host fleet window dryrun: virtual 2-host leg (bit-equal, capacity, host-death) + real 2-process leg (skips without the Gloo CPU backend)
+	$(PYTHON) -c "from __graft_entry__ import dryrun_fleet_multihost; dryrun_fleet_multihost(2)"
 
 .PHONY: introspect
 introspect: ## smoke the introspection plane: /debug/window + /debug/fleet on a local aggregator

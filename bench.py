@@ -76,13 +76,13 @@ DETAIL_PATH = os.environ.get("KEPLER_BENCH_DETAIL_PATH",
 # gate booleans surfaced in the headline (when their leg ran)
 GATE_KEYS = ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
              "aggwin_within_budget", "aggwin_pipeline_ok",
-             "aggwin_sharded_ok", "node_scrape_ok", "ingest_ok",
-             "ingest_zero_copy_ok")
+             "aggwin_sharded_ok", "aggwin_multihost_ok",
+             "node_scrape_ok", "ingest_ok", "ingest_zero_copy_ok")
 # an errored leg (subprocess died, no row, timeout) fails these gates
 LEG_ERROR_GATES = {
     "node_scrape_error": ("node_scrape_ok",),
     "aggwin_error": ("aggwin_within_budget", "aggwin_pipeline_ok",
-                     "aggwin_sharded_ok"),
+                     "aggwin_sharded_ok", "aggwin_multihost_ok"),
     "soak_error": ("soak_ok",),
     "ingest_error": ("ingest_ok", "ingest_zero_copy_ok"),
 }
@@ -154,6 +154,17 @@ def evaluate_gates(result: dict, on_tpu: bool) -> tuple[bool, list]:
             f"on {result.get('aggwin_sharded_devices')} devices) or "
             f"bit-inconsistent "
             f"({result.get('aggwin_sharded_bit_consistent')})")
+        failed = True
+    if (result.get("aggwin_multihost_ok") is False
+            and "aggwin_multihost_ok" not in forced):
+        messages.append(
+            f"GATE: multi-host window over "
+            f"{result.get('aggwin_multihost_hosts')} virtual hosts is "
+            f"bit-inconsistent "
+            f"({result.get('aggwin_multihost_bit_consistent')}) or "
+            f"capacity scaled only "
+            f"{result.get('aggwin_multihost_capacity_ratio')}x "
+            f"(gate >= {result.get('aggwin_multihost_capacity_budget')}x)")
         failed = True
     return failed, messages
 

@@ -1,0 +1,181 @@
+"""Shared virtual 2-host harness for the multi-host fleet window.
+
+THE one implementation of the in-process multi-host simulation used by
+the ``make multihost`` dryrun (``__graft_entry__``), the bench
+``multihost_*`` row (``benchmarks/scenarios.py``), and the engine tests
+(``tests/test_multihost_engine.py``): seeded row builders, the
+split-devices virtual topology, the lockstep two-thread window runner,
+and the capacity-row formula. A fix to any of these must change ONE
+place — the bench gate and the dryrun gate measure the same thing by
+construction.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any, Sequence
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ZONES = ("package", "dram")
+PEERS = ("host-a:28283", "host-b:28283")
+
+
+def make_virtual_rows(names: Sequence[str], seq: int, rng: Any,
+                      zones: tuple = ZONES,
+                      w_range: tuple[int, int] = (2, 12),
+                      w_fixed: int | None = None) -> list:
+    """Deterministic seeded RowInputs (alternating ratio/MODE_MODEL).
+
+    ``rng`` is caller-owned so successive windows draw fresh content;
+    ``w_fixed`` pins the workload count (bench), ``w_range`` draws it
+    (dryrun's ragged fleets)."""
+    from kepler_tpu.fleet.window import RowInput
+    from kepler_tpu.parallel.fleet import MODE_MODEL, NodeReport
+
+    rows = []
+    for i, name in enumerate(names):
+        w = w_fixed if w_fixed is not None else int(
+            rng.integers(*w_range))
+        cpu = rng.uniform(0.1, 5.0, w).astype(np.float32)
+        rep = NodeReport(
+            node_name=name,
+            zone_deltas_uj=rng.uniform(1e7, 1e8, len(zones)).astype(
+                np.float32),
+            zone_valid=np.ones(len(zones), bool),
+            usage_ratio=0.6,
+            cpu_deltas=cpu,
+            workload_ids=[f"{name}-w{j}" for j in range(w)],
+            node_cpu_delta=float(cpu.sum()),
+            dt_s=5.0,
+            mode=MODE_MODEL if i % 2 else 0,
+        )
+        rows.append(RowInput(name=name, report=rep, zone_names=zones,
+                             ident=("mh", seq)))
+    return rows
+
+
+def virtual_topology(n_hosts: int = 2,
+                     devices: Sequence[Any] | None = None) -> tuple:
+    """→ (mesh, device_process fn, peers) splitting the devices evenly
+    over ``n_hosts`` virtual processes. Raises when fewer than one
+    device per host is visible."""
+    import jax
+
+    from kepler_tpu.parallel.mesh import make_mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    per = len(devs) // n_hosts
+    if per < 1:
+        raise ValueError(
+            f"{len(devs)} devices cannot span {n_hosts} virtual hosts")
+    devs = devs[:per * n_hosts]
+    mesh = make_mesh([per * n_hosts], ["node"], devices=devs)
+    proc_of = {d: min(k // per, n_hosts - 1)
+               for k, d in enumerate(devs)}
+    peers = [PEERS[p] if p < len(PEERS) else f"host-{p}:28283"
+             for p in range(n_hosts)]
+    return mesh, proc_of.get, peers
+
+
+def build_virtual_hosts(n_hosts: int = 2, timeout: float = 120.0,
+                        devices: Sequence[Any] | None = None,
+                        **engine_kw: Any) -> tuple:
+    """→ (mesh, engines, fabric, ring, device_process): one
+    MultiHostWindowEngine per virtual host over a shared fabric, plus
+    the mesh-derived ingest ring splitting node ownership."""
+    from kepler_tpu.fleet.ring import ring_from_mesh
+    from kepler_tpu.fleet.window import (HostLocalFabric,
+                                         MultiHostWindowEngine)
+
+    mesh, device_process, peers = virtual_topology(n_hosts, devices)
+    fabric = HostLocalFabric(n_hosts, timeout=timeout)
+    engine_kw.setdefault("model_mode", "mlp")
+    engine_kw.setdefault("node_bucket", 8)
+    engine_kw.setdefault("workload_bucket", 16)
+    engines = [MultiHostWindowEngine(mesh, process_index=p,
+                                     device_process=device_process,
+                                     fabric=fabric, **engine_kw)
+               for p in range(n_hosts)]
+    ring = ring_from_mesh(peers,
+                          [device_process(d) for d in mesh.devices.flat])
+    return mesh, engines, fabric, ring, device_process
+
+
+def split_by_ring(ring: Any, names: Sequence[str],
+                  peers: Sequence[str]) -> dict[int, list[str]]:
+    """name → owning virtual host, per the mesh-derived ring
+    (``peers`` in process-index order, as ``virtual_topology`` mints)."""
+    host_of = {peer: p for p, peer in enumerate(peers)}
+    by_host: dict[int, list[str]] = {p: [] for p in range(len(peers))}
+    for name in names:
+        by_host[host_of[ring.owner(name)]].append(name)
+    return by_host
+
+
+def run_hosts(engines: Sequence[Any], rows_by_host: Sequence[list],
+              zones: Any, params: Any, dispatch: bool = True,
+              timeout: float = 600.0) -> list:
+    """Run ONE window on every virtual host concurrently (the fabric
+    barriers demand lockstep). ``zones`` is one tuple for all hosts or
+    a per-host list. → per-host (plan, plane|None); re-raises the
+    first host's error, and a thread surviving its join (a wedged
+    dispatch — the fabric timeout only bounds the rendezvous) raises a
+    clear timeout instead of a confusing unpack failure."""
+    from kepler_tpu.fleet.window import DeviceWindowError
+
+    out: list = [None] * len(engines)
+    errs: list = [None] * len(engines)
+    zones_of = (zones if isinstance(zones, list)
+                else [zones] * len(engines))
+
+    def run(p: int) -> None:
+        try:
+            plan = engines[p].plan_window(rows_by_host[p], zones_of[p],
+                                          params)
+            plane = None
+            if dispatch:
+                plane = plan.fetch(plan.program(*plan.args))
+            out[p] = (plan, plane)
+        except BaseException as e:  # re-raised on the caller thread
+            errs[p] = e
+
+    threads = [threading.Thread(target=run, args=(p,), daemon=True)
+               for p in range(len(engines))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    stuck = [p for p, t in enumerate(threads) if t.is_alive()]
+    if stuck:
+        raise DeviceWindowError(
+            "host_dead",
+            f"virtual host(s) {stuck} still running after {timeout:g}s "
+            "— wedged dispatch or fetch")
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+def capacity_rows(plan: Any, engine: Any) -> int:
+    """Global bucket rows hosted across every host of the mesh (the
+    capacity-scaling metric): per-shard bucket × global shard count."""
+    sb = plan.meta.n_rows // max(1, len(engine._owned_shards))
+    return plan.n_shards * sb
+
+
+def assert_remote_shards_untouched(plan: Any, engine: Any) -> None:
+    """The host-local invariant: zero H2D rows on every shard this
+    virtual host does not own."""
+    owned = set(engine._owned_shards)
+    for k, n in enumerate(plan.h2d_shards):
+        if k not in owned and n:
+            raise AssertionError(
+                f"host uploaded {n} rows to REMOTE shard {k} — the "
+                "host-local invariant is broken")

@@ -241,6 +241,14 @@ AGG_PIPELINE_RATIO_BUDGET = float(os.environ.get(
 # XLA_FLAGS=--xla_force_host_platform_device_count on CPU hosts).
 AGG_SHARDED_RATIO_BUDGET = float(os.environ.get(
     "KEPLER_AGG_SHARDED_RATIO_BUDGET", "0.6"))
+# the ISSUE-15 tentpole gate: node capacity (bucket rows hosted) must
+# scale ≥ this factor from 1 host to 2 virtual hosts of the same
+# per-host device count, with published windows bit-identical to the
+# single-host sharded engine on the same seeded fleet. Virtual-host
+# measurement (in-process HostLocalFabric) — it gates everywhere; the
+# real two-process leg is `make multihost`.
+AGG_MULTIHOST_CAPACITY_BUDGET = float(os.environ.get(
+    "KEPLER_AGG_MULTIHOST_CAPACITY_BUDGET", "1.8"))
 # the ISSUE-14 tentpole gate: wire-v2 delta steady-state decode+merge
 # must be ≥ this multiple of the v1 full-frame path on the same seeded
 # fleet. A same-host ratio of two in-process measurements, so it gates
@@ -587,6 +595,82 @@ def _sharded_window_fields(iters: int, n_nodes: int, w: int,
     }
 
 
+def _multihost_window_fields() -> dict:
+    """The ``multihost_*`` leg (ISSUE 15): two VIRTUAL hosts in this
+    process (half the devices each, wired through a HostLocalFabric —
+    the shared ``benchmarks.multihost_virtual`` harness, same code the
+    ``make multihost`` gate runs) drive the multi-host window engine
+    over a seeded fleet split by the mesh-derived ingest ring; a
+    single-host ShardedWindowEngine on the full device set is the
+    bit-consistency reference, and a half-device single host anchors
+    the capacity ratio. Absent (``{}``) below 4 devices — the
+    field-absence contract means it never gates there."""
+    import jax
+
+    from benchmarks.multihost_virtual import (ZONES, build_virtual_hosts,
+                                              capacity_rows,
+                                              make_virtual_rows,
+                                              run_hosts, split_by_ring)
+    from kepler_tpu.fleet.window import ShardedWindowEngine
+    from kepler_tpu.models import init_mlp
+    from kepler_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        return {}
+    rng = np.random.default_rng(7)
+    n_nodes, w = 64, 16
+    mesh, engines, fabric, ring, _ = build_virtual_hosts(
+        2, timeout=300, workload_bucket=w)
+    devices = list(mesh.devices.flat)
+    per = len(devices) // 2
+    single = ShardedWindowEngine(
+        make_mesh([len(devices)], ["node"], devices=devices),
+        model_mode="mlp", node_bucket=8, workload_bucket=w)
+    half = ShardedWindowEngine(
+        make_mesh([per], ["node"], devices=devices[:per]),
+        model_mode="mlp", node_bucket=8, workload_bucket=w)
+    params = init_mlp(jax.random.PRNGKey(0), n_zones=2)
+    names = [f"mh-{i:03d}" for i in range(n_nodes)]
+    owned = split_by_ring(ring, names, ["host-a:28283",
+                                        "host-b:28283"])
+
+    bit = True
+    for seq in (1, 2):  # full-pack window, then the delta path
+        all_rows = make_virtual_rows(names, seq, rng, w_fixed=w)
+        by_host = [[r for r in all_rows if r.name in set(owned[p])]
+                   for p in (0, 1)]
+        results = run_hosts(engines, by_host, ZONES, params)
+        plan_1 = single.plan_window(all_rows, ZONES, params)
+        ref = plan_1.fetch(plan_1.program(*plan_1.args))
+        for p, (plan, plane) in enumerate(results):
+            for name, li in plan.meta.rows.items():
+                if not np.array_equal(plane[li],
+                                      ref[plan_1.meta.rows[name]],
+                                      equal_nan=True):
+                    bit = False
+    # capacity: same per-host load — the half-device single host gets
+    # half the fleet, the 2-host mesh the whole fleet
+    cap_plan = half.plan_window(
+        make_virtual_rows(names[:n_nodes // 2], 3, rng, w_fixed=w),
+        ZONES, params)
+    cap_1 = cap_plan.meta.n_rows
+    cap_2 = capacity_rows(results[0][0], engines[0])
+    ratio = round(cap_2 / max(1, cap_1), 3)
+    return {
+        "multihost_hosts": 2,
+        "multihost_devices_per_host": per,
+        "multihost_nodes": n_nodes,
+        "multihost_bit_consistent": bit,
+        "multihost_capacity_rows": cap_2,
+        "multihost_singlehost_capacity_rows": cap_1,
+        "multihost_capacity_ratio": ratio,
+        "multihost_capacity_budget": AGG_MULTIHOST_CAPACITY_BUDGET,
+        "multihost_ok": bool(
+            bit and ratio >= AGG_MULTIHOST_CAPACITY_BUDGET),
+    }
+
+
 def run_aggregator_window_scenario(iters: int) -> dict:
     """LIVE Aggregators at the north-star fleet shape (1024 nodes × ~100
     workloads), both window configurations:
@@ -652,6 +736,7 @@ def run_aggregator_window_scenario(iters: int) -> dict:
 
     shard_fields = _sharded_window_fields(iters, n_nodes, w, dev_ms,
                                           host_s, host_last)
+    multihost_fields = _multihost_window_fields()
 
     # introspection evidence (detail row only — headline stays core):
     # compiled window-program cost, sticky-map skew, and ladder-timeline
@@ -700,6 +785,7 @@ def run_aggregator_window_scenario(iters: int) -> dict:
             host_ms[len(host_ms) // 2] <= AGG_HOST_BUDGET_MS
             and _pctl(host_ms, 0.99) <= AGG_HOST_P99_BUDGET_MS),
         **shard_fields,
+        **multihost_fields,
     }
 
 

@@ -277,6 +277,37 @@ DESCRIPTIONS = {
                                             "`Retry-After` — the "
                                             "longest an agent is ever "
                                             "asked to stay away.",
+    "aggregator.multihost.enabled":
+        "Multi-host SPMD fleet window: join a `jax.distributed` cluster "
+        "and run rung 0 over every host's devices — host-local donated "
+        "rings and delta H2D, ONE SPMD dispatch, owned-rows publish "
+        "fetch, and (with `aggregator.peers` set) ingest ownership "
+        "derived from the mesh shard map so each replica ingests "
+        "exactly the agents whose rows live on its local devices.",
+    "aggregator.multihost.coordinator":
+        "`jax.distributed` coordinator address (empty = "
+        "`JAX_COORDINATOR_ADDRESS`, the TPU pod runtime convention).",
+    "aggregator.multihost.num_processes":
+        "Process count of the multi-host job (`-1` = "
+        "`JAX_NUM_PROCESSES`). With `aggregator.peers` set, the peer "
+        "list must carry one endpoint per process in process-index "
+        "order.",
+    "aggregator.multihost.process_id":
+        "This process's id in the multi-host job (`-1` = "
+        "`JAX_PROCESS_ID`).",
+    "aggregator.multihost.init_timeout":
+        "Bound on the coordinator join (duration; `0` = jax's default "
+        "deadline). An unreachable coordinator surfaces as the distinct "
+        "`coordinator_unreachable` failure reason in the log and the "
+        "`fleet-window` health probe — never a generic decline.",
+    "aggregator.multihost.takeover":
+        "On a mesh demotion (\"mesh minus one host\"), bump the ring "
+        "epoch and take over ingest ownership on this survivor — "
+        "displaced agents follow 421s here and replay their spool "
+        "tails. GATED to 2-host meshes (the survivor is unambiguous "
+        "by elimination); on larger meshes the takeover is skipped — "
+        "every survivor claiming the key space would split-brain "
+        "ingest — and rebalancing is an operator `apply_membership`.",
     "aggregator.base_row_cache": "Wire-v2 delta-base LRU size: per-"
                                  "node last-keyframe state the delta "
                                  "frames merge against. Eviction "
@@ -421,6 +452,18 @@ FLAG_OF = {
     "agent.spool.dir": "--agent.spool-dir",
     "agent.wire.version": "--agent.wire-version",
     "aggregator.base_row_cache": "--aggregator.base-row-cache",
+    "aggregator.multihost.enabled":
+        "--aggregator.multihost.enabled / "
+        "--no-aggregator.multihost.enabled",
+    "aggregator.multihost.coordinator": "--aggregator.multihost.coordinator",
+    "aggregator.multihost.num_processes":
+        "--aggregator.multihost.num-processes",
+    "aggregator.multihost.process_id": "--aggregator.multihost.process-id",
+    "aggregator.multihost.init_timeout":
+        "--aggregator.multihost.init-timeout",
+    "aggregator.multihost.takeover":
+        "--aggregator.multihost.takeover / "
+        "--no-aggregator.multihost.takeover",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
     "telemetry.enabled": "--telemetry.enable / --no-telemetry.enable",

@@ -152,15 +152,26 @@ def _build_packed(case: ProgramCase) -> tuple:
 
 
 def _build_window_update(case: ProgramCase) -> tuple:
-    from kepler_tpu.fleet.window import (PackedWindowEngine,
+    from kepler_tpu.fleet.window import (MultiHostWindowEngine,
+                                         PackedWindowEngine,
                                          ShardedWindowEngine)
     from kepler_tpu.parallel.packed import packed_width
 
     d = case.dims
     nb, wb, z, db = d["n"], d["w"], d["z"], d["db"]
     width = packed_width(wb, z)
-    if d.get("sharded"):
-        engine: Any = ShardedWindowEngine(_mesh(8))
+    if d.get("multihost"):
+        # virtual 2-host split over the 8 traced devices: the update is
+        # the HOST-LOCAL donated scatter (identical discipline, owned
+        # shards only) — traced from process 0's perspective
+        mesh = _mesh(8)
+        devs = list(mesh.devices.flat)
+        proc_of = {dev: (0 if k < 4 else 1)
+                   for k, dev in enumerate(devs)}.get
+        engine: Any = MultiHostWindowEngine(mesh, process_index=0,
+                                            device_process=proc_of)
+    elif d.get("sharded"):
+        engine = ShardedWindowEngine(_mesh(8))
     else:
         engine = PackedWindowEngine(_mesh(8))
     fn = engine._update_for(nb, width, db)[0]
@@ -360,6 +371,29 @@ DEVICE_PROGRAMS: tuple[ProgramSpec, ...] = (
         require_shard_map=True,
     ),
     ProgramSpec(
+        name="packed.sparse_local_multihost",
+        source="kepler_tpu/parallel/packed.py",
+        description="the multi-host window's SPMD program: shard_map "
+                    "sparse variant at the GLOBAL-mesh shape two "
+                    "processes' device counts span (2 hosts x 4 "
+                    "devices traced as one 8-shard mesh) — zero "
+                    "collectives pins that the only cross-host traffic "
+                    "in a window is the dispatch itself (ISSUE 15)",
+        build=_build_packed,
+        cases=(
+            ProgramCase("hosts2_n16_w8_z2_m2",
+                        "per-host bucket 2 over 2x4 devices",
+                        dims={"n": 16, "w": 8, "z": 2, "m": 2,
+                              "model_mode": "mlp", "local": 1}),
+            ProgramCase("hosts2_pad_n8_w1_z1_m1", "minimal multi-host "
+                        "rung: one row per shard across both hosts",
+                        dims={"n": 8, "w": 1, "z": 1, "m": 1,
+                              "model_mode": "mlp", "local": 1}),
+        ),
+        allowed_half_casts=_F16_OUT,
+        require_shard_map=True,
+    ),
+    ProgramSpec(
         name="packed.pallas_dense",
         source="kepler_tpu/parallel/packed.py",
         description="packed program with the Mosaic attribution kernel "
@@ -397,6 +431,21 @@ DEVICE_PROGRAMS: tuple[ProgramSpec, ...] = (
             ProgramCase("s2_w8_z2_d2",
                         dims={"n": 2, "w": 8, "z": 2, "db": 2,
                               "sharded": 1}),
+        ),
+        donates=(0,),
+    ),
+    ProgramSpec(
+        name="window.update_multihost",
+        source="kepler_tpu/fleet/window.py",
+        description="host-local donated scatter-update of the "
+                    "multi-host engine (a virtual 2-host topology's "
+                    "process-0 view: same donation discipline, owned "
+                    "shards only)",
+        build=_build_window_update,
+        cases=(
+            ProgramCase("hosts2_s2_w8_z2_d2",
+                        dims={"n": 2, "w": 8, "z": 2, "db": 2,
+                              "multihost": 1}),
         ),
         donates=(0,),
     ),

@@ -44,11 +44,24 @@ def main(argv: Sequence[str] | None = None) -> int:
     from kepler_tpu import fault, telemetry
     fault.install_from_config(cfg.fault)
     telemetry.install_from_config(cfg.telemetry)
-    # multi-host DCN: if JAX_COORDINATOR_ADDRESS is set, join the cluster
-    # BEFORE any jax API initialises the backend (no-op single-host)
+    # multi-host DCN: join the cluster BEFORE any jax API initialises the
+    # backend (no-op single-host). Config knobs take precedence over the
+    # JAX_* env convention; a failed join logs its DISTINCT reason
+    # (coordinator_unreachable vs init_error) and the fleet-window
+    # health probe republishes it, so a half-joined mesh is diagnosable.
     from kepler_tpu.parallel import initialize_multihost
 
-    initialize_multihost()
+    mh = cfg.aggregator.multihost
+    joined = initialize_multihost(
+        coordinator_address=mh.coordinator or None,
+        num_processes=(mh.num_processes
+                       if mh.num_processes != -1 else None),
+        process_id=mh.process_id if mh.process_id != -1 else None,
+        init_timeout=mh.init_timeout or None)
+    if mh.enabled and not joined:
+        log.warning("multihost enabled but not joined (%s)%s — running "
+                    "single-host", joined.reason,
+                    f": {joined.detail}" if joined.detail else "")
     info = version.info()
     log.info("kepler-tpu aggregator %s (%s, %s)", info.version,
              info.python_version, info.platform)
@@ -88,6 +101,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         dispatch_timeout=cfg.aggregator.dispatch_timeout,
         mesh_shape=cfg.aggregator.mesh_shape,
         mesh_axes=cfg.aggregator.mesh_axes,
+        multihost_enabled=cfg.aggregator.multihost.enabled,
+        multihost_takeover=cfg.aggregator.multihost.takeover,
         scoreboard_cap=cfg.aggregator.scoreboard_cap,
         anomaly_z=cfg.aggregator.anomaly_z,
         peers=cfg.aggregator.peers,

@@ -316,6 +316,35 @@ class DevConfig:
 
 
 @dataclass
+class MultihostConfig:
+    """Multi-host SPMD fleet window (``docs/user/fleet.md`` "Multi-host"):
+    N aggregator processes form ONE ``jax.distributed`` job whose mesh
+    spans every host's devices; rung 0 runs the multi-host window engine
+    (host-local rings, one SPMD dispatch) and — with ``aggregator.peers``
+    set — ingest ownership derives from the mesh shard map, so each
+    replica ingests exactly the agents whose packed rows live on its
+    local devices."""
+
+    enabled: bool = False
+    # coordinator endpoint ("" = take JAX_COORDINATOR_ADDRESS from the
+    # env, the TPU pod runtime convention)
+    coordinator: str = ""
+    # process topology (-1 = take JAX_NUM_PROCESSES / JAX_PROCESS_ID
+    # from the env)
+    num_processes: int = -1
+    process_id: int = -1
+    # bound on the coordinator join — an unreachable coordinator is
+    # surfaced as a DISTINCT failure reason (coordinator_unreachable) in
+    # the log, the return, and the fleet-window probe (0 = jax default)
+    init_timeout: float = 0.0
+    # on a mesh demotion ("mesh minus one host"), bump the ring epoch
+    # and take over the whole key space on this survivor — right for
+    # 2-host meshes (the dead peer's agents must land SOMEWHERE);
+    # larger fleets should rebalance via an operator apply_membership
+    takeover: bool = True
+
+
+@dataclass
 class AggregatorConfig:
     """Cluster aggregator role — new in this framework.
 
@@ -404,6 +433,8 @@ class AggregatorConfig:
     # unsharded engine (batch still NamedSharding-sharded)
     mesh_shape: list[int] = field(default_factory=list)
     mesh_axes: list[str] = field(default_factory=lambda: ["node"])
+    # -- multi-host SPMD tier (docs/user/fleet.md "Multi-host") --
+    multihost: MultihostConfig = field(default_factory=MultihostConfig)
     # -- fleet scoreboard (docs/developer/observability.md "Fleet
     # scoreboard"): per-node health table served at /debug/fleet and as
     # kepler_fleet_node_state — LRU-capped (bounds memory AND metric
@@ -584,6 +615,21 @@ class Config:
                         "aggregator.admissionRetryAfter")
         if agg.base_row_cache < 1:
             errs.append("aggregator.baseRowCache must be >= 1")
+        mh = agg.multihost
+        if mh.init_timeout < 0:
+            errs.append("aggregator.multihost.initTimeout must be >= 0 "
+                        "(0 = jax's default join deadline)")
+        if mh.num_processes != -1 and mh.num_processes < 1:
+            errs.append("aggregator.multihost.numProcesses must be >= 1 "
+                        "(or -1 = from JAX_NUM_PROCESSES)")
+        if mh.process_id < -1:
+            errs.append("aggregator.multihost.processId must be >= 0 "
+                        "(or -1 = from JAX_PROCESS_ID)")
+        if (mh.enabled and agg.peers
+                and mh.num_processes not in (-1, len(agg.peers))):
+            errs.append("aggregator.peers must list exactly one replica "
+                        "endpoint per multihost process (in process-"
+                        "index order) when both are configured")
         wire = self.agent.wire
         if wire.version not in (1, 2):
             errs.append("agent.wire.version must be 1 or 2")
@@ -721,6 +767,9 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "ringEpoch": "ring_epoch",
     "ringVnodes": "ring_vnodes",
     "admissionEnabled": "admission_enabled",
+    "numProcesses": "num_processes",
+    "processId": "process_id",
+    "initTimeout": "init_timeout",
     "admissionMaxInflight": "admission_max_inflight",
     "admissionLatencyBudget": "admission_latency_budget",
     "admissionRetryAfter": "admission_retry_after",
@@ -756,7 +805,8 @@ _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "restart_backoff_initial", "restart_backoff_max",
                     "state_max_age", "fsync_interval", "dispatch_timeout",
                     "admission_latency_budget", "admission_retry_after",
-                    "admission_retry_after_max", "retry_after_max"}
+                    "admission_retry_after_max", "retry_after_max",
+                    "init_timeout"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -936,6 +986,37 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
         dest="aggregator_base_row_cache", default=None, type=int,
         help="wire-v2 delta-base LRU size (per-node last keyframes; "
              "eviction costs a 409 needs-keyframe round-trip)")
+    add("--aggregator.multihost.enabled",
+        dest="aggregator_multihost_enabled", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="multi-host SPMD fleet window: join a jax.distributed "
+             "cluster and run rung 0 over every host's devices "
+             "(host-local rings, one SPMD dispatch, mesh-derived "
+             "ingest ownership)")
+    add("--aggregator.multihost.coordinator",
+        dest="aggregator_multihost_coordinator", default=None,
+        help="jax.distributed coordinator address (empty = "
+             "JAX_COORDINATOR_ADDRESS)")
+    add("--aggregator.multihost.num-processes",
+        dest="aggregator_multihost_num_processes", default=None,
+        type=int,
+        help="process count of the multi-host job (-1 = "
+             "JAX_NUM_PROCESSES)")
+    add("--aggregator.multihost.process-id",
+        dest="aggregator_multihost_process_id", default=None, type=int,
+        help="this process's id in the multi-host job (-1 = "
+             "JAX_PROCESS_ID)")
+    add("--aggregator.multihost.init-timeout",
+        dest="aggregator_multihost_init_timeout", default=None,
+        help="bound on the coordinator join, e.g. 60s (0 = jax's "
+             "default); an unreachable coordinator surfaces as the "
+             "distinct coordinator_unreachable failure reason")
+    add("--aggregator.multihost.takeover",
+        dest="aggregator_multihost_takeover", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="on a mesh demotion, bump the ring epoch and take over "
+             "ingest ownership on this survivor (right for 2-host "
+             "meshes)")
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
@@ -1014,6 +1095,20 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
         cfg.agent.wire.version = args.agent_wire_version
     set_if(("aggregator", "base_row_cache"),
            args.aggregator_base_row_cache)
+    mh = cfg.aggregator.multihost
+    if args.aggregator_multihost_enabled is not None:
+        mh.enabled = args.aggregator_multihost_enabled
+    if args.aggregator_multihost_coordinator is not None:
+        mh.coordinator = args.aggregator_multihost_coordinator
+    if args.aggregator_multihost_num_processes is not None:
+        mh.num_processes = args.aggregator_multihost_num_processes
+    if args.aggregator_multihost_process_id is not None:
+        mh.process_id = args.aggregator_multihost_process_id
+    if args.aggregator_multihost_init_timeout is not None:
+        mh.init_timeout = _parse_duration(
+            args.aggregator_multihost_init_timeout)
+    if args.aggregator_multihost_takeover is not None:
+        mh.takeover = args.aggregator_multihost_takeover
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     set_if(("telemetry", "enabled"), args.telemetry_enable)

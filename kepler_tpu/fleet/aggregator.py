@@ -51,7 +51,8 @@ from kepler_tpu.fleet.admission import (
     PRIORITY_REPLAY_GROUND,
     AdmissionController,
 )
-from kepler_tpu.fleet.ring import HashRing, coerce_epoch, sanitize_peer
+from kepler_tpu.fleet.ring import (HashRing, coerce_epoch, ring_from_mesh,
+                                   sanitize_peer)
 from kepler_tpu.fleet.wire import (
     ParsedHeader,
     WireError,
@@ -64,9 +65,11 @@ from kepler_tpu.fleet.wire import (
     try_parse_header,
 )
 from kepler_tpu.fleet.scoreboard import STATE_NAMES, FleetScoreboard
-from kepler_tpu.fleet.window import (DeviceWindowError, PackedWindowEngine,
-                                     RowInput, ShardedWindowEngine,
-                                     WindowMeta, align_zone_matrices)
+from kepler_tpu.fleet.window import (DeviceWindowError,
+                                     MultiHostWindowEngine,
+                                     PackedWindowEngine, RowInput,
+                                     ShardedWindowEngine, WindowMeta,
+                                     align_zone_matrices)
 from kepler_tpu.monitor.history import HistoryBuffer
 from kepler_tpu.telemetry import DEFAULT_DELIVERY_BUCKETS, Histogram
 from kepler_tpu.parallel.aggregator_core import (
@@ -105,6 +108,12 @@ RUNG_NAMES = ("packed-pipelined", "packed-serial", "einsum-serial",
 # mesh (ShardedWindowEngine): a single shard's device failure demotes
 # to the single-device rungs above, so only rung 0 has a sharded form
 RUNG_NAME_SHARDED = "packed-sharded-pipelined"
+# rung 0's names on a multi-host mesh (MultiHostWindowEngine): healthy,
+# and after the "mesh minus one host" demotion (the surviving process's
+# own single-host sharded engine — sticky for the process lifetime, a
+# dead jax.distributed peer cannot rejoin a running job)
+RUNG_NAME_MULTIHOST = "packed-multihost-pipelined"
+RUNG_NAME_MESH_DEGRADED = "packed-sharded-mesh-minus-host"
 
 # per-mode checkpoint layout: required keys, and which key's last axis is
 # the zone count Z. Temporal params serve through the dedicated history
@@ -203,6 +212,10 @@ class _Pending:
     # dispatching engine was unsharded; legacy/numpy paths leave 1)
     h2d_shards: tuple = ()
     shards: int = 1
+    # publish-fetch override from the dispatching engine's plan:
+    # per-shard addressable fetch (owned shards only on the multi-host
+    # engine). None = np.asarray of the whole output.
+    fetch: Callable | None = None
     # legacy path extras (training dump + dense scatter)
     batch: object = None
     aligned: list | None = None
@@ -436,6 +449,9 @@ class Aggregator:
         dispatch_timeout: float = 30.0,
         mesh_shape: Sequence[int] | None = None,
         mesh_axes: Sequence[str] | None = None,
+        multihost_enabled: bool = False,
+        multihost_takeover: bool = True,
+        multihost_topology: Mapping[str, Any] | None = None,
         scoreboard_cap: int = 1024,
         anomaly_z: float = 4.0,
         peers: Sequence[str] | None = None,
@@ -470,6 +486,22 @@ class Aggregator:
         # — the sharded production shape)
         self._mesh_shape = list(mesh_shape or [])
         self._mesh_axes = list(mesh_axes or [])
+        # -- multi-host SPMD tier (ISSUE 15): with multihost enabled and
+        # a mesh spanning > 1 process, rung 0 runs the
+        # MultiHostWindowEngine (host-local rings + one SPMD dispatch)
+        # and ingest ownership derives from the mesh shard map
+        # (ring_from_mesh). A cross-host failure demotes STICKY to the
+        # surviving single-host engine ("mesh minus one host" — a dead
+        # jax.distributed peer cannot rejoin a running job), bumping the
+        # ring epoch so displaced agents follow 421s to the new owner.
+        self._multihost_enabled = bool(multihost_enabled)
+        self._multihost_takeover = bool(multihost_takeover)
+        topo = dict(multihost_topology or {})
+        self._mh_process_index: int | None = topo.get("process_index")
+        self._mh_device_process = topo.get("device_process")
+        self._mh_fabric = topo.get("fabric")
+        self._mesh_degraded = False  # keplint: guarded-by=_results_lock
+        self._engine_mesh: Any = None  # mesh the packed engines run on
         # temporal mode: per-node feature-history ring buffers, fed on
         # report receipt so the window advances at each node's own cadence.
         # Each node's buffer carries its OWN lock: ingest for node A never
@@ -554,6 +586,10 @@ class Aggregator:
         self._ring: HashRing | None = None
         self._self_peer = str(self_peer or "")
         self._ring_vnodes = max(1, int(ring_vnodes))
+        # config-ORDER peer list (HashRing sorts; the mesh ring needs
+        # process-index order: peers[p] = process p's endpoint)
+        self._config_peers = list(peers or [])
+        self._ring_epoch_cfg = max(1, int(ring_epoch))
         if peers:
             if not self._self_peer:
                 raise ValueError(
@@ -606,6 +642,9 @@ class Aggregator:
                        # pipelined-window legs + delta-H2D accounting
                        "last_dispatch_ms": 0.0,
                        "last_wait_ms": 0.0,
+                       # publish-fetch leg alone (per-shard addressable
+                       # D2H materialization inside the pipeline wait)
+                       "last_fetch_ms": 0.0,
                        "last_h2d_rows": 0,
                        # sharded window: device shards the last window ran
                        # over (1 = unsharded engine or demoted rung) and
@@ -708,6 +747,39 @@ class Aggregator:
         if self._node_bucket % n_dev:
             self._node_bucket = ((self._node_bucket // n_dev) + 1) * n_dev
         self._shard_count = self._mesh_shard_count()
+        if self._ring is not None and self._multihost_active():
+            # co-locate ingest with compute (ISSUE 15): ownership derives
+            # from the mesh shard map — each replica ingests exactly the
+            # agents whose packed rows live on its local devices.
+            # aggregator.peers is ordered by jax process index here.
+            proc = self._device_process_fn()
+            shard_procs = [proc(d) for d in self._mesh.devices.flat]
+            n_hosts = len(set(shard_procs))
+            if len(self._config_peers) != n_hosts:
+                raise ValueError(
+                    f"aggregator.peers has {len(self._config_peers)} "
+                    f"entries but the multi-host mesh spans {n_hosts} "
+                    "processes — one peer endpoint per process, in "
+                    "process-index order")
+            me = self._self_process()
+            if (0 <= me < len(self._config_peers)
+                    and self._config_peers[me] != self._self_peer):
+                # a misordered list would silently INVERT ownership:
+                # every replica ingesting exactly the OTHER host's
+                # agents — fail loudly instead
+                raise ValueError(
+                    f"aggregator.peers[{me}] is "
+                    f"{self._config_peers[me]!r} but this replica "
+                    f"(process {me}) is aggregator.selfPeer "
+                    f"{self._self_peer!r} — the list must be ordered "
+                    "by jax process index")
+            self._ring = ring_from_mesh(self._config_peers, shard_procs,
+                                        epoch=self._ring_epoch_cfg)
+            log.info("ingest ring derived from the mesh shard map: "
+                     "%d shards over %d hosts, epoch %d, self owns "
+                     "%.3f of the shard space", self._ring.n_shards,
+                     n_hosts, self._ring.epoch,
+                     self._ring.ownership_ratio(self._self_peer))
         if self._model_mode:
             if self._model_mode != "temporal":
                 from kepler_tpu.models.estimator import predictor
@@ -762,7 +834,7 @@ class Aggregator:
                  dict(self._mesh.shape), n_dev, self._model_mode,
                  self._interval)
 
-    def _mesh_shard_count(self) -> int:
+    def _mesh_shard_count(self, mesh: Any = None) -> int:
         """Shards the packed window runs over: the node-axis size when
         the mesh is 1-D over ``node`` (every device an independent
         shard with its own resident ring). Single-device and 2-D
@@ -770,13 +842,98 @@ class Aggregator:
         still shards via NamedSharding, but H2D stays whole-batch."""
         from kepler_tpu.parallel.mesh import NODE_AXIS
 
-        mesh = self._mesh
+        mesh = mesh if mesh is not None else self._mesh
         if mesh is None:
             return 1
         n_dev = mesh.devices.size
         if n_dev > 1 and dict(mesh.shape).get(NODE_AXIS, 0) == n_dev:
             return n_dev
         return 1
+
+    # -- multi-host topology -----------------------------------------------
+
+    def _device_process_fn(self) -> Callable[[Any], int]:
+        if self._mh_device_process is not None:
+            return self._mh_device_process
+        return lambda d: int(getattr(d, "process_index", 0))
+
+    def _self_process(self) -> int:
+        if self._mh_process_index is not None:
+            return int(self._mh_process_index)
+        import jax
+
+        return int(jax.process_index())
+
+    def _multihost_active(self) -> bool:
+        """True when rung 0 should run the multi-host engine: multihost
+        enabled, a 1-D node mesh, and devices spanning > 1 process
+        (real ``jax.distributed`` processes, or the injected virtual
+        topology the tests/bench drive in one process)."""
+        if not self._multihost_enabled or self._mesh is None:
+            return False
+        from kepler_tpu.parallel.mesh import NODE_AXIS
+
+        mesh = self._mesh
+        n_dev = mesh.devices.size
+        if n_dev < 2 or dict(mesh.shape).get(NODE_AXIS, 0) != n_dev:
+            return False
+        proc = self._device_process_fn()
+        return len({proc(d) for d in mesh.devices.flat}) > 1
+
+    def _local_mesh(self) -> Any:
+        """The surviving single-host mesh after a mesh demotion: this
+        process's own devices, 1-D over node."""
+        proc = self._device_process_fn()
+        me = self._self_process()
+        devs = [d for d in self._mesh.devices.flat if proc(d) == me]
+        return make_mesh([len(devs)], devices=devs)
+
+    def _multihost_host_count(self) -> int:
+        if self._mesh is None:
+            return 1
+        proc = self._device_process_fn()
+        return len({proc(d) for d in self._mesh.devices.flat})
+
+    def _demote_mesh(self, reason: str) -> None:
+        """The "mesh minus one host" rung: a cross-host window failure
+        (dead peer, broken collective, fabric loss) permanently retires
+        the multi-host engine in this process — a dead
+        ``jax.distributed`` peer cannot rejoin a running job, so unlike
+        the single-host ladder this demotion never re-promotes. The
+        survivors' rung 0 becomes their own single-host sharded engine
+        (full ring re-seed via the engine rebuild), and with the ingest
+        ring enabled the membership epoch bumps so displaced agents
+        follow 421s to the new owner and replay their spool tails —
+        the existing hand-off machinery, zero windows lost.
+
+        The automatic TAKEOVER (this survivor claims the whole key
+        space) runs only on a 2-HOST mesh, where the survivor is
+        unambiguous by elimination. On larger meshes every survivor
+        sees the same cross-host failure — N replicas each claiming
+        100% at the same epoch would split-brain ingest (double
+        attribution, conflicting 421 owners), so rebalancing is left
+        to the operator's ``apply_membership``."""
+        self._engine = None  # next window rebuilds over the local mesh
+        self._engine_serial = None  # its pinned device must be LOCAL
+        log.error("multi-host mesh degraded (%s): demoting to the "
+                  "single-host engine over this process's devices; "
+                  "displaced agents will be redirected by epoch bump",
+                  reason)
+        if self._ring is None or not self._multihost_takeover:
+            return
+        if self._multihost_host_count() != 2:
+            log.error(
+                "mesh-demotion ring takeover SKIPPED: %d-host mesh — "
+                "every survivor would claim the whole key space "
+                "(split-brain); rebalance the surviving peers via an "
+                "operator apply_membership",
+                self._multihost_host_count())
+            return
+        try:
+            self.apply_membership([self._self_peer],
+                                  self._ring.epoch + 1)
+        except ValueError as err:
+            log.error("mesh-demotion ring takeover failed: %s", err)
 
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
@@ -1462,10 +1619,16 @@ class Aggregator:
         return out
 
     def _rung_display(self, rung: int) -> str:
-        """Operator-facing rung name: rung 0 reads as its sharded form
-        on a multi-device node mesh (only rung 0 has one)."""
-        if rung == RUNG_PIPELINED and self._shard_count > 1:
-            return RUNG_NAME_SHARDED
+        """Operator-facing rung name: rung 0 reads as its multi-host or
+        sharded form on a multi-device node mesh (only rung 0 has
+        one), and as the "mesh minus one host" tier after a mesh
+        demotion."""
+        if rung == RUNG_PIPELINED:
+            if self._multihost_active():
+                return (RUNG_NAME_MESH_DEGRADED if self._mesh_degraded
+                        else RUNG_NAME_MULTIHOST)
+            if self._shard_count > 1:
+                return RUNG_NAME_SHARDED
         return RUNG_NAMES[rung]
 
     def window_health(self) -> dict:
@@ -1495,22 +1658,43 @@ class Aggregator:
             }
             if self._last_window_failure:
                 out["last_failure"] = self._last_window_failure
+            if self._multihost_enabled:
+                from kepler_tpu.parallel.mesh import multihost_status
+
+                init = multihost_status()
+                # a degraded mesh is NOT ok — the probe names the tier
+                # so a half-joined or half-dead mesh is diagnosable
+                out["multihost"] = {
+                    "active": self._multihost_active(),
+                    "mesh_degraded": self._mesh_degraded,
+                    "init_joined": bool(init.joined),
+                    # the DISTINCT init failure reason (joined |
+                    # unconfigured | coordinator_unreachable |
+                    # init_error) — never a generic decline
+                    "init_reason": init.reason,
+                }
+                if init.detail:
+                    out["multihost"]["init_detail"] = init.detail
+                if self._mesh_degraded:
+                    out["ok"] = False
         return out
 
     # -- degradation ladder ------------------------------------------------
 
     # keplint: requires-lock=_results_lock
     def _record_rung_transition_locked(self, prev: int, rung: int,
-                                       reason: str) -> None:
+                                       reason: str,
+                                       from_name: str = "") -> None:
         """Append one ladder transition to the bounded rung timeline
         (the flight recorder's demote/re-promote history). Monotonic
         time orders transitions across wall-clock steps; wall time
-        anchors them for humans."""
+        anchors them for humans. ``from_name`` overrides the from-rung
+        display for the mesh demotion, whose from/to share rung 0."""
         self._rung_timeline.append({
             "rung": rung,
             "rung_name": self._rung_display(rung),
             "from_rung": prev,
-            "from_rung_name": self._rung_display(prev),
+            "from_rung_name": from_name or self._rung_display(prev),
             "reason": reason,
             "wall_time": self._clock(),
             "monotonic_s": _time.monotonic(),
@@ -1538,10 +1722,23 @@ class Aggregator:
         if self._engine_serial is not None:
             self._engine_serial.reset()
         self._program = None  # a failed serial program recompiles fresh
+        # a failure at the MULTI-HOST rung demotes to "mesh minus one
+        # host" first: rung 0 is kept, but its engine becomes the
+        # surviving single-host sharded engine — the next failure (a
+        # genuinely dead local device) walks the ordinary ladder
+        mesh_demotion = (self._multihost_active()
+                         and not self._mesh_degraded
+                         and self._rung == RUNG_PIPELINED)
         with self._results_lock:
             prev = self._rung
-            self._rung = min(prev + 1, RUNG_NUMPY)
-            rung = self._rung
+            from_name = ""
+            if mesh_demotion:
+                from_name = self._rung_display(prev)  # before the flag
+                self._mesh_degraded = True
+                rung = prev  # rung 0 stays; its engine changes tier
+            else:
+                self._rung = min(prev + 1, RUNG_NUMPY)
+                rung = self._rung
             self._clean_windows = 0
             self._windows_since_failure = 0
             if self._just_promoted:
@@ -1555,7 +1752,10 @@ class Aggregator:
             self._stats["window_demotions_total"] += 1
             self._stats["window_rung"] = rung
             self._last_window_failure = f"{reason}: {err}"[:240]
-            self._record_rung_transition_locked(prev, rung, reason)
+            self._record_rung_transition_locked(prev, rung, reason,
+                                                from_name=from_name)
+        if mesh_demotion:
+            self._demote_mesh(reason)
         log.error("fleet window device leg failed (%s) at rung %s; "
                   "demoting to %s, %d in-flight window(s) abandoned, "
                   "resident ring re-seeded: %s", reason,
@@ -1767,21 +1967,38 @@ class Aggregator:
         and the ladder walks on to einsum and then the device-free NumPy
         rung; every interval still publishes.)"""
         if self._engine is None:
-            self._shard_count = self._mesh_shard_count()
-            cls = (ShardedWindowEngine if self._shard_count > 1
-                   else PackedWindowEngine)
-            self._engine = cls(
-                self._mesh, backend=self._backend,
-                model_mode=self._model_mode,
+            kwargs = dict(
+                backend=self._backend, model_mode=self._model_mode,
                 node_bucket=self._node_bucket,
                 workload_bucket=self._workload_bucket,
                 shrink_after=self._bucket_shrink_after,
                 staging_slots=self._pipeline_depth + 1)
+            if self._multihost_active() and not self._mesh_degraded:
+                # the multi-host tier: host-local rings over the GLOBAL
+                # mesh, one SPMD dispatch, owned-rows publish fetch
+                self._engine_mesh = self._mesh
+                self._shard_count = self._mesh.devices.size
+                self._engine = MultiHostWindowEngine(
+                    self._mesh,
+                    process_index=self._mh_process_index,
+                    device_process=self._mh_device_process,
+                    fabric=self._mh_fabric, **kwargs)
+            else:
+                mesh = self._mesh
+                if self._multihost_enabled and self._mesh_degraded:
+                    # "mesh minus one host": the survivors' own devices
+                    mesh = self._local_mesh()
+                self._engine_mesh = mesh
+                self._shard_count = self._mesh_shard_count(mesh)
+                cls = (ShardedWindowEngine if self._shard_count > 1
+                       else PackedWindowEngine)
+                self._engine = cls(mesh, **kwargs)
         if rung == RUNG_PIPELINED or self._shard_count == 1:
             return self._engine
         if self._engine_serial is None:
+            base = self._engine_mesh or self._mesh
             self._engine_serial = PackedWindowEngine(
-                make_mesh([1], devices=[self._mesh.devices.flat[0]]),
+                make_mesh([1], devices=[base.devices.flat[0]]),
                 backend=self._backend, model_mode=self._model_mode,
                 node_bucket=self._node_bucket,
                 workload_bucket=self._workload_bucket,
@@ -1833,7 +2050,8 @@ class Aggregator:
             assembly_ms=(t_planned - t_win) * 1e3,
             dispatch_ms=(t_dispatched - t_planned) * 1e3,
             h2d_rows=plan.h2d_rows, compiled=plan.cold,
-            h2d_shards=plan.h2d_shards, shards=plan.n_shards)
+            h2d_shards=plan.h2d_shards, shards=plan.n_shards,
+            fetch=plan.fetch)
 
     def _dispatch_legacy(self, stored_sorted: list, zone_names: list[str],
                          now: float, t_win: float) -> _Pending:
@@ -1961,9 +2179,24 @@ class Aggregator:
         interleaving publishes (out-of-order ``_results``) with the
         aggregation loop's own."""
         t0 = _time.perf_counter()
+        fetch_ms = 0.0
         if p.kind == "packed":
+            # the engine's plan may override the fetch (per-shard
+            # addressable materialization; owned shards only on the
+            # multi-host engine — publish cost scales with owned rows)
+            fetch_fn = p.fetch or np.asarray
+
+            def _materialize() -> np.ndarray:
+                with telemetry.span("window.publish_fetch"):
+                    t_f = _time.perf_counter()
+                    plane = fetch_fn(p.out)
+                    nonlocal_box[0] = (_time.perf_counter() - t_f) * 1e3
+                return plane
+
+            nonlocal_box = [0.0]
             with telemetry.span("window.pipeline_wait"):
-                packed = self._fetch_device(lambda: np.asarray(p.out))
+                packed = self._fetch_device(_materialize)
+            fetch_ms = nonlocal_box[0]
             t_fetched = _time.perf_counter()
             results = self._scatter_packed(p, packed)
         elif p.kind == "numpy":
@@ -1997,6 +2230,7 @@ class Aggregator:
             self._stats["last_assembly_ms"] = p.assembly_ms
             self._stats["last_dispatch_ms"] = p.dispatch_ms
             self._stats["last_wait_ms"] = wait_ms
+            self._stats["last_fetch_ms"] = fetch_ms
             self._stats["last_device_ms"] = p.dispatch_ms + wait_ms
             self._stats["last_scatter_ms"] = scatter_ms
             self._stats["last_attribution_ms"] = (
@@ -2336,7 +2570,7 @@ class Aggregator:
                 "engines": self._introspect_cache,
                 "stats": {k: self._stats[k] for k in (
                     "last_assembly_ms", "last_dispatch_ms",
-                    "last_wait_ms", "last_scatter_ms",
+                    "last_wait_ms", "last_fetch_ms", "last_scatter_ms",
                     "last_attribution_ms", "last_h2d_rows",
                     "last_h2d_shards", "window_shards", "shard_skew",
                     "window_compiles_total", "window_rung",
@@ -2435,6 +2669,14 @@ class Aggregator:
             "— 0 when the resident device batch was already current")
         h2d_rows.add_metric([], stats["last_h2d_rows"])
         yield h2d_rows
+        fetch_ms = GaugeMetricFamily(
+            "kepler_fleet_window_fetch_ms",
+            "Publish-fetch leg of the last fleet window: per-shard "
+            "addressable D2H materialization of the result plane "
+            "(owned shards only on the multi-host engine, so the cost "
+            "scales with owned rows, not fleet size)")
+        fetch_ms.add_metric([], stats["last_fetch_ms"])
+        yield fetch_ms
         shards = GaugeMetricFamily(
             "kepler_fleet_window_shards",
             "Device shards the last fleet window ran over (node-axis "

@@ -42,8 +42,8 @@ import bisect
 import hashlib
 from typing import Iterable, Sequence
 
-__all__ = ["HashRing", "RingError", "MAX_PEER_NAME", "sanitize_peer",
-           "coerce_epoch"]
+__all__ = ["HashRing", "MeshRing", "RingError", "MAX_PEER_NAME",
+           "ring_from_mesh", "sanitize_peer", "coerce_epoch"]
 
 # peer names become redirect payloads, log fields, and /debug/ring
 # entries; the cap bounds every store keyed on them (the node-name
@@ -198,3 +198,90 @@ class HashRing:
             "ownership_ratio": (round(self.ownership_ratio(self_peer), 6)
                                 if self_peer else None),
         }
+
+
+class MeshRing(HashRing):
+    """Ingest ring whose ownership is DERIVED from the device mesh's
+    shard map — the multi-host co-location contract (ISSUE 15): a node
+    hashes to a global mesh shard (``blake2b(node) % n_shards``), and
+    its owner is the peer of the PROCESS whose local devices host that
+    shard. Each host's aggregator replica therefore ingests exactly the
+    agents whose packed rows live on its local devices — wire-v2
+    zero-copy decode lands in host-local staging with zero cross-host
+    bytes on the ingest path.
+
+    Deterministic across processes for the same (peers-by-process,
+    shard→process, epoch) inputs, like the vnode ring. ``with_members``
+    intentionally DEGRADES to a plain :class:`HashRing`: a membership
+    change away from the mesh map (host death, operator rebalance) is
+    exactly the moment mesh-derived ownership stops being true.
+    """
+
+    __slots__ = ("_shard_owner", "_n_shards")
+
+    def __init__(self, peers_by_process: Sequence[str],
+                 shard_processes: Sequence[int], epoch: int = 1) -> None:
+        if not shard_processes:
+            raise RingError("mesh ring needs at least one shard")
+        if any(not isinstance(p, int) or isinstance(p, bool)
+               or not 0 <= p < len(peers_by_process)
+               for p in shard_processes):
+            raise RingError(
+                f"shard process ids must index peers_by_process "
+                f"(0..{len(peers_by_process) - 1}); got "
+                f"{list(shard_processes)!r}")
+        # the vnode point set is unused for ownership but kept valid so
+        # every HashRing surface (peers, describe, epoch checks) holds
+        super().__init__(peers_by_process, epoch=epoch, vnodes=1)
+        cleaned = [sanitize_peer(p) for p in peers_by_process]
+        self._shard_owner = tuple(cleaned[p] for p in shard_processes)
+        self._n_shards = len(shard_processes)
+
+    @property
+    def n_shards(self) -> int:
+        return self._n_shards
+
+    def shard_of(self, key: str) -> int:
+        """The global mesh shard ``key``'s packed row hashes to."""
+        return _point(key) % self._n_shards
+
+    def owner(self, key: str) -> str:
+        return self._shard_owner[self.shard_of(key)]
+
+    def ownership_ratio(self, peer: str) -> float:
+        if peer not in self._peers:
+            return 0.0
+        owned = sum(1 for o in self._shard_owner if o == peer)
+        return owned / self._n_shards
+
+    def with_members(self, peers: Sequence[str], epoch: int) -> HashRing:
+        """Membership change → a PLAIN consistent-hash ring over the
+        survivors (the mesh map no longer describes reality once a host
+        left it). Epoch must advance, as on the base ring."""
+        if coerce_epoch(epoch) is None or epoch <= self.epoch:
+            raise RingError(
+                f"membership epoch must increase past {self.epoch}, "
+                f"got {epoch!r}")
+        return HashRing(peers, epoch=epoch, vnodes=DEFAULT_VNODES)
+
+    def describe(self, self_peer: str = "") -> dict:
+        out = super().describe(self_peer)
+        out["mesh_derived"] = True
+        out["n_shards"] = self._n_shards
+        return out
+
+
+def ring_from_mesh(peers_by_process: Sequence[str],
+                   shard_processes: Sequence[int],
+                   epoch: int = 1) -> MeshRing:
+    """Build the mesh-co-located ingest ring (ISSUE 15).
+
+    ``peers_by_process[p]`` is process ``p``'s dialable replica endpoint
+    (``aggregator.peers`` ordered by ``jax.process_index``);
+    ``shard_processes[k]`` is the process whose local device hosts
+    global mesh shard ``k`` (``[d.process_index for d in
+    mesh.devices.flat]`` on the 1-D node mesh). Every process builds the
+    identical ring with no coordination — the same determinism contract
+    as :class:`HashRing`, with the shard map as the hash space.
+    """
+    return MeshRing(peers_by_process, shard_processes, epoch=epoch)

@@ -36,6 +36,7 @@ depth-2 pipeline lives in ``fleet.aggregator``).
 from __future__ import annotations
 
 import logging
+import threading
 from dataclasses import dataclass
 from typing import Any, Callable, NamedTuple, Sequence
 
@@ -50,6 +51,8 @@ log = logging.getLogger("kepler.fleet.window")
 __all__ = [
     "BucketLadder",
     "DeviceWindowError",
+    "HostLocalFabric",
+    "MultiHostWindowEngine",
     "PackedWindowEngine",
     "RowInput",
     "ShardedWindowEngine",
@@ -164,6 +167,12 @@ class WindowPlan:
     # the shard count — (h2d_rows,) / 1 on the single-device engine
     h2d_shards: tuple[int, ...] = ()
     n_shards: int = 1
+    # publish-fetch override: fetches the dispatched output as a host
+    # plane whose row layout matches ``meta`` (per-shard addressable
+    # fetch on the sharded engines; owned shards only on the multi-host
+    # engine, so publish cost scales with owned rows). None = plain
+    # ``np.asarray`` of the whole output.
+    fetch: Callable[[Any], np.ndarray] | None = None
 
 
 def align_zone_matrices(reports: Sequence[NodeReport],
@@ -811,6 +820,11 @@ class ShardedWindowEngine(PackedWindowEngine):
 
         self.n_shards = n_dev
         self._devices = list(mesh.devices.flat)
+        # shards THIS engine stages/uploads to: every shard on the
+        # single-process engine; the multi-host subclass narrows it to
+        # the shards committed to this process's local devices (remote
+        # shards' buffers stay None — never packed, never uploaded)
+        self._owned_shards: list[int] = list(range(n_dev))
         # the node ladder sizes the PER-SHARD bucket here (global rows =
         # n_shards × bucket, evenly shardable by construction)
         self._ladder_n = BucketLadder(max(1, node_bucket // n_dev),
@@ -864,6 +878,20 @@ class ShardedWindowEngine(PackedWindowEngine):
 
     # -- window planning ---------------------------------------------------
 
+    # -- cross-host agreement hooks (identity on one process) --------------
+
+    def _agree_window_needs(self, need_s: int, need_w: int,
+                            zones_t: tuple[str, ...]) -> tuple[int, int]:
+        """Agree the per-shard and workload bucket NEEDS across every
+        process before fitting the ladders: the SPMD program's shapes
+        must match on all hosts or the dispatch deadlocks. One process =
+        nothing to agree."""
+        return need_s, need_w
+
+    def _agree_model_need(self, need_m: int) -> int:
+        """Agree the sparse model-bucket need (same contract)."""
+        return need_m
+
     def plan_window(self, rows: Sequence[RowInput],
                     zone_names: Sequence[str], params: Any) -> WindowPlan:
         self._window_seq += 1
@@ -872,7 +900,6 @@ class ShardedWindowEngine(PackedWindowEngine):
         k_sh = self.n_shards
         need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
         prev_sb, prev_wb = self._ladder_n.bucket, self._ladder_w.bucket
-        wb = self._ladder_w.fit(need_w)
 
         overflow = False
         if self._buffers:
@@ -919,12 +946,16 @@ class ShardedWindowEngine(PackedWindowEngine):
                     model_load[k] += 1
                 self._shard_of[r.name] = k
         if overflow or not self._buffers:
-            need_s = -(-len(rows) // k_sh)  # ceil: rebalanced occupancy
+            # ceil over the shards THIS process stages (rebalanced
+            # occupancy; every shard on the single-process engine)
+            need_s = -(-len(rows) // max(1, len(self._owned_shards)))
         else:
             occupancy = [0] * k_sh
             for k in self._shard_of.values():
                 occupancy[k] += 1
             need_s = max(1, max(occupancy, default=1))
+        need_s, need_w = self._agree_window_needs(need_s, need_w, zones_t)
+        wb = self._ladder_w.fit(need_w)
         sb = self._ladder_n.fit(need_s)
         if self._buffers and (sb > prev_sb or wb > prev_wb):
             if fault.fire("device.oom_on_grow") is not None:
@@ -940,22 +971,8 @@ class ShardedWindowEngine(PackedWindowEngine):
             h2d_shards = self._delta_sync_shards(rows, zones_t)
         self._buf_served[self._buf_i] = self._window_seq
         nb = k_sh * sb
-        meta = WindowMeta(
-            zones=list(zones_t),
-            names=[r.name for r in rows],
-            rows=dict(self._row_of),
-            mode=np.asarray(self._mode, np.int32),
-            dt=np.asarray(self._dt, np.float32),
-            counts=list(self._counts),
-            ids=list(self._ids),
-            kinds=list(self._kinds),
-            n_live=len(rows),
-            n_rows=nb,
-        )
-        jax = self._jax
-        resident = jax.make_array_from_single_device_arrays(
-            (nb, self._width), self._sh_batch,
-            list(self._buffers[self._buf_i]))
+        meta = self._build_meta(rows, zones_t, sb)
+        resident = self._assemble_resident(nb)
         args: tuple
         mb: int | None = None
         if self._sparse:
@@ -963,15 +980,14 @@ class ShardedWindowEngine(PackedWindowEngine):
             local_rows = [np.flatnonzero(
                 mode_arr[k * sb:(k + 1) * sb] == MODE_MODEL)
                 for k in range(k_sh)]
-            mb = self._ladder_m.fit(
-                max(1, max(len(lk) for lk in local_rows)))
+            mb = self._ladder_m.fit(self._agree_model_need(
+                max(1, max(len(lk) for lk in local_rows))))
             # shard-local indices, one mb-sized segment per shard; pad sb
             # is past the shard's rows → gather-clamped, scatter-dropped
             idx = np.full(k_sh * mb, sb, np.int32)
             for k, lk in enumerate(local_rows):
                 idx[k * mb:k * mb + len(lk)] = lk
-            args = (params, resident,
-                    jax.device_put(idx, self._sh_rows))
+            args = (params, resident, self._put_model_rows(idx, mb))
         else:
             args = (params, resident)
         entry = self._program_for(nb, wb, z, mb)
@@ -982,7 +998,54 @@ class ShardedWindowEngine(PackedWindowEngine):
         return WindowPlan(program=program, args=args, cold=cold, meta=meta,
                           h2d_rows=sum(h2d_shards),
                           h2d_shards=tuple(h2d_shards),
-                          n_shards=k_sh)
+                          n_shards=k_sh, fetch=self._fetch_plane)
+
+    def _build_meta(self, rows: Sequence[RowInput],
+                    zones_t: tuple[str, ...], sb: int) -> WindowMeta:
+        """Per-window row-layout snapshot. Row indices are GLOBAL here;
+        the multi-host subclass re-indexes into the LOCAL result plane
+        (the only rows its publish fetch materializes)."""
+        return WindowMeta(
+            zones=list(zones_t),
+            names=[r.name for r in rows],
+            rows=dict(self._row_of),
+            mode=np.asarray(self._mode, np.int32),
+            dt=np.asarray(self._dt, np.float32),
+            counts=list(self._counts),
+            ids=list(self._ids),
+            kinds=list(self._kinds),
+            n_live=len(rows),
+            n_rows=self.n_shards * sb,
+        )
+
+    def _assemble_resident(self, nb: int) -> Any:
+        """Zero-copy global view over the per-shard device buffers
+        (every buffer is already committed to its shard's device; the
+        multi-host subclass passes only its ADDRESSABLE shards plus the
+        global sharding — jax's multi-controller assembly contract)."""
+        jax = self._jax
+        arrays = [b for b in self._buffers[self._buf_i] if b is not None]
+        return jax.make_array_from_single_device_arrays(
+            (nb, self._width), self._sh_batch, arrays)
+
+    def _put_model_rows(self, idx: np.ndarray, mb: int) -> Any:
+        """Commit the shard-local sparse index vector onto the mesh."""
+        return self._jax.device_put(idx, self._sh_rows)
+
+    def _fetch_plane(self, out: Any) -> np.ndarray:
+        """Publish fetch: materialize the dispatched output per ADDRESSABLE
+        shard (each shard's D2H was already queued by
+        ``copy_to_host_async``, so the per-shard ``np.asarray`` calls
+        drain transfers that ran concurrently) and concatenate in global
+        row order — never one monolithic device fetch of the assembled
+        array. The multi-host subclass additionally narrows this to the
+        shards it OWNS, so publish cost scales with owned rows, not
+        fleet size."""
+        shards = getattr(out, "addressable_shards", None)
+        if not shards or len(shards) <= 1:
+            return np.asarray(out)
+        parts = sorted(shards, key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in parts], axis=0)
 
     # -- resident maintenance ----------------------------------------------
 
@@ -1014,11 +1077,23 @@ class ShardedWindowEngine(PackedWindowEngine):
         self._counts = [0] * (k_sh * sb)
         self._ids = [[] for _ in range(k_sh * sb)]
         self._kinds = [None] * (k_sh * sb)
-        shard_packed: list[np.ndarray] = []
+        # deal members round-robin over the shards THIS process stages
+        # (all of them single-process; the local subset multi-host)
+        owned = list(self._owned_shards)
+        pos_of = {k: pos for pos, k in enumerate(owned)}
+        shard_packed: list[np.ndarray | None] = []
         shard_idents: list[list] = []
         h2d_shards: list[int] = []
         for k in range(k_sh):
-            members = ordered[k::k_sh]
+            if k not in pos_of:
+                # a remote host's shard: never packed, never uploaded —
+                # its process stages it from its own report store
+                shard_packed.append(None)
+                shard_idents.append([_EMPTY] * sb)
+                self._free_by_shard[k] = []
+                h2d_shards.append(0)
+                continue
+            members = ordered[pos_of[k]::len(owned)]
             n_real = len(members)
             if n_real:
                 reports = [r.report for r in members]
@@ -1052,7 +1127,8 @@ class ShardedWindowEngine(PackedWindowEngine):
             self._free_by_shard[k] = list(range(sb - 1, n_real - 1, -1))
             h2d_shards.append(n_real)
         self._buffers = [
-            [jax.device_put(shard_packed[k], self._devices[k])
+            [(jax.device_put(shard_packed[k], self._devices[k])
+              if shard_packed[k] is not None else None)
              for k in range(k_sh)]
             for _ in range(self._n_slots)]
         self._content = [[list(shard_idents[k]) for k in range(k_sh)]
@@ -1114,7 +1190,10 @@ class ShardedWindowEngine(PackedWindowEngine):
         h2d_shards = [0] * k_sh
         self._stage_i = (self._stage_i + 1) % len(self._stages)
         stage_slot = self._stages[self._stage_i]
-        for k in range(k_sh):
+        # only owned shards can hold rows (the sticky map never assigns a
+        # node to a shard this process doesn't stage), so remote shards
+        # are untouched by construction: zero H2D, zero staging writes
+        for k in self._owned_shards:
             content = content_slot[k]
             changed = changed_by[k]
             changed_locals = {local for local, _ in changed}
@@ -1169,3 +1248,278 @@ class ShardedWindowEngine(PackedWindowEngine):
                     resident = update(resident, rows_dev, idx_dev)
                 self._buffers[self._buf_i][k] = resident
         return h2d_shards
+
+
+class HostLocalFabric:
+    """In-process stand-in for the cross-host mesh fabric.
+
+    N virtual hosts run their :class:`MultiHostWindowEngine` on N
+    threads; the fabric provides the two cross-host exchanges a real
+    ``jax.distributed`` mesh performs over DCN:
+
+    * :meth:`agree` — elementwise max over small int vectors (the
+      bucket-need agreement that keeps every host compiling the same
+      SPMD shapes);
+    * :meth:`exchange` — merge per-shard single-device buffers for
+      global assembly (in ONE process every device is addressable, so
+      the simulated hosts hand each other the arrays a real
+      multi-controller runtime already sees locally).
+
+    :meth:`kill` breaks the fabric: every in-flight and future
+    rendezvous raises ``DeviceWindowError("host_dead")`` on the
+    survivors — the same failure surface a dead host's collective
+    produces — which the aggregator's ladder turns into the
+    "mesh minus one host" demotion. Used by tests,
+    ``make multihost``'s virtual leg, and the bench multihost row;
+    production multi-host runs with no fabric (``fabric=None``) and
+    gets agreement from ``jax.experimental.multihost_utils`` instead.
+    """
+
+    def __init__(self, n_parties: int, timeout: float = 60.0) -> None:
+        if n_parties < 1:
+            raise ValueError("fabric needs at least one party")
+        self._n = int(n_parties)
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._barrier = threading.Barrier(self._n)
+        self._dead = False
+        self._seq = [0] * self._n
+        self._slots: dict = {}
+
+    @property
+    def n_parties(self) -> int:
+        return self._n
+
+    def kill(self) -> None:
+        """Simulate a host death: break every rendezvous, now and
+        forever — survivors see ``DeviceWindowError("host_dead")``."""
+        self._dead = True
+        self._barrier.abort()
+
+    def _rendezvous(self, party: int, name: str, value: Any) -> list:
+        if self._dead:
+            raise DeviceWindowError(
+                "host_dead", "mesh fabric is down (peer host died)")
+        key = (name, self._seq[party])
+        self._seq[party] += 1
+        with self._lock:
+            entry = self._slots.setdefault(key,
+                                           {"values": [], "reads": 0})
+            entry["values"].append(value)
+        try:
+            self._barrier.wait(timeout=self._timeout)
+        except threading.BrokenBarrierError:
+            raise DeviceWindowError(
+                "host_dead", f"mesh peer lost at {name} rendezvous")
+        with self._lock:
+            entry = self._slots[key]
+            values = list(entry["values"])
+            entry["reads"] += 1
+            if entry["reads"] >= self._n:
+                del self._slots[key]
+        if len(values) != self._n:
+            # parties rendezvoused on DIFFERENT call sites: their plan
+            # paths diverged (a bug the SPMD contract cannot survive)
+            raise DeviceWindowError(
+                "mesh_desync",
+                f"{len(values)}/{self._n} parties met at {name}")
+        return values
+
+    def agree(self, party: int, name: str, vec: np.ndarray) -> np.ndarray:
+        return np.maximum.reduce(self._rendezvous(party, name,
+                                                  np.asarray(vec)))
+
+    def exchange(self, party: int, name: str,
+                 mapping: dict) -> dict:
+        merged: dict = {}
+        for m in self._rendezvous(party, name, dict(mapping)):
+            merged.update(m)
+        return merged
+
+
+class MultiHostWindowEngine(ShardedWindowEngine):
+    """The multi-host tier of the sharded window (ISSUE 15): ONE logical
+    aggregator whose packed resident batch spans every host's devices,
+    with everything except the SPMD dispatch kept strictly HOST-LOCAL.
+
+    The mesh is global (``initialize_multihost()`` + ``make_mesh()``
+    span all processes' devices — ICI within a host, DCN/Gloo across);
+    this engine narrows ``_owned_shards`` to the shards committed to
+    THIS process's local devices, so the inherited machinery stages,
+    packs, and donated-scatter-updates only local rings:
+
+    * **Host-local staging + delta H2D.** Joins/changes/drops touch only
+      local shards (the sticky map never assigns a node to a remote
+      shard); remote shards' buffers are ``None`` — never packed, never
+      uploaded, never read. Zero cross-host bytes on the ingest path.
+    * **Assembly by contract, not transfer.**
+      ``make_array_from_single_device_arrays`` over the LOCAL shards
+      plus the global ``NamedSharding`` builds the global array view —
+      jax's multi-controller assembly contract; no host ever sees
+      another host's packed rows.
+    * **Bucket agreement.** Before fitting the ladders, the per-shard /
+      workload / model bucket NEEDS (and a zone-axis hash) are agreed
+      across hosts with one tiny allgather-max — the SPMD program
+      shapes must match everywhere or dispatch deadlocks. A zone-axis
+      mismatch raises ``mesh_desync`` instead of wedging.
+    * **Owned-rows publish fetch.** The publish fetch materializes only
+      the ADDRESSABLE (owned) shards of the result plane, and the
+      window meta is re-indexed into that local plane: each host
+      publishes exactly the nodes it ingested (which
+      ``fleet.ring.ring_from_mesh`` makes exactly the nodes whose rows
+      live here). The only cross-host traffic in a window is the SPMD
+      dispatch itself.
+
+    ``fabric`` (a :class:`HostLocalFabric`) replaces the DCN exchanges
+    for in-process simulation — tests, ``make multihost``'s virtual
+    leg, bench. Production passes no fabric and agreement rides
+    ``jax.experimental.multihost_utils.process_allgather``.
+    """
+
+    def __init__(self, mesh: Any, backend: str = "einsum",
+                 model_mode: str | None = None,
+                 node_bucket: int = 8, workload_bucket: int = 256,
+                 shrink_after: int = 16, staging_slots: int = 2,
+                 process_index: int | None = None,
+                 device_process: Callable[[Any], int] | None = None,
+                 fabric: HostLocalFabric | None = None) -> None:
+        super().__init__(mesh, backend=backend, model_mode=model_mode,
+                         node_bucket=node_bucket,
+                         workload_bucket=workload_bucket,
+                         shrink_after=shrink_after,
+                         staging_slots=staging_slots)
+        if device_process is None:
+            def device_process(d: Any) -> int:
+                return int(getattr(d, "process_index", 0))
+        if process_index is None:
+            process_index = int(self._jax.process_index())
+        self._party = int(process_index)
+        procs = [int(device_process(d)) for d in self._devices]
+        self._shard_processes = procs
+        self._owned_shards = [k for k, p in enumerate(procs)
+                              if p == self._party]
+        if not self._owned_shards:
+            raise ValueError(
+                f"process {self._party} owns no devices of the mesh "
+                f"(shard processes {procs})")
+        self._owned_devices = {self._devices[k]
+                               for k in self._owned_shards}
+        self._host_count = len(set(procs))
+        self._fabric = fabric
+
+    # -- cross-host agreement ----------------------------------------------
+
+    def _agree_vec(self, name: str, vec: np.ndarray) -> np.ndarray:
+        if self._fabric is not None:
+            return self._fabric.agree(self._party, name, vec)
+        if self._host_count <= 1:
+            return vec
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(vec))
+        return gathered.max(axis=0)
+
+    def _agree_window_needs(self, need_s: int, need_w: int,
+                            zones_t: tuple[str, ...]) -> tuple[int, int]:
+        import hashlib
+
+        zh = int.from_bytes(
+            hashlib.blake2b(repr(zones_t).encode(),
+                            digest_size=4).digest(), "big")
+        # max(zh) and -max(-zh) = min(zh): equal iff every host packed
+        # the same canonical zone axis (string sets can't ride the
+        # allgather, their hash can)
+        out = self._agree_vec(
+            "window_needs", np.asarray([need_s, need_w, zh, -zh],
+                                       np.int64))
+        if int(out[2]) != zh or int(-out[3]) != zh:
+            raise DeviceWindowError(
+                "mesh_desync",
+                "hosts disagree on the canonical zone axis")
+        return int(out[0]), int(out[1])
+
+    def _agree_model_need(self, need_m: int) -> int:
+        out = self._agree_vec("model_need",
+                              np.asarray([need_m], np.int64))
+        return int(out[0])
+
+    # -- host-local assembly / fetch ---------------------------------------
+
+    def _exchange(self, name: str, local: dict) -> dict:
+        if self._fabric is not None:
+            return self._fabric.exchange(self._party, name, local)
+        return local
+
+    def _assemble_resident(self, nb: int) -> Any:
+        jax = self._jax
+        bufs = self._buffers[self._buf_i]
+        local = {k: bufs[k] for k in self._owned_shards}
+        arrays_map = self._exchange("resident", local)
+        return jax.make_array_from_single_device_arrays(
+            (nb, self._width), self._sh_batch,
+            [arrays_map[k] for k in sorted(arrays_map)])
+
+    def _put_model_rows(self, idx: np.ndarray, mb: int) -> Any:
+        jax = self._jax
+        local = {
+            k: jax.device_put(np.ascontiguousarray(
+                idx[k * mb:(k + 1) * mb]), self._devices[k])
+            for k in self._owned_shards}
+        arrays_map = self._exchange("model_rows", local)
+        return jax.make_array_from_single_device_arrays(
+            (self.n_shards * mb,), self._sh_rows,
+            [arrays_map[k] for k in sorted(arrays_map)])
+
+    def _build_meta(self, rows: Sequence[RowInput],
+                    zones_t: tuple[str, ...], sb: int) -> WindowMeta:
+        """LOCAL-plane meta: row indices point into the concatenation of
+        the OWNED shards' result rows (what :meth:`_fetch_plane`
+        materializes) — this host publishes exactly the nodes it
+        ingested, never a remote host's rows."""
+        owned = self._owned_shards
+        pos_of = {k: pos for pos, k in enumerate(owned)}
+
+        def seg(xs: list) -> list:
+            return [x for k in owned for x in xs[k * sb:(k + 1) * sb]]
+
+        local_rows = {}
+        for name, i in self._row_of.items():
+            k, local = divmod(i, sb)
+            local_rows[name] = pos_of[k] * sb + local
+        return WindowMeta(
+            zones=list(zones_t),
+            names=[r.name for r in rows],
+            rows=local_rows,
+            mode=np.asarray(seg(self._mode), np.int32),
+            dt=np.asarray(seg(self._dt), np.float32),
+            counts=seg(self._counts),
+            ids=seg(self._ids),
+            kinds=seg(self._kinds),
+            n_live=len(rows),
+            n_rows=len(owned) * sb,
+        )
+
+    def _fetch_plane(self, out: Any) -> np.ndarray:
+        """Fetch ONLY the owned shards' result rows (the addressable
+        subset a real multi-controller runtime exposes anyway; the
+        in-process simulation filters explicitly) — publish cost scales
+        with owned rows, not fleet size."""
+        shards = getattr(out, "addressable_shards", None)
+        if not shards:
+            return np.asarray(out)
+        parts = [s for s in shards if s.device in self._owned_devices]
+        parts.sort(key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in parts],
+                              axis=0)
+
+    # -- introspection -----------------------------------------------------
+
+    def introspect(self) -> dict:
+        out = super().introspect()
+        out["multihost"] = {
+            "hosts": self._host_count,
+            "process": self._party,
+            "owned_shards": list(self._owned_shards),
+            "simulated_fabric": self._fabric is not None,
+        }
+        return out

@@ -23,8 +23,10 @@ from kepler_tpu.parallel.expert import (
 from kepler_tpu.parallel.mesh import (
     MODEL_AXIS,
     NODE_AXIS,
+    MultihostInit,
     initialize_multihost,
     make_mesh,
+    multihost_status,
 )
 from kepler_tpu.parallel.pipeline import (
     STAGE_AXIS,
@@ -81,6 +83,8 @@ __all__ = [
     "make_fleet_program",
     "initialize_multihost",
     "make_mesh",
+    "MultihostInit",
+    "multihost_status",
     "mlp_param_shardings",
     "run_fleet_attribution",
     "shard_train_state",

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import math
 import os
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import jax
 from jax.sharding import Mesh
@@ -29,6 +29,88 @@ NODE_AXIS = "node"
 MODEL_AXIS = "model"
 
 log = logging.getLogger("kepler.parallel.mesh")
+
+
+class MultihostInit(NamedTuple):
+    """Outcome of :func:`initialize_multihost` — truthy iff the process
+    joined a cluster, with the DISTINCT failure reason preserved so a
+    half-joined mesh is diagnosable from the return value, the log, and
+    the ``fleet-window`` health probe (not a generic decline).
+
+    ``reason`` is one of the bounded labels below; ``detail`` carries
+    the underlying error text (bounded) when one exists.
+    """
+
+    joined: bool
+    reason: str  # joined | unconfigured | coordinator_unreachable | init_error
+    detail: str = ""
+    num_processes: int = 1
+    process_id: int = 0
+
+    def __bool__(self) -> bool:  # backward compat: callers truth-test it
+        return self.joined
+
+
+#: the last initialize_multihost outcome in this process ("never called"
+#: reads as an unconfigured single-host decline) — the health probe's view
+_last_init: MultihostInit = MultihostInit(False, "unconfigured")
+
+
+def multihost_status() -> MultihostInit:
+    """The last :func:`initialize_multihost` outcome in this process."""
+    return _last_init
+
+
+# error-text markers that mean "the coordinator never answered" (gRPC
+# deadline/connectivity vocabulary across the jax versions we support) —
+# anything else is an init_error, a different operator problem entirely
+_UNREACHABLE_MARKERS = ("deadline_exceeded", "deadline exceeded",
+                        "unavailable", "timed out", "timeout",
+                        "failed to connect", "connection refused")
+
+
+def _classify_init_error(err: BaseException) -> str:
+    text = f"{type(err).__name__}: {err}".lower()
+    if any(m in text for m in _UNREACHABLE_MARKERS):
+        return "coordinator_unreachable"
+    return "init_error"
+
+
+#: pre-probe bound when no init_timeout is configured — jax's own
+#: RegisterTask deadline default
+_DEFAULT_JOIN_DEADLINE_S = 300.0
+
+
+def _wait_coordinator(addr: str, deadline_s: float) -> bool:
+    """Poll a TCP connect to the coordinator until ``deadline_s``.
+
+    jax's distributed client handles a connect deadline with a native
+    ``LOG(FATAL)`` — the process ABORTS before any Python except clause
+    can classify the failure (observed live on jax 0.4.37:
+    ``Terminating process … DEADLINE_EXCEEDED … RegisterTask``). So for
+    non-coordinator processes the unreachable case must be caught HERE,
+    in Python, before ``jax.distributed.initialize`` is ever entered.
+    Retries absorb the normal startup race where process 0 hasn't bound
+    its port yet."""
+    import socket
+    import time
+
+    host, _, port_s = addr.rpartition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        return True  # unparseable → let jax produce its own error
+    host = host.strip("[]") or "127.0.0.1"
+    deadline = time.monotonic() + max(1.0, deadline_s)
+    while True:
+        try:
+            with socket.create_connection((host, port), timeout=2.0):
+                return True
+        except OSError:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(0.5, max(0.05,
+                                    deadline - time.monotonic())))
 
 
 def make_mesh(
@@ -68,7 +150,8 @@ def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
-) -> bool:
+    init_timeout: float | None = None,
+) -> MultihostInit:
     """Join a multi-host JAX cluster (DCN) so meshes span every host's
     chips — the scale-out leg beyond one aggregator host.
 
@@ -83,14 +166,22 @@ def initialize_multihost(
 
     Arguments default from the standard env (JAX_COORDINATOR_ADDRESS,
     JAX_NUM_PROCESSES, JAX_PROCESS_ID — also set by TPU pod runtimes).
-    → True if distributed init ran; False when unconfigured (single-host,
-    the default everywhere in this repo's tests and benches).
+    → a truthy :class:`MultihostInit` if distributed init ran; a falsy
+    one when unconfigured (single-host, the default everywhere in this
+    repo's tests and benches) or when joining FAILED — with the failure
+    reason kept distinct: ``coordinator_unreachable`` (the coordinator
+    never answered within the deadline — the classic half-joined-mesh
+    misconfiguration) vs ``init_error`` (anything else). Both are also
+    logged at error level and surfaced by :func:`multihost_status`, which
+    the aggregator's ``fleet-window`` health probe republishes.
 
     Call ONCE per process, before any other jax API touches the backend.
     """
+    global _last_init
     addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if not addr:
-        return False
+        _last_init = MultihostInit(False, "unconfigured")
+        return _last_init
     kwargs: dict[str, Any] = {"coordinator_address": addr}
     nproc = (num_processes if num_processes is not None
              else os.environ.get("JAX_NUM_PROCESSES"))
@@ -100,7 +191,57 @@ def initialize_multihost(
         kwargs["num_processes"] = int(nproc)
     if pid is not None:
         kwargs["process_id"] = int(pid)
-    jax.distributed.initialize(**kwargs)
+    if init_timeout is not None and init_timeout > 0:
+        kwargs["initialization_timeout"] = int(init_timeout)
+    # non-coordinator processes: verify the coordinator ANSWERS before
+    # entering jax — its native client aborts the whole process on a
+    # connect deadline (no Python exception to classify), which would
+    # turn the most common misconfiguration into an undiagnosable crash.
+    # Process 0 hosts the coordinator itself, so it never probes; with
+    # the process id UNKNOWN (jax auto-detection) we cannot tell the two
+    # apart — probing would wrongly decline on the coordinator host, so
+    # the probe is skipped and an unreachable coordinator still aborts
+    # natively there. Leave the breadcrumb where it can be found.
+    pid_i = int(pid) if pid is not None else None
+    if pid_i is None:
+        log.warning(
+            "multi-host init with no explicit process id: the "
+            "coordinator reachability pre-probe is skipped — if %s is "
+            "unreachable, jax's native client will ABORT this process "
+            "(set JAX_PROCESS_ID / aggregator.multihost.processId for "
+            "a diagnosable coordinator_unreachable decline)", addr)
+    if pid_i is not None and pid_i != 0:
+        bound = (float(init_timeout) if init_timeout else
+                 _DEFAULT_JOIN_DEADLINE_S)
+        if not _wait_coordinator(addr, bound):
+            detail = (f"no coordinator listening at {addr} within "
+                      f"{bound:g}s")
+            _last_init = MultihostInit(
+                False, "coordinator_unreachable", detail=detail,
+                num_processes=int(nproc) if nproc is not None else 1,
+                process_id=pid_i)
+            log.error("multi-host jax init FAILED "
+                      "(coordinator_unreachable): %s", detail)
+            return _last_init
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as err:
+        # a failed join must not read as "unconfigured single-host": the
+        # reason is preserved for the return/log/probe so the operator
+        # sees a coordinator that never answered vs a real init bug
+        reason = _classify_init_error(err)
+        detail = f"{type(err).__name__}: {err}"[:240]
+        _last_init = MultihostInit(
+            False, reason, detail=detail,
+            num_processes=int(nproc) if nproc is not None else 1,
+            process_id=int(pid) if pid is not None else 0)
+        log.error("multi-host jax init FAILED (%s) against %s: %s",
+                  reason, addr, detail)
+        return _last_init
+    _last_init = MultihostInit(
+        True, "joined",
+        num_processes=jax.process_count(),
+        process_id=jax.process_index())
     log.info("joined multi-host jax cluster: %s (process %s/%s, "
              "%d global devices)", addr, pid, nproc, len(jax.devices()))
-    return True
+    return _last_init

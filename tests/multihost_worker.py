@@ -17,6 +17,88 @@ import hashlib
 import json
 import sys
 
+# -- shared two-process spawn/skip/retry vocabulary -------------------------
+# THE one copy used by both tests/test_multihost.py and the
+# `make multihost` gate (__graft_entry__._dryrun_multihost_two_process):
+# the skip markers and the bind-collision retry must never diverge
+# between the two gates.
+
+# error-text markers that mean the jax build simply cannot run
+# cross-process computations on CPU (no Gloo collective backend) — a
+# clean SKIP, not an error: the gate is environmental there by design
+UNSUPPORTED_MARKERS = (
+    "multiprocess computations aren't implemented",
+    "not implemented on the cpu backend",
+    "unimplemented",
+    "gloo",
+    "distributed service is not supported",
+)
+
+# a coordinator port raced by another process: retry on a fresh port
+BIND_MARKERS = ("address already in use", "failed to bind", "bind error")
+
+# hard wall-clock bound per two-process attempt: a wedged coordinator
+# must produce a captured-stderr failure, never a hung run
+WORKER_TIMEOUT_S = 240
+
+
+def unsupported_reason(stderr: str) -> str | None:
+    """The matched no-multiprocess-backend marker, or None."""
+    low = stderr.lower()
+    for marker in UNSUPPORTED_MARKERS:
+        if marker in low:
+            return marker
+    return None
+
+
+def bind_collision(stderr: str) -> bool:
+    low = stderr.lower()
+    return any(m in low for m in BIND_MARKERS)
+
+
+def run_workers(repo: str, n_proc: int, port: int,
+                sanitize_env: tuple = ()) -> list:
+    """Spawn ``n_proc`` workers against one coordinator port; → per-
+    worker (rc, stdout, stderr) with a HARD timeout (kill + stderr
+    capture — a dead coordinator must not leave its peer blocked
+    forever)."""
+    import os
+    import subprocess
+
+    pythonpath = repo + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": pythonpath.rstrip(os.pathsep)}
+    for var in sanitize_env:
+        env.pop(var, None)
+    workers = [
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tests", "multihost_worker.py"),
+             str(i), str(n_proc), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=repo)
+        for i in range(n_proc)
+    ]
+    results = []
+    try:
+        for w in workers:
+            try:
+                out, err = w.communicate(timeout=WORKER_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                w.kill()
+                out, err = w.communicate(timeout=30)
+                err = (f"[killed after {WORKER_TIMEOUT_S}s timeout]\n"
+                       + (err or ""))
+                results.append((124, out or "", err))
+                continue
+            results.append((w.returncode, out, err))
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+                w.wait(timeout=30)
+    return results
+
 
 def main() -> int:
     pid = int(sys.argv[1])

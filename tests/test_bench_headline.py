@@ -59,6 +59,11 @@ def fat_result(**overrides) -> dict:
         "aggwin_sharded_device_ratio": 0.5,
         "aggwin_sharded_ratio_budget": 0.6,
         "aggwin_sharded_bit_consistent": True,
+        "aggwin_multihost_ok": True,
+        "aggwin_multihost_hosts": 2,
+        "aggwin_multihost_bit_consistent": True,
+        "aggwin_multihost_capacity_ratio": 2.0,
+        "aggwin_multihost_capacity_budget": 1.8,
         "ingest_ok": True,
         "ingest_zero_copy_ok": True,
         "ingest_decode_ratio": 4.9,
@@ -235,3 +240,44 @@ class TestErroredLegGates:
         assert messages == []
         head = json.loads(bench.build_headline(result, "f.json"))
         assert "aggwin_sharded_ok" not in head
+
+    def test_multihost_violation_gates_and_survives_headline(self):
+        """The ISSUE-15 multi-host gate: bit-inconsistency or a
+        capacity-scaling miss fails the run, lands False in the
+        headline, and the headline still honors the size contract."""
+        result = fat_result(aggwin_multihost_ok=False,
+                            aggwin_multihost_bit_consistent=False,
+                            aggwin_multihost_capacity_ratio=1.2)
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert any("multi-host" in m for m in messages)
+        result["ok"] = not failed
+        line = bench.build_headline(result, "BENCH_DETAIL.json")
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["aggwin_multihost_ok"] is False
+        assert head["ok"] is False
+
+    def test_absent_multihost_leg_does_not_gate(self):
+        """Below 4 devices the scenario emits no multihost fields —
+        absence never gates."""
+        result = fat_result()
+        for key in list(result):
+            if key.startswith("aggwin_multihost"):
+                del result[key]
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert not failed
+        assert messages == []
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert "aggwin_multihost_ok" not in head
+
+    def test_aggwin_error_forces_multihost_gate_false(self):
+        """An errored aggwin leg forces every aggwin gate False —
+        including the multi-host one — without fabricating a measured
+        violation message for it."""
+        result = fat_result(aggwin_error="subprocess died")
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert result["aggwin_multihost_ok"] is False
+        assert result["aggwin_sharded_ok"] is False
+        assert sum("aggwin" in m for m in messages) == 1  # the leg error

@@ -445,6 +445,25 @@ FIELD_MATRIX = [
     FieldCase("aggregator.base_row_cache",
               "aggregator: {baseRowCache: 64}", 64,
               ["--aggregator.base-row-cache", "32"], 32),
+    FieldCase("aggregator.multihost.enabled",
+              "aggregator: {multihost: {enabled: true}}", True,
+              ["--no-aggregator.multihost.enabled"], False),
+    FieldCase("aggregator.multihost.coordinator",
+              "aggregator: {multihost: {coordinator: 'coord:1234'}}",
+              "coord:1234",
+              ["--aggregator.multihost.coordinator", "c2:1"], "c2:1"),
+    FieldCase("aggregator.multihost.num_processes",
+              "aggregator: {multihost: {numProcesses: 2}}", 2,
+              ["--aggregator.multihost.num-processes", "4"], 4),
+    FieldCase("aggregator.multihost.process_id",
+              "aggregator: {multihost: {processId: 1}}", 1,
+              ["--aggregator.multihost.process-id", "0"], 0),
+    FieldCase("aggregator.multihost.init_timeout",
+              "aggregator: {multihost: {initTimeout: 90s}}", 90.0,
+              ["--aggregator.multihost.init-timeout", "1m"], 60.0),
+    FieldCase("aggregator.multihost.takeover",
+              "aggregator: {multihost: {takeover: false}}", False,
+              ["--aggregator.multihost.takeover"], True),
     FieldCase("web.max_connections",
               "web: {maxConnections: 64}", 64,
               ["--web.max-connections", "32"], 32),
@@ -584,6 +603,9 @@ class TestYAMLSpellings:
         "retryAfterMax": ("agent", "drain"),
         "keyframeEvery": ("agent", "wire"),
         "baseRowCache": "aggregator",
+        "numProcesses": ("aggregator", "multihost"),
+        "processId": ("aggregator", "multihost"),
+        "initTimeout": ("aggregator", "multihost"),
         "maxConnections": "web",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
@@ -652,6 +674,9 @@ class TestYAMLSpellings:
         "retryAfterMax": ("2m", 120.0),
         "keyframeEvery": ("4", 4),
         "baseRowCache": ("64", 64),
+        "numProcesses": ("2", 2),
+        "processId": ("1", 1),
+        "initTimeout": ("90s", 90.0),
         "maxConnections": ("64", 64),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),
@@ -817,6 +842,21 @@ class TestValidationMatrix:
         ("aggregator.baseRowCache",
          lambda c: setattr(c.aggregator, "base_row_cache", 0),
          "baseRowCache"),
+        ("aggregator.multihost.initTimeout",
+         lambda c: setattr(c.aggregator.multihost, "init_timeout", -1),
+         "initTimeout"),
+        ("aggregator.multihost.numProcesses",
+         lambda c: setattr(c.aggregator.multihost, "num_processes", 0),
+         "numProcesses"),
+        ("aggregator.multihost.processId",
+         lambda c: setattr(c.aggregator.multihost, "process_id", -2),
+         "processId"),
+        ("aggregator.multihost.peersMismatch",
+         lambda c: (setattr(c.aggregator.multihost, "enabled", True),
+                    setattr(c.aggregator.multihost, "num_processes", 3),
+                    setattr(c.aggregator, "peers", ["a:1", "b:2"]),
+                    setattr(c.aggregator, "self_peer", "a:1")),
+         "one replica endpoint per multihost process"),
         ("web.maxConnections",
          lambda c: setattr(c.web, "max_connections", -1),
          "maxConnections"),

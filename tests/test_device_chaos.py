@@ -516,3 +516,183 @@ class TestShardedChaos:
         for result in final:
             assert_windows_equal(result, ref_by_ts[result.timestamp])
         agg.shutdown()
+
+
+class TestMultiHostChaos:
+    """Host death on the multi-host tier (ISSUE 15): a 2-host virtual
+    dryrun — one host's fabric presence is killed mid-run (the
+    in-process stand-in for SIGKILLing a worker; the real two-process
+    leg lives in ``make multihost`` and skips where jax lacks the Gloo
+    CPU backend) — and the survivor must
+
+    * demote to the "mesh minus one host" rung within ONE window and
+      keep publishing every interval,
+    * bump the ring membership epoch so displaced agents follow 421s,
+    * absorb the displaced agents' replay with ZERO windows counted
+      lost (the acked_through watermark seeds their seq trackers), and
+    * publish windows bit-equal to a fault-free single-host reference
+      after recovery.
+    """
+
+    PEERS = ["127.0.0.1:28291", "127.0.0.1:28292"]
+
+    @staticmethod
+    def _topology():
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs >= 4 simulated devices")
+        per = len(devs) // 2
+        mesh_devs = devs[:2 * per]
+        proc_of = {d: (0 if k < per else 1)
+                   for k, d in enumerate(mesh_devs)}
+        return mesh_devs, proc_of.get
+
+    def _make_agg(self, process_index: int, fabric, device_process):
+        ticks = [1e9]
+        agg = Aggregator(
+            APIServer(), model_mode="mlp", node_bucket=8,
+            workload_bucket=8, stale_after=1e9, pipeline_depth=1,
+            multihost_enabled=True,
+            multihost_topology={"process_index": process_index,
+                                "device_process": device_process,
+                                "fabric": fabric},
+            peers=list(self.PEERS),
+            self_peer=self.PEERS[process_index],
+            clock=lambda: ticks[0])
+        agg.test_clock = ticks
+        agg.init()
+        return agg
+
+    @staticmethod
+    def _seed(agg, names, win):
+        now = agg.test_clock[0]
+        for i, name in enumerate(names):
+            rep = make_report(name, win * 100 + i, w=4,
+                              mode=MODE_MODEL if i % 2 else MODE_RATIO)
+            agg._reports[name] = _Stored(report=rep, zone_names=ZONES,
+                                         received=now, seq=win + 1,
+                                         run="r1")
+
+    def test_host_death_demotes_within_one_window_zero_loss(self):
+        import threading
+
+        from kepler_tpu.fleet import wire
+        from kepler_tpu.fleet.aggregator import (RUNG_NAME_MESH_DEGRADED,
+                                                 RUNG_NAME_MULTIHOST)
+        from kepler_tpu.fleet.ring import MeshRing
+        from kepler_tpu.fleet.window import HostLocalFabric
+
+        mesh_devs, device_process = self._topology()
+        fabric = HostLocalFabric(2, timeout=60)
+        aggs = [self._make_agg(p, fabric, device_process)
+                for p in (0, 1)]
+        assert isinstance(aggs[0]._ring, MeshRing)
+        ring = aggs[0]._ring
+        all_names = [f"n{i:02d}" for i in range(10)]
+        owned = {p: [n for n in all_names
+                     if ring.owner(n) == self.PEERS[p]] for p in (0, 1)}
+        assert owned[0] and owned[1], owned  # both hosts host agents
+
+        # -- healthy multi-host windows on both virtual hosts ----------
+        def window_on_both(win):
+            published = [None, None]
+            errs = [None, None]
+
+            def run(p):
+                try:
+                    aggs[p].test_clock[0] += 5.0
+                    self._seed(aggs[p], owned[p], win)
+                    published[p] = aggs[p].aggregate_once()
+                except BaseException as e:
+                    errs[p] = e
+
+            ts = [threading.Thread(target=run, args=(p,))
+                  for p in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=180)
+            for e in errs:
+                if e is not None:
+                    raise e
+            return published
+
+        for win in range(3):
+            published = window_on_both(win)
+            for p in (0, 1):
+                assert published[p] is not None
+                assert sorted(published[p].names) == sorted(owned[p])
+        assert aggs[0]._rung_display(RUNG_PIPELINED) == \
+            RUNG_NAME_MULTIHOST
+        epoch_before = aggs[0]._ring.epoch
+
+        # -- SIGKILL host 1 (fabric presence dies mid-run) -------------
+        fabric.kill()
+        survivor = aggs[0]
+        survivor.test_clock[0] += 5.0
+        self._seed(survivor, owned[0], 3)
+        result = survivor.aggregate_once()
+
+        # demoted to "mesh minus one host" within ONE window — the
+        # interval still published, on the survivor's own devices
+        assert result is not None
+        assert sorted(result.names) == sorted(owned[0])
+        assert survivor._mesh_degraded is True
+        assert survivor._rung == RUNG_PIPELINED
+        assert survivor._stats["window_demotions_total"] == 1
+        assert survivor._rung_display(RUNG_PIPELINED) == \
+            RUNG_NAME_MESH_DEGRADED
+        # ring epoch bumped: displaced agents follow 421s to the
+        # survivor (takeover ring owns everything here)
+        assert survivor._ring.epoch == epoch_before + 1
+        assert survivor._ring.owner(owned[1][0]) == self.PEERS[0]
+
+        # -- displaced agents replay to the new owner ------------------
+        # each displaced node re-delivers its next window with the
+        # acked_through watermark covering everything the dead owner
+        # 2xx'd — the fresh seq tracker seeds from it: ZERO loss
+        now = survivor.test_clock[0]
+        for i, name in enumerate(owned[1]):
+            rep = make_report(name, 3 * 100 + 50 + i, w=4,
+                              mode=MODE_MODEL if i % 2 else MODE_RATIO)
+            data = wire.encode_report(rep, list(ZONES), seq=4, run="r1",
+                                      sent_at=now)
+            data = wire.restamp_transmit(data, sent_at=now,
+                                         acked_through=3)
+            status, _, body = survivor._ingest_payload(data)
+            assert status == 204, (status, body)
+        assert survivor._stats["windows_lost_total"] == 0
+        assert survivor._stats["reports_total"] >= len(owned[1])
+
+        # -- recovered window: full fleet on the survivor, bit-equal
+        # to a fault-free single-host reference --------------------------
+        survivor.test_clock[0] += 5.0
+        self._seed(survivor, owned[0], 4)
+        for i, name in enumerate(owned[1]):
+            rep = make_report(name, 4 * 100 + 50 + i, w=4,
+                              mode=MODE_MODEL if i % 2 else MODE_RATIO)
+            survivor._reports[name] = _Stored(
+                report=rep, zone_names=ZONES,
+                received=survivor.test_clock[0], seq=5, run="r1")
+        recovered = survivor.aggregate_once()
+        assert recovered is not None
+        assert sorted(recovered.names) == sorted(all_names)
+        assert survivor._stats["windows_lost_total"] == 0
+
+        ref = make_agg(depth=1)
+        ref.test_clock[0] = survivor.test_clock[0] - 5.0
+        self._seed(ref, owned[0], 4)
+        for i, name in enumerate(owned[1]):
+            rep = make_report(name, 4 * 100 + 50 + i, w=4,
+                              mode=MODE_MODEL if i % 2 else MODE_RATIO)
+            ref._reports[name] = _Stored(
+                report=rep, zone_names=ZONES,
+                received=ref.test_clock[0], seq=5, run="r1")
+        ref.test_clock[0] += 5.0
+        reference = ref.aggregate_once()
+        assert_windows_equal(recovered, reference)
+        ref.shutdown()
+        survivor.shutdown()
+        aggs[1].shutdown()

@@ -24,8 +24,6 @@ from __future__ import annotations
 import json
 import os
 import socket
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -64,38 +62,34 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# spawn/skip/retry vocabulary shared with the `make multihost` gate —
+# ONE copy, in the worker module itself
+from tests.multihost_worker import (bind_collision,  # noqa: E402
+                                    run_workers, unsupported_reason)
+
+
 def test_fleet_program_across_two_processes():
-    port = _free_port()
-    pythonpath = REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": pythonpath.rstrip(os.pathsep)}
-    workers = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests",
-                                          "multihost_worker.py"),
-             str(i), "2", str(port)],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=REPO)
-        for i in range(2)
-    ]
     rows = []
-    try:
-        for w in workers:
-            out, err = w.communicate(timeout=240)
-            if w.returncode != 0:
-                if "distributed" in err.lower() and "unimplemented" in \
-                        err.lower():
-                    pytest.skip(
-                        f"jax.distributed unsupported here: {err[-200:]}")
-                raise AssertionError(
-                    f"worker failed rc={w.returncode}\n{err[-2000:]}")
-            rows.append(json.loads(out.strip().splitlines()[-1]))
-    finally:
-        # a dead coordinator must not leave its peer blocked forever
-        for w in workers:
-            if w.poll() is None:
-                w.kill()
-                w.wait(timeout=30)
+    for attempt in range(3):
+        port = _free_port()
+        results = run_workers(REPO, 2, port)
+        stderr_all = "\n".join(err for _, _, err in results)
+        if all(rc == 0 for rc, _, _ in results):
+            rows = [json.loads(out.strip().splitlines()[-1])
+                    for _, out, _ in results]
+            break
+        reason = unsupported_reason(stderr_all)
+        if reason is not None:
+            pytest.skip("jax build lacks the multi-process CPU "
+                        f"(Gloo) backend [{reason!r}]: "
+                        f"{stderr_all[-300:]}")
+        if bind_collision(stderr_all) and attempt < 2:
+            continue  # the coordinator port was raced: fresh port, retry
+        failed = [(i, rc) for i, (rc, _, _) in enumerate(results)
+                  if rc != 0]
+        raise AssertionError(
+            f"workers {failed} failed (attempt {attempt + 1})\n"
+            f"{stderr_all[-2000:]}")
 
     # both processes saw the same GLOBAL mesh (conftest's virtual-device
     # flag gives each process several local CPU devices) and agree
