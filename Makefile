@@ -26,8 +26,10 @@ test-verbose:
 	$(PYTHON) -m pytest tests/ -v
 
 .PHONY: chaos
-chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines, ring kill/rebalance, overload herd
+chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines, ring kill/rebalance, overload herd, diurnal scale soak
 	$(PYTHON) -m pytest tests/ -q -m chaos
+	$(PYTHON) -m benchmarks.soak --agents 40 --seconds 36 --interval 3 \
+		--workloads 20 --diurnal
 
 .PHONY: verify
 verify: lint chaos multihost ## the lint surface plus the chaos subset and the multi-host dryrun — the PR gate's sibling path

@@ -73,7 +73,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              interval: float = 5.0, workloads: int = 100,
              model_mode: str | None = "mlp", replicas: int = 1,
              kill_at: float = 0.0, shed: bool = False,
-             rebalance_after: float = 0.0) -> dict:
+             rebalance_after: float = 0.0, diurnal: bool = False) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
     from kepler_tpu.fleet.wire import (encode_report, encode_report_batch,
                                        restamp_transmit)
@@ -94,6 +94,14 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     # measures the overload plane itself: sheds fired, drain requests
     # vs records (batching factor), and the survivors' post-kill
     # ingest p99.
+    #
+    # --diurnal (ISSUE 16 elastic membership): a 1 → peak → 2 replica
+    # schedule UNDER LIVE LOAD driven through the real membership
+    # plane — standbys register with the lease holder over
+    # ``/v1/membership`` (join) at seconds/3, the holder retires them
+    # again (leave) at 2·seconds/3, and displaced agents follow 421s
+    # and replay to the new owners. The gate requires ZERO windows
+    # lost across every scale event.
     replicas = max(1, int(replicas))
     admission_kw = dict(
         admission_enabled=True, admission_max_inflight=64,
@@ -108,28 +116,37 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     peers = [f"{h}:{p}" for (h, p) in (s.addresses[0] for s in servers)]
     aggs: list[Aggregator] = []
     ctxs: list[CancelContext] = []
-    threads: list[threading.Thread] = []
+    replica_threads: list[list[threading.Thread]] = []
     for i, server in enumerate(servers):
+        if diurnal:
+            # replica 0 starts as a ring of ONE (the lease holder);
+            # standbys carry just [holder, self] so request_join has a
+            # ring and a first peer to register with
+            peer_kw = dict(
+                peers=[peers[0]] if i == 0 else [peers[0], peers[i]],
+                self_peer=peers[i])
+        else:
+            peer_kw = dict(peers=peers if replicas > 1 else None,
+                           self_peer=peers[i] if replicas > 1 else "")
         agg = Aggregator(server, interval=interval,
                          stale_after=interval * 3,
                          model_mode=model_mode, node_bucket=64,
                          workload_bucket=128, pipeline_depth=2,
-                         peers=peers if replicas > 1 else None,
-                         self_peer=peers[i] if replicas > 1 else "",
-                         **admission_kw)
+                         **peer_kw, **admission_kw)
         agg._mesh = make_mesh()
         agg.init()
         ctx = CancelContext()
-        threads += [
+        replica_threads.append([
             threading.Thread(target=server.run, args=(ctx,), daemon=True),
-            threading.Thread(target=agg.run, args=(ctx,), daemon=True)]
+            threading.Thread(target=agg.run, args=(ctx,), daemon=True)])
         aggs.append(agg)
         ctxs.append(ctx)
-    for t in threads:
-        t.start()
+    live = {0} if diurnal else set(range(replicas))
+    for i in sorted(live):
+        for t in replica_threads[i]:
+            t.start()
     time.sleep(0.2)
     victim = replicas - 1 if replicas > 1 and kill_at > 0 else -1
-    live = set(range(replicas))
 
     rng = np.random.default_rng(0)
     zones = ["package", "core", "dram", "uncore"]
@@ -141,6 +158,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     rejects = np.zeros(n_agents, np.int64)
     errors = np.zeros(n_agents, np.int64)
     redirects = np.zeros(n_agents, np.int64)
+    replays = np.zeros(n_agents, np.int64)
     throttled = np.zeros(n_agents, np.int64)
     drain_requests = np.zeros(n_agents, np.int64)
     drain_records = np.zeros(n_agents, np.int64)
@@ -166,7 +184,8 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             mode=MODE_MODEL if idx % 2 else MODE_RATIO,
             workload_kinds=np.ones(workloads, np.int8),
         )
-        t_idx = idx % len(peers)
+        # diurnal starts single-replica: everyone aims at the holder
+        t_idx = 0 if diurnal else idx % len(peers)
 
         def connect():
             h, _, p = peers[t_idx].rpartition(":")
@@ -182,6 +201,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         while not stop.is_set():
             seq += 1
             base = encode_report(rep, zones, seq=seq, run=f"r{idx}")
+            first_target = t_idx
             # at-least-once: retry THIS seq until a replica concludes
             # it — a replica outage then shows up as duplicates and
             # redirects, never as a seq-gap loss, which is exactly what
@@ -231,6 +251,12 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                             (time.perf_counter() - t0) * 1e3))
                 if status == 204:
                     acked = seq
+                    if t_idx != first_target:
+                        # the window concluded on a DIFFERENT replica
+                        # than first tried — a membership change (or
+                        # outage) moved the shard and the report was
+                        # replayed to its new owner
+                        replays[idx] += 1
                 else:
                     rejects[idx] += 1
                 break
@@ -451,6 +477,69 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         killer = threading.Timer(max(0.0, kill_at), kill_and_rebalance)
         killer.daemon = True
         killer.start()
+
+    scale_events = [0]
+    if diurnal:
+        def membership_post(holder: str, payload: dict) -> None:
+            h, _, p = holder.rpartition(":")
+            conn = http.client.HTTPConnection(h, int(p), timeout=10)
+            try:
+                conn.request("POST", "/v1/membership",
+                             body=json.dumps(payload).encode())
+                conn.getresponse().read()
+            finally:
+                conn.close()
+
+        def diurnal_schedule() -> None:
+            # 1 → peak at seconds/3: every standby replica registers
+            # with the lease holder over the REAL /v1/membership wire
+            # (the holder folds it in at epoch+1 and broadcasts)
+            up_at = t_start + seconds / 3.0
+            down_at = t_start + 2.0 * seconds / 3.0
+            while time.monotonic() < up_at and not stop.is_set():
+                time.sleep(0.1)
+            for i in range(1, replicas):
+                if stop.is_set():
+                    return
+                for t in replica_threads[i]:
+                    t.start()
+                time.sleep(0.2)
+                try:
+                    aggs[i].request_join()
+                except ValueError as err:
+                    print(f"diurnal join of replica {i} failed: {err}",
+                          file=sys.stderr)
+                    continue
+                live.add(i)
+                scale_events[0] += 1
+            # peak → 2 at 2·seconds/3: graceful leave through the
+            # holder; the leaver keeps answering 421s for a grace
+            # period (redirect drain) before going dark
+            while time.monotonic() < down_at and not stop.is_set():
+                time.sleep(0.1)
+            left = []
+            for i in range(2, replicas):
+                if stop.is_set() or i not in live:
+                    continue
+                try:
+                    membership_post(peers[0],
+                                    {"op": "leave", "peer": peers[i]})
+                except OSError as err:
+                    print(f"diurnal leave of replica {i} failed: {err}",
+                          file=sys.stderr)
+                    continue
+                left.append(i)
+                scale_events[0] += 1
+            time.sleep(min(2.0, interval))
+            for i in left:
+                live.discard(i)
+                ctxs[i].cancel()
+                servers[i].shutdown()
+                aggs[i].shutdown()
+
+        scheduler = threading.Thread(target=diurnal_schedule,
+                                     daemon=True)
+        scheduler.start()
     # ramp: wait until every agent has had a chance to connect+report and
     # a couple of attribution windows completed (first-window jit compile
     # memory and GIL stalls are one-time), so the steady-state baselines
@@ -526,6 +615,20 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         "soak_windows_lost": int(stats.get("windows_lost_total", 0)),
         "soak_duplicates": int(stats.get("duplicates_total", 0)),
     }
+    if diurnal:
+        out.update({
+            "soak_diurnal": True,
+            # enacted membership transitions: (peak-1) joins on the way
+            # up plus (peak-2) leaves on the way down
+            "soak_scale_events": int(scale_events[0]),
+            "soak_scale_events_expected": (replicas - 1) + (replicas - 2),
+            # reports concluded on a different replica than first
+            # tried — displaced shards replayed to their new owners
+            "soak_rejoin_replays": int(replays.sum()),
+            "soak_final_replicas": len(live),
+            "soak_final_epoch": max(
+                aggs[i]._ring.epoch for i in sorted(live)),
+        })
     if shed:
         shed_total = sum(
             sum(aggs[i]._admission.shed_by_reason().values())
@@ -576,6 +679,25 @@ def gate(row: dict, p99_budget_ms: float = 250.0,
         failures.append(
             f"{row['soak_windows_lost']} windows lost across the "
             "replicated ingest tier (hand-off must be replay, not loss)")
+    if row.get("soak_diurnal"):
+        # elastic membership: every scheduled transition must have been
+        # ENACTED through the membership plane, shards must actually
+        # have moved (and replayed), and — via the replicas>1 zero-loss
+        # check above — no window may be lost across any scale event
+        if row["soak_scale_events"] < row["soak_scale_events_expected"]:
+            failures.append(
+                f"only {row['soak_scale_events']} of "
+                f"{row['soak_scale_events_expected']} scale events "
+                "enacted (join/leave through the membership plane "
+                "failed)")
+        if not row["soak_rejoin_replays"]:
+            failures.append(
+                "no rejoin replays observed: membership changes moved "
+                "no shards (ring ownership never changed hands?)")
+        if row["soak_final_replicas"] != 2:
+            failures.append(
+                f"diurnal schedule ended at {row['soak_final_replicas']} "
+                "replicas (expected 2)")
     if row.get("soak_shed"):
         # herd mode: batched drain must measurably cut request count —
         # the deep recovery replay ships ≥ 8 records in one request
@@ -614,6 +736,13 @@ def main() -> None:
                         "emits soak_shed_total / soak_drain_requests / "
                         "soak_survivor_ingest_p99_ms and gates the "
                         "deepest recovery batch at >= 8 records")
+    p.add_argument("--diurnal", action="store_true",
+                   help="elastic-membership mode (ISSUE 16): a 1 -> "
+                        "peak -> 2 replica schedule under live load "
+                        "driven through /v1/membership join/leave; "
+                        "emits soak_scale_events / soak_rejoin_replays "
+                        "and gates ZERO windows lost across every "
+                        "scale event (peak = --replicas, min 4)")
     p.add_argument("--rebalance-after", type=float, default=None,
                    help="seconds AFTER the kill before survivors adopt "
                         "the shrunken membership (ownership-convergence "
@@ -628,13 +757,19 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.diurnal and (args.shed or args.kill_at):
+        p.error("--diurnal runs its own scale schedule; it does not "
+                "compose with --shed or --kill-at")
+    if args.diurnal:
+        args.replicas = max(args.replicas, 4)
     rebalance_after = args.rebalance_after
     if rebalance_after is None:
         rebalance_after = 8 * args.interval if args.shed else 0.0
     row = run_soak(args.agents, args.seconds, args.interval,
                    args.workloads, replicas=args.replicas,
                    kill_at=args.kill_at, shed=args.shed,
-                   rebalance_after=rebalance_after)
+                   rebalance_after=rebalance_after,
+                   diurnal=args.diurnal)
     row["soak_rss_growth_budget_mib"] = args.rss_budget_mib
     failures = ([] if args.no_gate
                 else gate(row, args.p99_budget_ms, args.rss_budget_mib))

@@ -301,13 +301,58 @@ DESCRIPTIONS = {
         "`coordinator_unreachable` failure reason in the log and the "
         "`fleet-window` health probe — never a generic decline.",
     "aggregator.multihost.takeover":
-        "On a mesh demotion (\"mesh minus one host\"), bump the ring "
-        "epoch and take over ingest ownership on this survivor — "
-        "displaced agents follow 421s here and replay their spool "
-        "tails. GATED to 2-host meshes (the survivor is unambiguous "
-        "by elimination); on larger meshes the takeover is skipped — "
-        "every survivor claiming the key space would split-brain "
-        "ingest — and rebalancing is an operator `apply_membership`.",
+        "On a mesh demotion (\"mesh minus one host\"), heal the ring "
+        "by DETERMINISTIC SUCCESSION at any mesh size: every survivor "
+        "probes the peer set and computes the same entitled issuer "
+        "(the incumbent lease holder while it survives, else the "
+        "lowest surviving peer), so exactly ONE survivor bumps the "
+        "epoch and broadcasts the survivor membership — displaced "
+        "agents follow 421s and replay their spool tails. Disabled, "
+        "survivors hold position \"degraded, awaiting membership\" "
+        "until an operator `apply_membership`.",
+    "aggregator.membership.auto_apply":
+        "Let the lease holder ENACT membership changes the autoscale "
+        "policy recommends (promote a standby, retire the "
+        "highest-sorting peer). Off (the default), recommendations "
+        "are surfaced only — logs, `/debug/ring`, and "
+        "`kepler_fleet_autoscale_recommended_replicas` — and "
+        "operator behavior is byte-for-byte unchanged.",
+    "aggregator.membership.autoscale_enabled":
+        "Feed each aggregation window's recorded signals (admission "
+        "load, shed deltas, ingest-latency EWMA, scoreboard states) "
+        "into the hysteresis autoscale policy. Pure function of the "
+        "signal trace: replaying the same metrics reproduces the "
+        "same decisions.",
+    "aggregator.membership.scale_up_load":
+        "Admission-load threshold at or above which a window counts "
+        "toward the scale-up streak (any shed traffic in the window "
+        "also counts).",
+    "aggregator.membership.scale_down_load":
+        "Admission-load threshold at or below which a window counts "
+        "toward the scale-down streak (only with zero shed and zero "
+        "flagged nodes). Must sit below `scaleUpLoad`; the gap is the "
+        "hysteresis dead band, where both streaks are preserved.",
+    "aggregator.membership.up_windows":
+        "Consecutive overloaded windows required before a scale-up "
+        "fires (the streak resets after firing).",
+    "aggregator.membership.down_windows":
+        "Consecutive idle windows required before a scale-down fires "
+        "— deliberately slower than scale-up so diurnal troughs "
+        "don't flap the fleet.",
+    "aggregator.membership.min_replicas":
+        "Floor the autoscale policy never recommends below.",
+    "aggregator.membership.max_replicas":
+        "Ceiling the autoscale policy never recommends above (`0` = "
+        "one step above the current replica count).",
+    "aggregator.membership.standby_peers":
+        "Warm standby replica endpoints (repeatable) the lease holder "
+        "may promote into the ring on an enacted scale-up; must not "
+        "overlap `aggregator.peers`.",
+    "aggregator.membership.probe_timeout":
+        "Per-peer bound on the liveness probe (`GET /healthz`) behind "
+        "succession and the autoscale live-node count (duration). ANY "
+        "HTTP answer proves a listener; only transport failures read "
+        "as death.",
     "aggregator.base_row_cache": "Wire-v2 delta-base LRU size: per-"
                                  "node last-keyframe state the delta "
                                  "frames merge against. Eviction "
@@ -464,6 +509,28 @@ FLAG_OF = {
     "aggregator.multihost.takeover":
         "--aggregator.multihost.takeover / "
         "--no-aggregator.multihost.takeover",
+    "aggregator.membership.auto_apply":
+        "--aggregator.membership.auto-apply / "
+        "--no-aggregator.membership.auto-apply",
+    "aggregator.membership.autoscale_enabled":
+        "--aggregator.membership.autoscale-enabled / "
+        "--no-aggregator.membership.autoscale-enabled",
+    "aggregator.membership.scale_up_load":
+        "--aggregator.membership.scale-up-load",
+    "aggregator.membership.scale_down_load":
+        "--aggregator.membership.scale-down-load",
+    "aggregator.membership.up_windows":
+        "--aggregator.membership.up-windows",
+    "aggregator.membership.down_windows":
+        "--aggregator.membership.down-windows",
+    "aggregator.membership.min_replicas":
+        "--aggregator.membership.min-replicas",
+    "aggregator.membership.max_replicas":
+        "--aggregator.membership.max-replicas",
+    "aggregator.membership.standby_peers":
+        "--aggregator.membership.standby-peers (repeatable)",
+    "aggregator.membership.probe_timeout":
+        "--aggregator.membership.probe-timeout",
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
     "telemetry.enabled": "--telemetry.enable / --no-telemetry.enable",
@@ -484,6 +551,7 @@ _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
                    "aggregator.admission_retry_after_max",
                    "agent.drain.retry_after_max",
                    "agent.wire.degraded_ttl",
+                   "aggregator.membership.probe_timeout",
                    "service.restart_backoff_initial",
                    "service.restart_backoff_max"}
 

@@ -22,7 +22,10 @@ _UNIT_TOKENS = frozenset({
     "epoch", "version",
 })
 _COUNT_TOKENS = frozenset({"nodes", "workloads", "records", "rows",
-                           "shards", "windows", "inflight"})
+                           "shards", "windows", "inflight",
+                           # elastic membership (ISSUE 16): ring
+                           # replicas are counted, not measured
+                           "peers", "replicas"})
 # reference-parity names grandfathered in (match the upstream exporter)
 _EXACT_ALLOW = frozenset({"kepler_node_cpu_power_meter"})
 

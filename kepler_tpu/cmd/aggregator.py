@@ -103,6 +103,17 @@ def main(argv: Sequence[str] | None = None) -> int:
         mesh_axes=cfg.aggregator.mesh_axes,
         multihost_enabled=cfg.aggregator.multihost.enabled,
         multihost_takeover=cfg.aggregator.multihost.takeover,
+        membership_auto_apply=cfg.aggregator.membership.auto_apply,
+        membership_autoscale=cfg.aggregator.membership.autoscale_enabled,
+        membership_scale_up_load=cfg.aggregator.membership.scale_up_load,
+        membership_scale_down_load=(
+            cfg.aggregator.membership.scale_down_load),
+        membership_up_windows=cfg.aggregator.membership.up_windows,
+        membership_down_windows=cfg.aggregator.membership.down_windows,
+        membership_min_replicas=cfg.aggregator.membership.min_replicas,
+        membership_max_replicas=cfg.aggregator.membership.max_replicas,
+        membership_standby_peers=cfg.aggregator.membership.standby_peers,
+        membership_probe_timeout=cfg.aggregator.membership.probe_timeout,
         scoreboard_cap=cfg.aggregator.scoreboard_cap,
         anomaly_z=cfg.aggregator.anomaly_z,
         peers=cfg.aggregator.peers,

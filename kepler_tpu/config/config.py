@@ -337,11 +337,53 @@ class MultihostConfig:
     # surfaced as a DISTINCT failure reason (coordinator_unreachable) in
     # the log, the return, and the fleet-window probe (0 = jax default)
     init_timeout: float = 0.0
-    # on a mesh demotion ("mesh minus one host"), bump the ring epoch
-    # and take over the whole key space on this survivor — right for
-    # 2-host meshes (the dead peer's agents must land SOMEWHERE);
-    # larger fleets should rebalance via an operator apply_membership
+    # on a mesh demotion, run coordinator-lease succession: the elected
+    # issuer (incumbent lease holder if alive, else the lowest surviving
+    # peer) bumps the ring epoch over the survivor set and broadcasts
+    # the membership — works at ANY mesh size. Off = every survivor
+    # flags itself "degraded, awaiting membership" until an operator
+    # apply_membership lands
     takeover: bool = True
+
+
+@dataclass
+class MembershipConfig:
+    """Elastic fleet membership (docs/developer/resilience.md "Elastic
+    membership"): runtime host join/leave over the coordinator lease,
+    plus the autoscale recommendation policy fed by the fleet's own
+    overload signals (admission load, shed deltas, ingest-latency EWMA,
+    scoreboard states). Recommendations are always surfaced; they are
+    ENACTED only with ``autoApply`` on — the default keeps
+    operator-driven behavior byte-for-byte."""
+
+    # enact membership changes (succession already runs under
+    # multihost.takeover; this additionally lets the lease holder
+    # enact autoscale decisions)
+    auto_apply: bool = False
+    # run the autoscale policy at all (off = no recommendation gauge,
+    # zero per-window overhead)
+    autoscale_enabled: bool = False
+    # admission load ratio at/above which a window counts toward the
+    # scale-up streak, and at/below which toward scale-down; between
+    # the two is the dead band (streaks preserved, nothing fires)
+    scale_up_load: float = 1.0
+    scale_down_load: float = 0.25
+    # consecutive overloaded/idle windows before a recommendation
+    # fires (up reacts in seconds, down in minutes — asymmetric
+    # hysteresis so a flapping load never thrashes membership)
+    up_windows: int = 3
+    down_windows: int = 12
+    # replica-count bounds the policy recommends within (maxReplicas
+    # 0 = current membership + available standby peers)
+    min_replicas: int = 1
+    max_replicas: int = 0
+    # endpoints a scale-up may promote into the membership (beyond
+    # the live peers list); empty = scale-up recommendations are
+    # surfaced but never enacted
+    standby_peers: list[str] = field(default_factory=list)
+    # bound on membership liveness probes (GET /healthz) and
+    # membership-plane POSTs
+    probe_timeout: float = 2.0
 
 
 @dataclass
@@ -435,6 +477,9 @@ class AggregatorConfig:
     mesh_axes: list[str] = field(default_factory=lambda: ["node"])
     # -- multi-host SPMD tier (docs/user/fleet.md "Multi-host") --
     multihost: MultihostConfig = field(default_factory=MultihostConfig)
+    # -- elastic membership + autoscale (docs/developer/resilience.md
+    # "Elastic membership") --
+    membership: MembershipConfig = field(default_factory=MembershipConfig)
     # -- fleet scoreboard (docs/developer/observability.md "Fleet
     # scoreboard"): per-node health table served at /debug/fleet and as
     # kepler_fleet_node_state — LRU-capped (bounds memory AND metric
@@ -630,6 +675,40 @@ class Config:
             errs.append("aggregator.peers must list exactly one replica "
                         "endpoint per multihost process (in process-"
                         "index order) when both are configured")
+        mem = agg.membership
+        if mem.scale_up_load <= 0:
+            errs.append("aggregator.membership.scaleUpLoad must be > 0")
+        if mem.scale_down_load < 0:
+            errs.append("aggregator.membership.scaleDownLoad must be >= 0")
+        if mem.scale_down_load >= mem.scale_up_load:
+            errs.append("aggregator.membership.scaleDownLoad must be "
+                        "below scaleUpLoad (the gap is the hysteresis "
+                        "dead band)")
+        if mem.up_windows < 1:
+            errs.append("aggregator.membership.upWindows must be >= 1")
+        if mem.down_windows < 1:
+            errs.append("aggregator.membership.downWindows must be >= 1")
+        if mem.min_replicas < 1:
+            errs.append("aggregator.membership.minReplicas must be >= 1")
+        if mem.max_replicas < 0:
+            errs.append("aggregator.membership.maxReplicas must be >= 0 "
+                        "(0 = membership + standby size)")
+        if mem.max_replicas and mem.max_replicas < mem.min_replicas:
+            errs.append("aggregator.membership.maxReplicas must be >= "
+                        "minReplicas (or 0)")
+        if mem.probe_timeout <= 0:
+            errs.append("aggregator.membership.probeTimeout must be > 0")
+        if any(not isinstance(p, str) or not p for p in mem.standby_peers):
+            errs.append("aggregator.membership.standbyPeers entries must "
+                        "be non-empty strings")
+        elif any(p in agg.peers for p in mem.standby_peers):
+            errs.append("aggregator.membership.standbyPeers must not "
+                        "overlap aggregator.peers (a standby is by "
+                        "definition outside the initial membership)")
+        if (mem.auto_apply or mem.autoscale_enabled) and not agg.peers:
+            errs.append("aggregator.membership.autoApply/autoscaleEnabled "
+                        "need aggregator.peers (the ingest ring is the "
+                        "membership being scaled)")
         wire = self.agent.wire
         if wire.version not in (1, 2):
             errs.append("agent.wire.version must be 1 or 2")
@@ -770,6 +849,16 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "numProcesses": "num_processes",
     "processId": "process_id",
     "initTimeout": "init_timeout",
+    "autoApply": "auto_apply",
+    "autoscaleEnabled": "autoscale_enabled",
+    "scaleUpLoad": "scale_up_load",
+    "scaleDownLoad": "scale_down_load",
+    "upWindows": "up_windows",
+    "downWindows": "down_windows",
+    "minReplicas": "min_replicas",
+    "maxReplicas": "max_replicas",
+    "standbyPeers": "standby_peers",
+    "probeTimeout": "probe_timeout",
     "admissionMaxInflight": "admission_max_inflight",
     "admissionLatencyBudget": "admission_latency_budget",
     "admissionRetryAfter": "admission_retry_after",
@@ -806,7 +895,7 @@ _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "state_max_age", "fsync_interval", "dispatch_timeout",
                     "admission_latency_budget", "admission_retry_after",
                     "admission_retry_after_max", "retry_after_max",
-                    "init_timeout"}
+                    "init_timeout", "probe_timeout"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -1014,9 +1103,57 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.multihost.takeover",
         dest="aggregator_multihost_takeover", default=None,
         action=argparse.BooleanOptionalAction,
-        help="on a mesh demotion, bump the ring epoch and take over "
-             "ingest ownership on this survivor (right for 2-host "
-             "meshes)")
+        help="on a mesh demotion, run coordinator-lease succession: "
+             "the elected issuer bumps the ring epoch over the "
+             "survivor set and broadcasts it (any mesh size)")
+    add("--aggregator.membership.auto-apply",
+        dest="aggregator_membership_auto_apply", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="let the lease holder ENACT autoscale membership changes "
+             "(off = recommendations surfaced only; operator behavior "
+             "unchanged)")
+    add("--aggregator.membership.autoscale-enabled",
+        dest="aggregator_membership_autoscale_enabled", default=None,
+        action=argparse.BooleanOptionalAction,
+        help="run the autoscale recommendation policy over the fleet's "
+             "recorded overload signals")
+    add("--aggregator.membership.scale-up-load",
+        dest="aggregator_membership_scale_up_load", default=None,
+        type=float,
+        help="admission load ratio counting a window toward the "
+             "scale-up streak")
+    add("--aggregator.membership.scale-down-load",
+        dest="aggregator_membership_scale_down_load", default=None,
+        type=float,
+        help="admission load ratio counting a window toward the "
+             "scale-down streak")
+    add("--aggregator.membership.up-windows",
+        dest="aggregator_membership_up_windows", default=None, type=int,
+        help="consecutive overloaded windows before a scale-up "
+             "recommendation fires")
+    add("--aggregator.membership.down-windows",
+        dest="aggregator_membership_down_windows", default=None,
+        type=int,
+        help="consecutive idle windows before a scale-down "
+             "recommendation fires")
+    add("--aggregator.membership.min-replicas",
+        dest="aggregator_membership_min_replicas", default=None,
+        type=int,
+        help="floor the autoscale policy never recommends below")
+    add("--aggregator.membership.max-replicas",
+        dest="aggregator_membership_max_replicas", default=None,
+        type=int,
+        help="ceiling the autoscale policy never recommends above "
+             "(0 = membership + standby size)")
+    add("--aggregator.membership.standby-peers",
+        dest="aggregator_membership_standby_peers", default=None,
+        action="append",
+        help="repeatable: replica endpoint a scale-up may promote "
+             "into the membership")
+    add("--aggregator.membership.probe-timeout",
+        dest="aggregator_membership_probe_timeout", default=None,
+        help="bound on membership liveness probes and membership-plane "
+             "POSTs, e.g. 2s")
     add("--tpu.platform", dest="tpu_platform", default=None,
         choices=["auto", "tpu", "cpu"])
     add("--tpu.fleet-backend", dest="tpu_fleet_backend", default=None,
@@ -1109,6 +1246,28 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
             args.aggregator_multihost_init_timeout)
     if args.aggregator_multihost_takeover is not None:
         mh.takeover = args.aggregator_multihost_takeover
+    mem = cfg.aggregator.membership
+    if args.aggregator_membership_auto_apply is not None:
+        mem.auto_apply = args.aggregator_membership_auto_apply
+    if args.aggregator_membership_autoscale_enabled is not None:
+        mem.autoscale_enabled = args.aggregator_membership_autoscale_enabled
+    if args.aggregator_membership_scale_up_load is not None:
+        mem.scale_up_load = args.aggregator_membership_scale_up_load
+    if args.aggregator_membership_scale_down_load is not None:
+        mem.scale_down_load = args.aggregator_membership_scale_down_load
+    if args.aggregator_membership_up_windows is not None:
+        mem.up_windows = args.aggregator_membership_up_windows
+    if args.aggregator_membership_down_windows is not None:
+        mem.down_windows = args.aggregator_membership_down_windows
+    if args.aggregator_membership_min_replicas is not None:
+        mem.min_replicas = args.aggregator_membership_min_replicas
+    if args.aggregator_membership_max_replicas is not None:
+        mem.max_replicas = args.aggregator_membership_max_replicas
+    if args.aggregator_membership_standby_peers:
+        mem.standby_peers = list(args.aggregator_membership_standby_peers)
+    if args.aggregator_membership_probe_timeout is not None:
+        mem.probe_timeout = _parse_duration(
+            args.aggregator_membership_probe_timeout)
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     set_if(("telemetry", "enabled"), args.telemetry_enable)

@@ -9,6 +9,15 @@ back per node.
 
 from kepler_tpu.fleet.agent import FleetAgent
 from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.membership import (
+    AutoscaleDecision,
+    AutoscalePolicy,
+    AutoscaleSignals,
+    CoordinatorLease,
+    MembershipError,
+    elect_successor,
+    plan_succession,
+)
 from kepler_tpu.fleet.ring import HashRing
 from kepler_tpu.fleet.scoreboard import FleetScoreboard
 from kepler_tpu.fleet.spool import Spool
@@ -20,11 +29,18 @@ from kepler_tpu.fleet.wire import (
 
 __all__ = [
     "Aggregator",
+    "AutoscaleDecision",
+    "AutoscalePolicy",
+    "AutoscaleSignals",
+    "CoordinatorLease",
     "FleetAgent",
     "FleetScoreboard",
     "HashRing",
+    "MembershipError",
     "Spool",
     "WireError",
     "decode_report",
+    "elect_successor",
     "encode_report",
+    "plan_succession",
 ]

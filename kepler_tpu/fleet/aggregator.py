@@ -51,8 +51,18 @@ from kepler_tpu.fleet.admission import (
     PRIORITY_REPLAY_GROUND,
     AdmissionController,
 )
-from kepler_tpu.fleet.ring import (HashRing, coerce_epoch, ring_from_mesh,
-                                   sanitize_peer)
+from kepler_tpu.fleet.membership import (
+    AutoscaleDecision,
+    AutoscalePolicy,
+    AutoscaleSignals,
+    CoordinatorLease,
+    MembershipError,
+    elect_successor,
+    plan_succession,
+    validate_membership_payload,
+)
+from kepler_tpu.fleet.ring import (HashRing, RingError, coerce_epoch,
+                                   ring_from_mesh, sanitize_peer)
 from kepler_tpu.fleet.wire import (
     ParsedHeader,
     WireError,
@@ -79,7 +89,7 @@ from kepler_tpu.parallel.aggregator_core import (
 )
 from kepler_tpu.parallel.fleet import (MODE_MODEL, NodeReport,
                                        assemble_fleet_batch)
-from kepler_tpu.parallel.mesh import make_mesh
+from kepler_tpu.parallel.mesh import make_mesh, submesh_for_processes
 from kepler_tpu.server.http import APIServer
 from kepler_tpu.service.lifecycle import CancelContext
 from kepler_tpu.utils.rowstore import RowStore
@@ -276,7 +286,8 @@ class _SeqTracker:
     (a seq jump is LOST windows, surfaced as a per-node counter instead
     of silence). Caller holds the aggregator's store lock."""
 
-    __slots__ = ("run", "max_seen", "seen", "order", "window", "touched")
+    __slots__ = ("run", "max_seen", "seen", "order", "window", "touched",
+                 "epoch")
 
     def __init__(self, run: str, window: int) -> None:
         self.run = run
@@ -285,6 +296,7 @@ class _SeqTracker:
         self.order: collections.deque[int] = collections.deque()
         self.window = max(1, window)
         self.touched = 0.0  # aggregator clock; drives cap eviction
+        self.epoch = 0  # ring epoch at last observe (ownership-return)
 
     def observe(self, seq: int) -> tuple[bool, int]:
         """→ (is_duplicate, windows_lost_by_this_arrival).
@@ -452,6 +464,17 @@ class Aggregator:
         multihost_enabled: bool = False,
         multihost_takeover: bool = True,
         multihost_topology: Mapping[str, Any] | None = None,
+        membership_auto_apply: bool = False,
+        membership_autoscale: bool = False,
+        membership_scale_up_load: float = 1.0,
+        membership_scale_down_load: float = 0.25,
+        membership_up_windows: int = 3,
+        membership_down_windows: int = 12,
+        membership_min_replicas: int = 1,
+        membership_max_replicas: int = 0,
+        membership_standby_peers: Sequence[str] | None = None,
+        membership_probe_timeout: float = 2.0,
+        membership_topology: Mapping[str, Any] | None = None,
         scoreboard_cap: int = 1024,
         anomaly_z: float = 4.0,
         peers: Sequence[str] | None = None,
@@ -603,6 +626,53 @@ class Aggregator:
                     f"aggregator.peers {list(self._ring.peers)!r}")
         self._last_redirect_at: float | None = None  # keplint: guarded-by=_lock
         self._last_membership_at: float | None = None  # keplint: guarded-by=_lock
+        # -- elastic membership (ISSUE 16): coordinator lease +
+        # deterministic succession + runtime join/leave + autoscale.
+        # The lease is DERIVED state, advanced in lock-step with the
+        # ring epoch by apply_membership; its initial holder is the
+        # lowest configured peer, so every replica starts agreeing.
+        # Succession (plan_succession) replaces the old 2-host-only
+        # takeover gate: on a host death at ANY mesh size exactly one
+        # survivor — the incumbent holder while it lives, else the
+        # lowest surviving peer — issues the survivor membership.
+        self._lease: CoordinatorLease | None = None
+        if self._ring is not None:
+            self._lease = CoordinatorLease(
+                elect_successor(self._config_peers),
+                epoch=self._ring.epoch)
+        mtopo = dict(membership_topology or {})
+        # test seams for the liveness probe and the membership POST
+        # (defaults: HTTP /healthz GET and /v1/membership POST)
+        self._peer_alive_fn = mtopo.get("peer_alive")
+        self._deliver_fn = mtopo.get("deliver")
+        self._membership_probe_timeout = max(
+            0.1, float(membership_probe_timeout))
+        self._membership_auto_apply = bool(membership_auto_apply)
+        self._standby_peers = list(membership_standby_peers or [])
+        # "degraded, awaiting membership": a survivor that is NOT the
+        # succession issuer (or has succession disabled) holds position
+        # until the issuer's membership broadcast arrives — surfaced by
+        # the fleet-window probe and the awaiting gauge
+        self._awaiting_membership = False  # keplint: guarded-by=_results_lock
+        # armed fabric incarnation for the next mesh-path membership (a
+        # rejoin's fresh HostLocalFabric; production analog: restart the
+        # jax.distributed job before re-applying the full set)
+        self._mesh_arm: Any = None
+        self._mesh_elastic: Any = None  # live (possibly sub-) mesh
+        self._membership_rejected: dict[str, int] = {}  # keplint: guarded-by=_lock
+        self._membership_applied: dict[str, int] = {}  # keplint: guarded-by=_lock
+        self._autoscale: AutoscalePolicy | None = None
+        if membership_autoscale:
+            self._autoscale = AutoscalePolicy(
+                scale_up_load=membership_scale_up_load,
+                scale_down_load=membership_scale_down_load,
+                up_windows=membership_up_windows,
+                down_windows=membership_down_windows,
+                min_replicas=membership_min_replicas,
+                max_replicas=membership_max_replicas)
+        self._autoscale_last: AutoscaleDecision | None = None  # keplint: guarded-by=_results_lock
+        self._autoscale_decisions: dict[str, int] = {}  # keplint: guarded-by=_results_lock
+        self._autoscale_shed_seen = 0
         # overload control (ISSUE 12): an AdmissionController in front of
         # the ingest path sheds with 429 + Retry-After BEFORE decode work
         # when the inflight or latency budget is blown — priority-aware,
@@ -815,6 +885,11 @@ class Aggregator:
                               "consistent-hash ingest ring: membership "
                               "epoch, peers, ownership share, redirect "
                               "counters", self._handle_ring_debug)
+        if self._ring is not None:
+            self._server.register("/v1/membership", "Elastic membership",
+                                  "POST apply/join/leave membership "
+                                  "operations (coordinator-lease gated)",
+                                  self._handle_membership)
         health = getattr(self._server, "health", None)
         if health is not None:
             health.register_probe("fleet-aggregator", self.health)
@@ -873,67 +948,98 @@ class Aggregator:
             return False
         from kepler_tpu.parallel.mesh import NODE_AXIS
 
-        mesh = self._mesh
+        mesh = self._live_mesh()
         n_dev = mesh.devices.size
         if n_dev < 2 or dict(mesh.shape).get(NODE_AXIS, 0) != n_dev:
             return False
         proc = self._device_process_fn()
         return len({proc(d) for d in mesh.devices.flat}) > 1
 
+    def _live_mesh(self) -> Any:
+        """The mesh the multi-host tier currently runs on: the full
+        configured mesh, or the elastic submesh the last mesh-path
+        membership restored over a peer subset."""
+        return (self._mesh_elastic if self._mesh_elastic is not None
+                else self._mesh)
+
     def _local_mesh(self) -> Any:
         """The surviving single-host mesh after a mesh demotion: this
         process's own devices, 1-D over node."""
-        proc = self._device_process_fn()
-        me = self._self_process()
-        devs = [d for d in self._mesh.devices.flat if proc(d) == me]
-        return make_mesh([len(devs)], devices=devs)
+        return submesh_for_processes(self._mesh, [self._self_process()],
+                                     self._device_process_fn())
 
     def _multihost_host_count(self) -> int:
         if self._mesh is None:
             return 1
         proc = self._device_process_fn()
-        return len({proc(d) for d in self._mesh.devices.flat})
+        return len({proc(d) for d in self._live_mesh().devices.flat})
 
     def _demote_mesh(self, reason: str) -> None:
-        """The "mesh minus one host" rung: a cross-host window failure
-        (dead peer, broken collective, fabric loss) permanently retires
-        the multi-host engine in this process — a dead
-        ``jax.distributed`` peer cannot rejoin a running job, so unlike
-        the single-host ladder this demotion never re-promotes. The
-        survivors' rung 0 becomes their own single-host sharded engine
-        (full ring re-seed via the engine rebuild), and with the ingest
-        ring enabled the membership epoch bumps so displaced agents
-        follow 421s to the new owner and replay their spool tails —
-        the existing hand-off machinery, zero windows lost.
+        """The "mesh minus one host" tier: a cross-host window failure
+        (dead peer, broken collective, fabric loss) retires the
+        multi-host engine in this process — the survivors' rung 0
+        becomes their own single-host sharded engine (full ring
+        re-seed via the engine rebuild). Within the current fabric
+        incarnation the demotion is sticky; a rejoin
+        (``/v1/membership`` join + :meth:`arm_mesh`) restores the
+        multi-host tier under a NEW incarnation.
 
-        The automatic TAKEOVER (this survivor claims the whole key
-        space) runs only on a 2-HOST mesh, where the survivor is
-        unambiguous by elimination. On larger meshes every survivor
-        sees the same cross-host failure — N replicas each claiming
-        100% at the same epoch would split-brain ingest (double
-        attribution, conflicting 421 owners), so rebalancing is left
-        to the operator's ``apply_membership``."""
+        Ring healing runs by DETERMINISTIC SUCCESSION at any mesh
+        size (ISSUE 16; the old 2-host-only takeover gate is
+        retired): every survivor probes the peer set and computes the
+        same entitled issuer — the incumbent lease holder while it
+        survives, else the lowest surviving peer. Exactly ONE
+        survivor therefore bumps the epoch and broadcasts the
+        survivor membership; the rest hold position "degraded,
+        awaiting membership" until the broadcast lands. The
+        equal-epoch conflict check at apply stays as the backstop a
+        partitioned prober could still trip. Displaced agents follow
+        421s to the new owners and replay their spool tails — the
+        existing hand-off machinery, zero windows lost."""
         self._engine = None  # next window rebuilds over the local mesh
         self._engine_serial = None  # its pinned device must be LOCAL
+        self._mesh_elastic = None  # the elastic submesh died with the peer
         log.error("multi-host mesh degraded (%s): demoting to the "
                   "single-host engine over this process's devices; "
                   "displaced agents will be redirected by epoch bump",
                   reason)
-        if self._ring is None or not self._multihost_takeover:
+        if self._ring is None:
             return
-        if self._multihost_host_count() != 2:
-            log.error(
-                "mesh-demotion ring takeover SKIPPED: %d-host mesh — "
-                "every survivor would claim the whole key space "
-                "(split-brain); rebalance the surviving peers via an "
-                "operator apply_membership",
-                self._multihost_host_count())
+        if not self._multihost_takeover:
+            # succession disabled: the operator owns the rebalance —
+            # flag the wait so the probe says WHY ingest is degraded
+            with self._results_lock:
+                self._awaiting_membership = True
             return
+        survivors = self._probe_survivors()
+        if set(survivors) == set(self._ring.peers):
+            # the issuer's broadcast landed BEFORE this process noticed
+            # the death: membership already reflects the survivor set,
+            # so there is neither a bump to issue nor one to await
+            return
+        holder = self._lease.holder if self._lease is not None else ""
+        issuer = plan_succession(holder, survivors)
+        if issuer != self._self_peer:
+            with self._results_lock:
+                self._awaiting_membership = True
+            log.warning(
+                "mesh demotion: membership succession belongs to "
+                "surviving peer %s (lease %s) — holding position, "
+                "awaiting its membership broadcast", issuer,
+                self._lease.lease_id if self._lease is not None
+                else "?")
+            return
+        epoch = self._ring.epoch + 1
         try:
-            self.apply_membership([self._self_peer],
-                                  self._ring.epoch + 1)
+            self.apply_membership(survivors, epoch,
+                                  source="succession",
+                                  issuer=self._self_peer)
         except ValueError as err:
-            log.error("mesh-demotion ring takeover failed: %s", err)
+            log.error("mesh-demotion succession failed: %s", err)
+            with self._results_lock:
+                self._awaiting_membership = True
+            return
+        self._broadcast_membership(survivors, epoch)
 
     def run(self, ctx: CancelContext) -> None:
         while not ctx.cancelled():
@@ -1369,6 +1475,23 @@ class Aggregator:
                                                stored.seq - 1)
                     self._seq_trackers[report.node_name] = tracker
                 tracker.touched = received
+                # ownership RETURN (elastic membership): this replica
+                # owned the node under an earlier epoch, lost it to a
+                # join/scale-up, and got it back on a leave/succession.
+                # Its tracker slept through the away period, but the
+                # agent's watermark vouches those windows were 2xx'd by
+                # the interim owner — delivered, not lost. Gated on an
+                # actual epoch advance and min()-clamped exactly like
+                # fresh-tracker seeding, so with membership at rest an
+                # inflated watermark still hides nothing.
+                ring_epoch = (self._ring.epoch
+                              if self._ring is not None else 0)
+                if (ring_epoch > tracker.epoch
+                        and acked_through > tracker.max_seen):
+                    tracker.max_seen = max(
+                        tracker.max_seen,
+                        min(acked_through, stored.seq - 1))
+                tracker.epoch = ring_epoch
                 dup, lost = tracker.observe(stored.seq)
                 if dup:
                     # at-least-once redelivery (spool replay, LB retry):
@@ -1444,27 +1567,121 @@ class Aggregator:
 
     # -- ingest ring (HA ingest tier) --------------------------------------
 
-    def apply_membership(self, peers: Sequence[str], epoch: int) -> int:
-        """Adopt a new replica membership (an operator action: config
-        rollout, or the chaos suite's kill/rebalance): swap in a NEW
-        ring at a HIGHER epoch and drop stored reports for nodes this
-        replica no longer owns — their agents get redirected on their
-        next send, and a stale local copy must not keep attributing
-        them here meanwhile. Seq trackers are KEPT (bounded by their
-        cap): if ownership bounces back, dedup continuity absorbs the
-        re-delivered overlap. Returns the number of nodes handed off."""
+    def apply_membership(self, peers: Sequence[str], epoch: int, *,
+                         source: str = "operator", issuer: str = "",
+                         mesh: bool = False) -> int:
+        """Adopt a new replica membership — the operator action it has
+        always been (config rollout, chaos rebalance), and now ALSO
+        the elastic plane's one write path: succession after a host
+        death, join/leave fan-out from the lease holder, autoscale
+        enactment. Swaps in a NEW ring at a HIGHER epoch and drops
+        stored reports for nodes this replica no longer owns — their
+        agents get redirected on their next send and replay their
+        spool tails to the new owner. Seq trackers are KEPT (bounded
+        by their cap): if ownership bounces back, dedup continuity
+        absorbs the re-delivered overlap.
+
+        Epoch semantics (ISSUE 16): re-applying the SAME peer set at
+        the CURRENT epoch is an idempotent replay (returns 0 — a
+        re-delivered broadcast, indistinguishable from a no-op); the
+        same epoch with a DIFFERENT set is the split-brain detector
+        firing — rejected loudly as ``equal_epoch_conflict`` and
+        counted in ``kepler_fleet_membership_rejected_total``. A
+        lower epoch is ``stale_epoch``. ``source`` labels the
+        applied/rejected counters; ``issuer`` (default: succession
+        over the new set) advances the coordinator lease in lock-step
+        with the ring.
+
+        A non-operator membership that EXCLUDES this replica retires
+        it: the new ring is adopted anyway, every stored node is
+        dropped, and all future ingest answers 421 toward the real
+        owners — the scale-down path. The operator path keeps the
+        strict self-in-set check (excluding yourself by hand is
+        almost certainly a typo). ``mesh=True`` asks for the
+        mesh-derived ring (and multi-host engine) to be restored over
+        the new set — the rejoin path; it needs the peers to be a
+        process-ordered subset of the configured list (and, after a
+        fabric loss, a fresh incarnation via :meth:`arm_mesh`), and
+        falls back to the plain hash ring otherwise.
+
+        Returns the number of nodes handed off. Raises
+        :class:`MembershipError` (a ``ValueError``) on rejection."""
+        try:
+            return self._apply_membership_checked(
+                peers, epoch, source=source, issuer=issuer, mesh=mesh)
+        except MembershipError as err:
+            with self._lock:
+                self._membership_rejected[err.reason] = \
+                    self._membership_rejected.get(err.reason, 0) + 1
+            log.error("membership rejected (%s, source=%s): %s",
+                      err.reason, source, err)
+            raise
+
+    def _apply_membership_checked(self, peers: Sequence[str],
+                                  epoch: int, *, source: str,
+                                  issuer: str, mesh: bool) -> int:
         if self._ring is None:
-            raise ValueError(
+            raise MembershipError(
+                "ring_disabled",
                 "ingest ring is not enabled (aggregator.peers is empty)")
-        new = self._ring.with_members(peers, epoch)
-        if self._self_peer not in new:
-            raise ValueError(
+        ep = coerce_epoch(epoch)
+        if ep is None or ep < 1:
+            raise MembershipError(
+                "bad_epoch",
+                f"membership epoch must be a positive int, got {epoch!r}")
+        cleaned: list[str] = []
+        for raw in peers:
+            peer = sanitize_peer(raw)
+            if peer is None:
+                raise MembershipError(
+                    "bad_peer", f"invalid membership peer {raw!r}")
+            if peer not in cleaned:
+                cleaned.append(peer)
+        if not cleaned:
+            raise MembershipError("bad_peer",
+                                  "membership needs at least one peer")
+        current = self._ring
+        if ep < current.epoch:
+            raise MembershipError(
+                "stale_epoch",
+                f"membership epoch {ep} is behind the current epoch "
+                f"{current.epoch}")
+        if ep == current.epoch:
+            if set(cleaned) == set(current.peers):
+                # idempotent replay: a re-delivered broadcast, or an
+                # operator re-running the change they already made
+                log.info("membership replay at epoch %d ignored (same "
+                         "peer set, digest %s)", ep,
+                         current.membership_digest)
+                return 0
+            raise MembershipError(
+                "equal_epoch_conflict",
+                f"membership at epoch {ep} already applied with a "
+                f"DIFFERENT peer set (digest "
+                f"{current.membership_digest}); a second writer "
+                f"proposed {sorted(set(cleaned))!r}")
+        retired = self._self_peer not in cleaned
+        if retired and source == "operator":
+            raise MembershipError(
+                "self_excluded",
                 f"self peer {self._self_peer!r} is not in the new "
-                f"membership {list(new.peers)!r}")
+                f"membership {sorted(cleaned)!r}")
+        new = self._build_ring(cleaned, ep, mesh=mesh)
+        who = issuer or plan_succession(
+            self._lease.holder if self._lease is not None else "",
+            new.peers)
         with self._lock:
             self._ring = new
+            # the lease advances in lock-step with the ring epoch —
+            # adopt cannot conflict here (ep > current epoch by the
+            # checks above), so succession state never splits from
+            # membership state
+            if self._lease is not None:
+                self._lease.adopt(who, ep)
+            else:
+                self._lease = CoordinatorLease(who, ep)
             dropped = [n for n in self._reports
-                       if new.owner(n) != self._self_peer]
+                       if retired or new.owner(n) != self._self_peer]
             for name in dropped:
                 del self._reports[name]
                 self._history.pop(name, None)
@@ -1476,10 +1693,490 @@ class Aggregator:
                 # here would age into a permanent false 'stale' signal
                 self._scoreboard.drop(name)
             self._last_membership_at = self._clock()
+            self._membership_applied[source] = \
+                self._membership_applied.get(source, 0) + 1
+        if self._multihost_enabled:
+            # elastic rebuild, the PR-6 ladder-reset invariant: sticky
+            # maps cleared, rings re-seeded — the next window does a
+            # full re-pack over the new member set
+            self._engine = None
+            self._engine_serial = None
+        with self._results_lock:
+            self._awaiting_membership = False
         log.warning("ingest ring membership changed: epoch %d, %d "
-                    "peer(s), %d node(s) handed off", new.epoch,
-                    len(new), len(dropped))
+                    "peer(s) (digest %s, issuer %s, source %s), %d "
+                    "node(s) handed off%s", new.epoch, len(new),
+                    new.membership_digest, who, source, len(dropped),
+                    (" — this replica RETIRED (owns nothing, redirects "
+                     "everything)" if retired else ""))
         return len(dropped)
+
+    def _build_ring(self, peers: list[str], epoch: int,
+                    mesh: bool) -> HashRing:
+        """The new ring for a membership change: the mesh-derived ring
+        when a mesh restore was requested AND the topology can honor
+        it — the peers must be a >=2-process subset of the configured
+        process-ordered list (ownership co-location is only true for
+        processes the device mesh actually contains); otherwise the
+        plain consistent-hash ring."""
+        if mesh and self._multihost_enabled and self._mesh is not None:
+            want = set(peers)
+            procs = [i for i, p in enumerate(self._config_peers)
+                     if p in want]
+            if len(procs) == len(want) and len(procs) >= 2:
+                armed, self._mesh_arm = self._mesh_arm, None
+                if armed is not None:
+                    # a rejoin's fresh fabric incarnation (the old
+                    # one's barriers died with the departed peer)
+                    self._mh_fabric = armed
+                proc = self._device_process_fn()
+                sub = submesh_for_processes(self._mesh, procs, proc)
+                order = {p: k for k, p in enumerate(procs)}
+                shard_procs = [order[int(proc(d))]
+                               for d in sub.devices.flat]
+                peers_by_proc = [self._config_peers[p] for p in procs]
+                self._mesh_elastic = sub
+                with self._results_lock:
+                    self._mesh_degraded = False
+                log.info("mesh-derived ring restored over %d process(es) "
+                         "(%d shards) at epoch %d", len(procs),
+                         len(shard_procs), epoch)
+                return ring_from_mesh(peers_by_proc, shard_procs,
+                                      epoch=epoch)
+            log.warning("mesh-path membership cannot be honored (peers "
+                        "%r are not a >=2-process subset of the "
+                        "configured process-ordered list); falling back "
+                        "to the plain hash ring", sorted(want))
+        if self._multihost_enabled:
+            # a non-mesh membership while the multi-host tier runs
+            # means the mesh no longer describes ownership: survivors
+            # serve their ring share from their own single-host
+            # engines until a mesh-path membership restores the tier
+            self._mesh_elastic = None
+            with self._results_lock:
+                if self._multihost_active():
+                    self._mesh_degraded = True
+        try:
+            return self._ring.with_members(peers, epoch)
+        except RingError as err:
+            raise MembershipError("bad_peer", str(err))
+
+    # -- elastic membership plane (ISSUE 16) -------------------------------
+
+    def arm_mesh(self, fabric: Any) -> None:
+        """Arm a fresh fabric incarnation for the NEXT mesh-path
+        membership (the rejoin/restore handshake): the virtual
+        topology passes its new :class:`HostLocalFabric`; production's
+        analog is restarting the ``jax.distributed`` job before
+        re-applying the full membership (a dead peer cannot rejoin a
+        RUNNING job — see docs/developer/resilience.md). One-shot:
+        consumed by the next ``apply_membership(..., mesh=True)``."""
+        self._mesh_arm = fabric
+
+    def _peer_alive(self, peer: str) -> bool:
+        """Liveness probe for one peer: the injected seam, or an HTTP
+        GET of its ``/healthz`` — ANY HTTP answer (even 503) proves a
+        listener; only transport failures read as death."""
+        probe = self._peer_alive_fn
+        if probe is not None:
+            try:
+                return bool(probe(peer))
+            except Exception:
+                return False
+        import urllib.error
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://{peer}/healthz",
+                    timeout=self._membership_probe_timeout):
+                return True
+        except urllib.error.HTTPError:
+            return True
+        except Exception:
+            return False
+
+    def _probe_survivors(self) -> list[str]:
+        """The current peer set filtered by liveness (self is alive by
+        definition). Every survivor runs the same probe over the same
+        set, so — probe flakes aside, which the equal-epoch conflict
+        check backstops — they compute the same survivor list and
+        therefore the same succession issuer."""
+        ring = self._ring
+        if ring is None:
+            return [self._self_peer]
+        return [peer for peer in ring.peers
+                if peer == self._self_peer or self._peer_alive(peer)]
+
+    def _deliver_membership(self, peer: str,
+                            payload: Mapping[str, Any]) -> dict:
+        """POST one membership payload to ``peer`` (the injected seam,
+        or HTTP ``/v1/membership``) and return its JSON reply.
+        Transport failures return a structured not-ok reply instead of
+        raising — broadcast is best-effort; a replica a broadcast
+        misses converges via the epoch headers and the equal-epoch
+        replay guard."""
+        deliver = self._deliver_fn
+        if deliver is not None:
+            try:
+                reply = deliver(peer, dict(payload))
+            except Exception as err:
+                return {"ok": False, "reason": "unreachable",
+                        "detail": str(err)[:240]}
+            if isinstance(reply, Mapping):
+                return dict(reply)
+            return {"ok": False, "reason": "bad_reply"}
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{peer}/v1/membership",
+            data=json.dumps(dict(payload)).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self._membership_probe_timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            try:
+                return json.loads(err.read() or b"{}")
+            except Exception:
+                return {"ok": False, "reason": "unreachable",
+                        "detail": f"http {err.code}"}
+        except Exception as err:
+            return {"ok": False, "reason": "unreachable",
+                    "detail": str(err)[:240]}
+
+    def _broadcast_membership(self, peers: Sequence[str], epoch: int,
+                              extra: Sequence[str] = (),
+                              mesh: bool = False) -> None:
+        """Fan the just-applied membership out to every OTHER member
+        (plus ``extra`` — e.g. a peer the membership just removed, so
+        it retires instead of serving a stale ring)."""
+        # the issuer is the CURRENT lease holder, not necessarily this
+        # replica: a holder retiring itself (leave) hands the lease to
+        # its successor in the local apply, and the fan-out must carry
+        # that successor or receivers would adopt the departed holder
+        issuer = self._self_peer
+        if self._lease is not None and self._lease.holder:
+            issuer = self._lease.holder
+        payload: dict[str, Any] = {
+            "op": "apply", "peers": list(peers), "epoch": int(epoch),
+            "issuer": issuer, "mesh": bool(mesh)}
+        if self._lease is not None:
+            payload["lease"] = self._lease.lease_id
+        for peer in sorted(set(peers) | set(extra)):
+            if peer == self._self_peer:
+                continue
+            reply = self._deliver_membership(peer, payload)
+            if not reply.get("ok", False):
+                log.warning("membership broadcast to %s not applied: %s",
+                            peer, reply.get("reason", "unknown"))
+
+    def request_join(self, *, mesh: bool = False, via: str = "") -> dict:
+        """Rejoin/new-host registration, run on the JOINING replica:
+        register with the lease holder (``via`` overrides the first
+        peer to ask), follow ``not_leader`` redirects, then adopt the
+        returned membership — ring at the granted epoch, INCUMBENT
+        holder from the reply (a rejoining peer therefore never
+        self-elects over a live lease, even when it sorts lowest), and
+        with ``mesh=True`` the mesh-derived ring + multi-host engine
+        over the restored set. Returns the holder's reply."""
+        if self._ring is None:
+            raise MembershipError(
+                "ring_disabled",
+                "ingest ring is not enabled (aggregator.peers is empty)")
+        payload = {"op": "join", "peer": self._self_peer,
+                   "mesh": bool(mesh)}
+        candidates: list[str] = []
+        if via and via != self._self_peer:
+            candidates.append(via)
+        holder = self._lease.holder if self._lease is not None else ""
+        if holder and holder != self._self_peer \
+                and holder not in candidates:
+            candidates.append(holder)
+        for p in self._ring.peers:
+            if p != self._self_peer and p not in candidates:
+                candidates.append(p)
+        reply: dict = {"ok": False, "reason": "unreachable",
+                       "detail": "no peer to register with"}
+        hops = 0
+        max_hops = len(self._ring.peers) + 2
+        while candidates and hops < max_hops:
+            target = candidates.pop(0)
+            hops += 1
+            reply = self._deliver_membership(target, payload)
+            if reply.get("reason") == "not_leader":
+                nxt = sanitize_peer(reply.get("holder"))
+                if nxt and nxt != self._self_peer \
+                        and nxt != target:
+                    candidates.insert(0, nxt)
+                continue
+            if reply.get("ok"):
+                break
+        if not reply.get("ok"):
+            with self._lock:
+                self._membership_rejected["join_failed"] = \
+                    self._membership_rejected.get("join_failed", 0) + 1
+            raise MembershipError(
+                "join_failed",
+                f"no lease holder accepted the join: "
+                f"{reply.get('reason', 'unreachable')}")
+        peers = [sanitize_peer(p) for p in reply.get("peers", [])]
+        epoch = coerce_epoch(reply.get("epoch"))
+        granted_holder = sanitize_peer(reply.get("holder")) or ""
+        if epoch is None or not peers or any(p is None for p in peers):
+            raise MembershipError(
+                "bad_payload",
+                "join reply did not carry a valid membership")
+        try:
+            self.apply_membership(peers, epoch, source="join",
+                                  issuer=granted_holder, mesh=mesh)
+        except MembershipError as err:
+            # the holder's broadcast may have raced ahead of the reply
+            # (our epoch already advanced) — that is convergence, not
+            # failure; anything else propagates
+            if err.reason != "stale_epoch":
+                raise
+        if granted_holder and self._lease is not None and epoch is not None:
+            try:
+                # an equal-epoch replay above skips the lease adopt —
+                # take the incumbent from the reply explicitly
+                self._lease.adopt(granted_holder, epoch)
+            except MembershipError:
+                pass  # a fresher lease was already adopted locally
+        return reply
+
+    def _membership_join(self, peer: str, mesh: bool
+                         ) -> tuple[int, dict[str, str], bytes]:
+        """Lease-holder handling of a join registration: fold the peer
+        into the membership at epoch+1, fan out, and answer the joiner
+        with the full adopted state (peers, epoch, holder) — the
+        joiner ADOPTS the incumbent lease from this reply."""
+        ring, lease = self._ring, self._lease
+        if peer in ring.peers:
+            # idempotent re-registration: answer the current state
+            body = {"ok": True, "epoch": ring.epoch,
+                    "peers": list(ring.peers),
+                    "holder": lease.holder if lease else "",
+                    "lease": lease.lease_id if lease else "",
+                    "already_member": True}
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(body).encode())
+        peers = sorted(set(ring.peers) | {peer})
+        epoch = ring.epoch + 1
+        try:
+            self.apply_membership(peers, epoch, source="join",
+                                  issuer=self._self_peer, mesh=mesh)
+        except MembershipError as err:
+            body = {"ok": False, "reason": err.reason,
+                    "error": str(err)}
+            return (409, {"Content-Type": "application/json"},
+                    json.dumps(body).encode())
+        self._broadcast_membership(peers, epoch, mesh=mesh)
+        ring, lease = self._ring, self._lease
+        body = {"ok": True, "epoch": ring.epoch,
+                "peers": list(ring.peers),
+                "holder": lease.holder if lease else "",
+                "lease": lease.lease_id if lease else ""}
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(body).encode())
+
+    def _membership_leave(self, peer: str
+                          ) -> tuple[int, dict[str, str], bytes]:
+        """Lease-holder handling of a graceful leave: drop the peer at
+        epoch+1 and fan out — INCLUDING to the leaver, whose wire
+        apply retires it (it keeps the new ring it is not in and
+        redirects everything)."""
+        ring = self._ring
+        if peer not in ring.peers:
+            body = {"ok": True, "epoch": ring.epoch,
+                    "peers": list(ring.peers), "already_left": True}
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(body).encode())
+        remaining = sorted(set(ring.peers) - {peer})
+        epoch = ring.epoch + 1
+        try:
+            # issuer defaults to succession over the remaining set, so
+            # the holder leaving ITSELF hands the lease to the lowest
+            # survivor in the same apply
+            self.apply_membership(remaining, epoch, source="leave")
+        except MembershipError as err:
+            body = {"ok": False, "reason": err.reason,
+                    "error": str(err)}
+            return (409, {"Content-Type": "application/json"},
+                    json.dumps(body).encode())
+        self._broadcast_membership(remaining, epoch, extra=[peer])
+        ring, lease = self._ring, self._lease
+        body = {"ok": True, "epoch": ring.epoch,
+                "peers": list(ring.peers),
+                "holder": lease.holder if lease else "",
+                "lease": lease.lease_id if lease else ""}
+        return (200, {"Content-Type": "application/json"},
+                json.dumps(body).encode())
+
+    def _membership_reject(self, status: int, reason: str, detail: str
+                           ) -> tuple[int, dict[str, str], bytes]:
+        with self._lock:
+            self._membership_rejected[reason] = \
+                self._membership_rejected.get(reason, 0) + 1
+        body = {"ok": False, "reason": reason, "error": detail}
+        return (status, {"Content-Type": "application/json"},
+                json.dumps(body).encode())
+
+    def _handle_membership(
+            self, request: Any) -> tuple[int, dict[str, str], bytes]:
+        """``POST /v1/membership``: the elastic-membership wire plane.
+        Ops: ``apply`` (adopt an issuer's membership — the broadcast
+        receiver), ``join`` (a rejoining/new replica registers with
+        the lease holder), ``leave`` (graceful scale-down). Every
+        field is laundered by ``validate_membership_payload`` before
+        it can steer the ring, reach a log line, or key a metric; a
+        non-holder answers join/leave with a structured ``not_leader``
+        redirect naming the holder (the membership plane's 421)."""
+        if request.command != "POST":
+            return (405, {"Content-Type": "text/plain"},
+                    b"POST membership operations\n")
+        try:
+            raw = json.loads(request.body or b"{}")
+        except ValueError:
+            return self._membership_reject(
+                400, "bad_payload", "membership body must be JSON")
+        try:
+            cleaned = validate_membership_payload(raw)
+        except MembershipError as err:
+            return self._membership_reject(400, err.reason, str(err))
+        op = cleaned.get("op")
+        if op == "apply":
+            if "peers" not in cleaned or "epoch" not in cleaned:
+                return self._membership_reject(
+                    400, "bad_payload",
+                    "membership apply needs peers and epoch")
+            try:
+                dropped = self.apply_membership(
+                    cleaned["peers"], cleaned["epoch"], source="wire",
+                    issuer=cleaned.get("issuer", ""),
+                    mesh=cleaned["mesh"])
+            except MembershipError as err:
+                # already counted by apply_membership's wrapper
+                body = {"ok": False, "reason": err.reason,
+                        "error": str(err),
+                        "epoch": (self._ring.epoch
+                                  if self._ring is not None else 0)}
+                return (409, {"Content-Type": "application/json"},
+                        json.dumps(body).encode())
+            ring, lease = self._ring, self._lease
+            body = {"ok": True, "dropped": dropped,
+                    "epoch": ring.epoch if ring is not None else 0,
+                    "holder": lease.holder if lease else ""}
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(body).encode())
+        if op in ("join", "leave"):
+            if self._ring is None:
+                return self._membership_reject(
+                    409, "ring_disabled",
+                    "ingest ring is not enabled on this replica")
+            peer = cleaned.get("peer")
+            if not peer:
+                return self._membership_reject(
+                    400, "bad_payload", f"membership {op} needs peer")
+            lease = self._lease
+            if lease is None or lease.holder != self._self_peer:
+                body = {"ok": False, "reason": "not_leader",
+                        "holder": lease.holder if lease else "",
+                        "epoch": self._ring.epoch}
+                return (421, {"Content-Type": "application/json"},
+                        json.dumps(body).encode())
+            if op == "join":
+                return self._membership_join(peer, cleaned["mesh"])
+            return self._membership_leave(peer)
+        return self._membership_reject(
+            400, "bad_op", "membership payload needs an op "
+            "(apply | join | leave)")
+
+    # -- autoscale (ISSUE 16) ----------------------------------------------
+
+    def _autoscale_tick(self) -> None:
+        """One autoscale observation per aggregation interval: fold
+        the fleet's already-recorded signals (admission load, shed
+        deltas, ingest-latency EWMA, scoreboard states) into the
+        hysteresis policy. Recommendations are always surfaced (gauge
+        + log); they are ENACTED — through the same apply_membership
+        plane as every other change — only when
+        ``aggregator.membership.autoApply`` is on AND this replica
+        holds the lease, so ``autoApply=false`` keeps operator-driven
+        behavior byte-for-byte."""
+        policy = self._autoscale
+        if policy is None or self._ring is None:
+            return
+        ctrl = self._admission
+        shed_total = (sum(ctrl.shed_by_reason().values())
+                      if ctrl is not None else 0)
+        now = self._clock()
+        with self._lock:
+            live_nodes = len(self._reports)
+            states = self._scoreboard.states(now, self._stale_after)
+        flagged = sum(1 for code in states.values() if code != 0)
+        sig = AutoscaleSignals(
+            load=ctrl.load() if ctrl is not None else 0.0,
+            shed_delta=max(0, shed_total - self._autoscale_shed_seen),
+            ingest_latency_s=(ctrl.latency_ewma()
+                              if ctrl is not None else 0.0),
+            live_nodes=live_nodes, flagged_nodes=flagged,
+            replicas=len(self._ring))
+        self._autoscale_shed_seen = shed_total
+        decision = policy.observe(sig)
+        with self._results_lock:
+            self._autoscale_last = decision
+            self._autoscale_decisions[decision.direction] = \
+                self._autoscale_decisions.get(decision.direction, 0) + 1
+        if decision.direction == "hold":
+            return
+        log.warning("autoscale recommendation: scale %s to %d "
+                    "replica(s) — %s", decision.direction,
+                    decision.replicas, decision.reason)
+        if not self._membership_auto_apply:
+            return
+        lease = self._lease
+        if lease is None or lease.holder != self._self_peer:
+            return  # only the lease holder enacts membership
+        try:
+            self._enact_scale(decision)
+        except ValueError as err:
+            log.error("autoscale enactment failed: %s", err)
+
+    def _enact_scale(self, decision: AutoscaleDecision) -> None:
+        """Turn one non-hold autoscale decision into a membership:
+        scale-up promotes the first unused
+        ``aggregator.membership.standbyPeers`` entry; scale-down
+        retires the highest-sorting non-holder peer (deterministic,
+        and never the lease holder — that would orphan the lease
+        mid-change)."""
+        ring = self._ring
+        current = set(ring.peers)
+        extra: list[str] = []
+        if decision.direction == "up":
+            pool = [p for p in self._standby_peers if p not in current]
+            if not pool:
+                log.warning(
+                    "autoscale wants %d replicas but "
+                    "aggregator.membership.standbyPeers has no unused "
+                    "entry — recommendation stands, nothing enacted",
+                    decision.replicas)
+                return
+            peers = sorted(current | {pool[0]})
+        else:
+            victims = [p for p in sorted(current, reverse=True)
+                       if p != self._self_peer]
+            if not victims:
+                return
+            peers = sorted(current - {victims[0]})
+            extra = [victims[0]]
+        epoch = ring.epoch + 1
+        self.apply_membership(peers, epoch, source="autoscale",
+                              issuer=self._self_peer)
+        self._broadcast_membership(peers, epoch, extra=extra)
 
     def ring_health(self) -> dict:
         """``fleet-ring`` probe for /healthz: degraded while a hand-off
@@ -1496,13 +2193,25 @@ class Aggregator:
         settling = any(
             t is not None and now - t <= self._degraded_ttl
             for t in (last_redirect, last_membership))
+        with self._results_lock:
+            awaiting = self._awaiting_membership
+        lease = self._lease
         out = {
-            "ok": not settling,
+            "ok": not settling and not awaiting,
             "epoch": ring.epoch if ring is not None else 0,
             "peers": len(ring) if ring is not None else 0,
             "self": self._self_peer,
             "redirected_total": redirected,
+            "lease_holder": lease.holder if lease is not None else "",
+            "lease_epoch": lease.epoch if lease is not None else 0,
         }
+        if awaiting:
+            out["awaiting_membership"] = True
+            out["detail"] = ("degraded, awaiting membership: a peer "
+                             "died and this replica is not the "
+                             "succession issuer (or takeover is off) — "
+                             "recovers on the issuer's broadcast or an "
+                             "operator apply_membership")
         if last_redirect is not None:
             out["last_redirect_age_s"] = round(now - last_redirect, 3)
         if last_membership is not None:
@@ -1664,6 +2373,7 @@ class Aggregator:
                 init = multihost_status()
                 # a degraded mesh is NOT ok — the probe names the tier
                 # so a half-joined or half-dead mesh is diagnosable
+                lease = self._lease
                 out["multihost"] = {
                     "active": self._multihost_active(),
                     "mesh_degraded": self._mesh_degraded,
@@ -1672,9 +2382,23 @@ class Aggregator:
                     # unconfigured | coordinator_unreachable |
                     # init_error) — never a generic decline
                     "init_reason": init.reason,
+                    "awaiting_membership": self._awaiting_membership,
+                    "lease_holder": (lease.holder
+                                     if lease is not None else ""),
+                    "lease_epoch": (lease.epoch
+                                    if lease is not None else 0),
                 }
                 if init.detail:
                     out["multihost"]["init_detail"] = init.detail
+                if self._awaiting_membership:
+                    # a peer died and this replica is NOT the succession
+                    # issuer (or takeover is disabled): engines rebuilt
+                    # over a stale ring would misattribute, so the probe
+                    # flags it until the issuer's broadcast (or an
+                    # operator apply_membership) lands
+                    out["ok"] = False
+                    out["multihost"]["detail"] = \
+                        "degraded, awaiting membership"
                 if self._mesh_degraded:
                     out["ok"] = False
         return out
@@ -1870,6 +2594,10 @@ class Aggregator:
             for name in [n for n, e in self._degraded.items()
                          if now - e["last_at"] > self._degraded_ttl]:
                 del self._degraded[name]
+        # one autoscale observation per aggregation interval — BEFORE
+        # the empty-fleet early return, so an idle fleet still feeds
+        # the scale-down streak
+        self._autoscale_tick()
         if not live:
             return self._drain_pipeline()
         # one telemetry cycle per non-empty fleet window, with the
@@ -1974,12 +2702,15 @@ class Aggregator:
                 shrink_after=self._bucket_shrink_after,
                 staging_slots=self._pipeline_depth + 1)
             if self._multihost_active() and not self._mesh_degraded:
-                # the multi-host tier: host-local rings over the GLOBAL
-                # mesh, one SPMD dispatch, owned-rows publish fetch
-                self._engine_mesh = self._mesh
-                self._shard_count = self._mesh.devices.size
+                # the multi-host tier: host-local rings over the LIVE
+                # mesh (the elastic submesh after a membership change,
+                # else the full configured mesh), one SPMD dispatch,
+                # owned-rows publish fetch
+                mh_mesh = self._live_mesh()
+                self._engine_mesh = mh_mesh
+                self._shard_count = mh_mesh.devices.size
                 self._engine = MultiHostWindowEngine(
-                    self._mesh,
+                    mh_mesh,
                     process_index=self._mh_process_index,
                     device_process=self._mh_device_process,
                     fabric=self._mh_fabric, **kwargs)
@@ -2610,6 +3341,29 @@ class Aggregator:
                 round(now - last_redirect, 3)
                 if last_redirect is not None else None),
         }
+        if ring is not None:
+            payload["digest"] = ring.membership_digest
+            lease = self._lease
+            with self._results_lock:
+                awaiting = self._awaiting_membership
+                decision = self._autoscale_last
+            with self._lock:
+                rejected = dict(self._membership_rejected)
+                applied = dict(self._membership_applied)
+            payload["membership"] = {
+                "lease": lease.describe() if lease is not None else None,
+                "awaiting_membership": awaiting,
+                "auto_apply": self._membership_auto_apply,
+                "rejected_total": rejected,
+                "applied_total": applied,
+                "standby_peers": list(self._standby_peers),
+            }
+            if decision is not None:
+                payload["membership"]["autoscale"] = {
+                    "direction": decision.direction,
+                    "replicas": decision.replicas,
+                    "reason": decision.reason,
+                }
         return (200, {"Content-Type": "application/json"},
                 json.dumps(payload).encode())
 
@@ -2889,6 +3643,58 @@ class Aggregator:
             [], ring.ownership_ratio(self._self_peer)
             if ring is not None else 1.0)
         yield ownership
+        ring_peers = GaugeMetricFamily(
+            "kepler_fleet_ring_peers",
+            "Replicas in the current ingest-ring membership (0 = ring "
+            "disabled) — the elastic fleet's replica count")
+        ring_peers.add_metric([], len(ring) if ring is not None else 0)
+        yield ring_peers
+        with self._lock:
+            rejected_snap = sorted(self._membership_rejected.items())
+            applied_snap = sorted(self._membership_applied.items())
+        mem_rejected = CounterMetricFamily(
+            "kepler_fleet_membership_rejected_total",
+            "Membership operations rejected, by structured reason "
+            "(equal_epoch_conflict is the split-brain detector firing)",
+            labels=["reason"])
+        for reason, count in rejected_snap:
+            mem_rejected.add_metric([reason], count)
+        yield mem_rejected
+        mem_applied = CounterMetricFamily(
+            "kepler_fleet_membership_applied_total",
+            "Membership changes applied, by source (operator | "
+            "succession | wire | join | leave | autoscale)",
+            labels=["source"])
+        for source, count in applied_snap:
+            mem_applied.add_metric([source], count)
+        yield mem_applied
+        with self._results_lock:
+            awaiting_now = self._awaiting_membership
+            decision_now = self._autoscale_last
+            scale_snap = sorted(self._autoscale_decisions.items())
+        mem_awaiting = GaugeMetricFamily(
+            "kepler_fleet_membership_awaiting_state",
+            "1 while this replica is degraded awaiting a membership "
+            "(a peer died and it is not the succession issuer, or "
+            "takeover is disabled)")
+        mem_awaiting.add_metric([], 1 if awaiting_now else 0)
+        yield mem_awaiting
+        if self._autoscale is not None:
+            rec = GaugeMetricFamily(
+                "kepler_fleet_autoscale_recommended_replicas",
+                "The autoscale policy's current replica recommendation "
+                "(enacted only with aggregator.membership.autoApply)")
+            rec.add_metric([], decision_now.replicas
+                           if decision_now is not None
+                           else (len(ring) if ring is not None else 0))
+            yield rec
+            scale_dec = CounterMetricFamily(
+                "kepler_fleet_autoscale_decisions_total",
+                "Autoscale observations by decision direction",
+                labels=["direction"])
+            for direction, count in scale_snap:
+                scale_dec.add_metric([direction], count)
+            yield scale_dec
         now = self._clock()
         with self._lock:
             lost_by_node = dict(self._lost_by_node)

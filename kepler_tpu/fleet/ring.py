@@ -143,6 +143,15 @@ class HashRing:
     def vnodes(self) -> int:
         return self._vnodes
 
+    @property
+    def membership_digest(self) -> str:
+        """Short stable digest of the peer SET (order-free) — lets two
+        replicas cheaply check they applied the SAME membership at an
+        epoch (the equal-epoch split-brain detector's log/debug
+        evidence) without printing full peer lists."""
+        return hashlib.blake2b("\x1f".join(self._peers).encode(),
+                               digest_size=4).hexdigest()
+
     def __contains__(self, peer: str) -> bool:
         return peer in self._peers
 
@@ -195,6 +204,7 @@ class HashRing:
             "peers": list(self._peers),
             "vnodes": self._vnodes,
             "self": self_peer,
+            "digest": self.membership_digest,
             "ownership_ratio": (round(self.ownership_ratio(self_peer), 6)
                                 if self_peer else None),
         }

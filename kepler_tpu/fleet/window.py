@@ -1273,22 +1273,50 @@ class HostLocalFabric:
     ``make multihost``'s virtual leg, and the bench multihost row;
     production multi-host runs with no fabric (``fabric=None``) and
     gets agreement from ``jax.experimental.multihost_utils`` instead.
+
+    ``parties`` names the LIVE party ids explicitly (default
+    ``range(n_parties)``). The elastic-membership plane uses it to
+    stand up a fabric incarnation over a SURVIVOR subset whose ids
+    keep their original process indices — e.g. ``parties=[1, 2]``
+    after host 0 of a 3-host mesh died — so the survivors' rebuilt
+    multi-host engines rendezvous among themselves without relabeling.
+    A rejoin builds a fresh full-set incarnation (sequence numbers
+    start aligned at zero on every party, matching the freshly rebuilt
+    engines).
     """
 
-    def __init__(self, n_parties: int, timeout: float = 60.0) -> None:
-        if n_parties < 1:
-            raise ValueError("fabric needs at least one party")
-        self._n = int(n_parties)
+    def __init__(self, n_parties: int | None = None,
+                 timeout: float = 60.0,
+                 parties: "Sequence[int] | None" = None) -> None:
+        if parties is None:
+            if n_parties is None or n_parties < 1:
+                raise ValueError("fabric needs at least one party")
+            parties = range(int(n_parties))
+        ids = sorted({int(p) for p in parties})
+        if not ids or any(p < 0 for p in ids):
+            raise ValueError(
+                f"fabric party ids must be non-negative, got {ids!r}")
+        if n_parties is not None and len(ids) != int(n_parties):
+            raise ValueError(
+                f"n_parties={n_parties} but {len(ids)} party ids "
+                f"given: {ids!r}")
+        self._parties = tuple(ids)
+        self._n = len(ids)
         self._timeout = float(timeout)
         self._lock = threading.Lock()
         self._barrier = threading.Barrier(self._n)
         self._dead = False
-        self._seq = [0] * self._n
+        self._seq = {p: 0 for p in ids}
         self._slots: dict = {}
 
     @property
     def n_parties(self) -> int:
         return self._n
+
+    @property
+    def parties(self) -> tuple:
+        """The live party ids this incarnation rendezvouses over."""
+        return self._parties
 
     def kill(self) -> None:
         """Simulate a host death: break every rendezvous, now and
@@ -1300,6 +1328,11 @@ class HostLocalFabric:
         if self._dead:
             raise DeviceWindowError(
                 "host_dead", "mesh fabric is down (peer host died)")
+        if party not in self._seq:
+            raise DeviceWindowError(
+                "host_dead",
+                f"party {party} is not in this fabric incarnation "
+                f"(live parties {list(self._parties)})")
         key = (name, self._seq[party])
         self._seq[party] += 1
         with self._lock:

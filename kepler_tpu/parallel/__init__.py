@@ -27,6 +27,7 @@ from kepler_tpu.parallel.mesh import (
     initialize_multihost,
     make_mesh,
     multihost_status,
+    submesh_for_processes,
 )
 from kepler_tpu.parallel.pipeline import (
     STAGE_AXIS,
@@ -83,6 +84,7 @@ __all__ = [
     "make_fleet_program",
     "initialize_multihost",
     "make_mesh",
+    "submesh_for_processes",
     "MultihostInit",
     "multihost_status",
     "mlp_param_shardings",

@@ -146,6 +146,36 @@ def make_mesh(
     return Mesh(np.asarray(devs).reshape(shape), tuple(axes))
 
 
+def submesh_for_processes(
+    mesh: Mesh,
+    processes: Sequence[int],
+    device_process: Any = None,
+) -> Mesh:
+    """A 1-D node mesh over the subset of ``mesh``'s devices owned by
+    ``processes`` — the elastic-membership rebuild primitive: after a
+    host death the survivors rebuild their multi-host window engine
+    over exactly the surviving processes' devices (and a rejoin
+    rebuilds over the full set again). Device order is preserved, so
+    every process derives the identical shard order with no
+    coordination — the same determinism contract as the ingest ring.
+
+    ``device_process`` maps a device to its process index (defaults to
+    ``device.process_index``; the virtual multi-host topology injects
+    its own). Degenerate cases fail loudly: an empty retained set has
+    no mesh to build.
+    """
+    keep = {int(p) for p in processes}
+    if device_process is None:
+        def device_process(d: Any) -> int:
+            return int(getattr(d, "process_index", 0))
+    devs = [d for d in mesh.devices.flat if int(device_process(d)) in keep]
+    if not devs:
+        raise ValueError(
+            f"no devices of the mesh belong to processes "
+            f"{sorted(keep)!r}")
+    return make_mesh([len(devs)], (NODE_AXIS,), devices=devs)
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -464,6 +464,37 @@ FIELD_MATRIX = [
     FieldCase("aggregator.multihost.takeover",
               "aggregator: {multihost: {takeover: false}}", False,
               ["--aggregator.multihost.takeover"], True),
+    FieldCase("aggregator.membership.auto_apply",
+              "aggregator: {membership: {autoApply: true}}", True,
+              ["--no-aggregator.membership.auto-apply"], False),
+    FieldCase("aggregator.membership.autoscale_enabled",
+              "aggregator: {membership: {autoscaleEnabled: true}}", True,
+              ["--no-aggregator.membership.autoscale-enabled"], False),
+    FieldCase("aggregator.membership.scale_up_load",
+              "aggregator: {membership: {scaleUpLoad: 0.9}}", 0.9,
+              ["--aggregator.membership.scale-up-load", "0.8"], 0.8),
+    FieldCase("aggregator.membership.scale_down_load",
+              "aggregator: {membership: {scaleDownLoad: 0.1}}", 0.1,
+              ["--aggregator.membership.scale-down-load", "0.2"], 0.2),
+    FieldCase("aggregator.membership.up_windows",
+              "aggregator: {membership: {upWindows: 5}}", 5,
+              ["--aggregator.membership.up-windows", "2"], 2),
+    FieldCase("aggregator.membership.down_windows",
+              "aggregator: {membership: {downWindows: 20}}", 20,
+              ["--aggregator.membership.down-windows", "6"], 6),
+    FieldCase("aggregator.membership.min_replicas",
+              "aggregator: {membership: {minReplicas: 2}}", 2,
+              ["--aggregator.membership.min-replicas", "3"], 3),
+    FieldCase("aggregator.membership.max_replicas",
+              "aggregator: {membership: {maxReplicas: 8}}", 8,
+              ["--aggregator.membership.max-replicas", "4"], 4),
+    FieldCase("aggregator.membership.standby_peers",
+              "aggregator: {membership: {standbyPeers: ['s:1']}}",
+              ["s:1"],
+              ["--aggregator.membership.standby-peers", "s:2"], ["s:2"]),
+    FieldCase("aggregator.membership.probe_timeout",
+              "aggregator: {membership: {probeTimeout: 5s}}", 5.0,
+              ["--aggregator.membership.probe-timeout", "1s"], 1.0),
     FieldCase("web.max_connections",
               "web: {maxConnections: 64}", 64,
               ["--web.max-connections", "32"], 32),
@@ -606,6 +637,16 @@ class TestYAMLSpellings:
         "numProcesses": ("aggregator", "multihost"),
         "processId": ("aggregator", "multihost"),
         "initTimeout": ("aggregator", "multihost"),
+        "autoApply": ("aggregator", "membership"),
+        "autoscaleEnabled": ("aggregator", "membership"),
+        "scaleUpLoad": ("aggregator", "membership"),
+        "scaleDownLoad": ("aggregator", "membership"),
+        "upWindows": ("aggregator", "membership"),
+        "downWindows": ("aggregator", "membership"),
+        "minReplicas": ("aggregator", "membership"),
+        "maxReplicas": ("aggregator", "membership"),
+        "standbyPeers": ("aggregator", "membership"),
+        "probeTimeout": ("aggregator", "membership"),
         "maxConnections": "web",
         "maxBytes": ("agent", "spool"),
         "maxRecords": ("agent", "spool"),
@@ -677,6 +718,16 @@ class TestYAMLSpellings:
         "numProcesses": ("2", 2),
         "processId": ("1", 1),
         "initTimeout": ("90s", 90.0),
+        "autoApply": ("true", True),
+        "autoscaleEnabled": ("true", True),
+        "scaleUpLoad": ("0.9", 0.9),
+        "scaleDownLoad": ("0.1", 0.1),
+        "upWindows": ("5", 5),
+        "downWindows": ("20", 20),
+        "minReplicas": ("2", 2),
+        "maxReplicas": ("8", 8),
+        "standbyPeers": ("['s:1']", ["s:1"]),
+        "probeTimeout": ("5s", 5.0),
         "maxConnections": ("64", 64),
         "maxBytes": ("1048576", 1048576),
         "maxRecords": ("128", 128),

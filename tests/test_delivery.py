@@ -204,12 +204,171 @@ class TestRingHeaderCoercion:
         assert post_raw(server, blob).status == 204
         assert agg._stats["windows_lost_total"] == 3  # seqs 5, 6, 7
 
+    def test_ownership_return_honors_watermark_after_epoch_bump(
+            self, server):
+        """Elastic membership (ISSUE 16): a replica that owned a node,
+        lost it to a scale-up, and got it back on a scale-down has a
+        STALE tracker — the away-period windows were 2xx'd by the
+        interim owner, and the agent's watermark vouches for them.
+        After a ring-epoch advance the existing tracker honors the
+        watermark (clamped); with membership at rest it still
+        doesn't."""
+        self_peer = "127.0.0.1:28283"
+        agg = make_agg(server, peers=[self_peer], self_peer=self_peer)
+        blob = mutate_header(
+            encode_report(make_report("elastic"), ["package", "dram"],
+                          seq=1, run="r1"))
+        assert post_raw(server, blob).status == 204
+        # ownership leaves and returns: membership advanced to epoch 2
+        agg.apply_membership([self_peer], 2)
+        blob = mutate_header(
+            encode_report(make_report("elastic"), ["package", "dram"],
+                          seq=7, run="r1"), acked_through=6)
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["windows_lost_total"] == 0  # 2..6 delivered
+        # same epoch, later gap: the watermark hides NOTHING now
+        blob = mutate_header(
+            encode_report(make_report("elastic"), ["package", "dram"],
+                          seq=10, run="r1"), acked_through=9)
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["windows_lost_total"] == 2  # seqs 8, 9
+
     def test_no_watermark_keeps_conservative_accounting(self, server):
         """Pre-handoff agents (no acked_through) keep PR-3 semantics:
         a fresh tracker counts the full leading gap."""
         agg = make_agg(server)
         post_report(server, make_report("plain"), seq=5, run="r1")
         assert agg._stats["windows_lost_total"] == 4
+
+
+MEMBER_PEERS = ["127.0.0.1:28283", "127.0.0.1:28284", "127.0.0.1:28285"]
+
+
+def post_membership(server, payload):
+    """POST to /v1/membership, returning (status, parsed body) for
+    both success and error responses."""
+    host, port = server.addresses[0]
+    body = payload if isinstance(payload, bytes) \
+        else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/v1/membership", data=body, method="POST")
+    try:
+        resp = urllib.request.urlopen(req, timeout=5)
+        return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read() or b"{}")
+
+
+class TestMembershipWireCoercion:
+    """Satellite (ISSUE 16): the /v1/membership control plane launders
+    every wire field through the same chokepoint discipline as the
+    ring headers — hostile peers/epoch/lease values answer a bounded
+    structured 400 (counted in ``membership_rejected_total``), stale
+    and conflicting epochs answer 409 with the current epoch as
+    evidence, and join/leave on a non-holder answers 421 naming the
+    holder. Never a 500, never an unbounded echo."""
+
+    def make_ring_agg(self, server, **kw):
+        kw.setdefault("peers", list(MEMBER_PEERS))
+        kw.setdefault("self_peer", MEMBER_PEERS[0])
+        return make_agg(server, **kw)
+
+    @pytest.mark.parametrize("payload,reason", [
+        (b"not json at all {", "bad_payload"),
+        (b"[1, 2, 3]", "bad_payload"),
+        (b'"a string"', "bad_payload"),
+        ({"op": "takeover"}, "bad_op"),
+        ({"op": 42}, "bad_op"),
+        ({"op": "apply", "peers": "not-a-list", "epoch": 2},
+         "bad_peer"),
+        ({"op": "apply", "peers": [42], "epoch": 2}, "bad_peer"),
+        ({"op": "apply", "peers": ["ok:1", "evil\nname"], "epoch": 2},
+         "bad_peer"),
+        ({"op": "apply", "peers": ["x" * 300], "epoch": 2}, "bad_peer"),
+        ({"op": "apply", "peers": MEMBER_PEERS, "epoch": "abc"},
+         "bad_epoch"),
+        ({"op": "apply", "peers": MEMBER_PEERS, "epoch": -1},
+         "bad_epoch"),
+        ({"op": "apply", "peers": MEMBER_PEERS, "epoch": True},
+         "bad_epoch"),
+        ({"op": "apply", "peers": MEMBER_PEERS, "epoch": 2,
+          "issuer": "bad\x01issuer"}, "bad_peer"),
+        ({"op": "apply", "peers": MEMBER_PEERS, "epoch": 2,
+          "lease": "no-separator"}, "bad_lease"),
+        ({"op": "join", "peer": 42}, "bad_peer"),
+    ])
+    def test_hostile_payloads_structured_400(self, server, payload,
+                                             reason):
+        agg = self.make_ring_agg(server)
+        status, body = post_membership(server, payload)
+        assert status == 400
+        assert body["ok"] is False
+        assert body["reason"] == reason
+        assert len(body.get("error", "")) < 512  # bounded, no echo
+        assert agg._membership_rejected[reason] == 1
+        assert agg._ring.epoch == 1  # nothing applied
+
+    def test_non_post_method_rejected(self, server):
+        self.make_ring_agg(server)
+        host, port = server.addresses[0]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/v1/membership", timeout=5)
+        assert err.value.code == 405
+
+    def test_stale_epoch_answers_409_with_current(self, server):
+        agg = self.make_ring_agg(server)
+        agg.apply_membership(MEMBER_PEERS, 3)
+        status, body = post_membership(server, {
+            "op": "apply", "peers": MEMBER_PEERS[:2], "epoch": 2,
+            "issuer": MEMBER_PEERS[0]})
+        assert status == 409
+        assert body["reason"] == "stale_epoch"
+        assert body["epoch"] == 3  # evidence: the epoch it lost to
+        assert agg._membership_rejected["stale_epoch"] == 1
+
+    def test_equal_epoch_conflict_answers_409(self, server):
+        """Two issuers writing DIFFERENT peer sets at the same epoch is
+        the split-brain the lease exists to catch — loud, counted,
+        evidence in the reply."""
+        agg = self.make_ring_agg(server)
+        status, body = post_membership(server, {
+            "op": "apply", "peers": MEMBER_PEERS[:2], "epoch": 1,
+            "issuer": MEMBER_PEERS[0]})
+        assert status == 409
+        assert body["reason"] == "equal_epoch_conflict"
+        assert agg._membership_rejected["equal_epoch_conflict"] == 1
+        assert list(agg._ring.peers) == sorted(MEMBER_PEERS)
+
+    def test_equal_epoch_same_set_is_idempotent_200(self, server):
+        agg = self.make_ring_agg(server)
+        status, body = post_membership(server, {
+            "op": "apply", "peers": MEMBER_PEERS, "epoch": 1,
+            "issuer": MEMBER_PEERS[0]})
+        assert status == 200
+        assert body["ok"] is True
+        assert agg._ring.epoch == 1
+
+    def test_good_apply_advances_ring(self, server):
+        agg = self.make_ring_agg(server)
+        status, body = post_membership(server, {
+            "op": "apply", "peers": MEMBER_PEERS[:2], "epoch": 2,
+            "issuer": MEMBER_PEERS[0]})
+        assert status == 200
+        assert body["ok"] is True
+        assert agg._ring.epoch == 2
+        assert agg._membership_applied["wire"] == 1
+
+    def test_join_on_non_holder_answers_421(self, server):
+        # self is NOT the lowest peer, so it does not hold the lease
+        agg = self.make_ring_agg(server, self_peer=MEMBER_PEERS[1])
+        status, body = post_membership(server, {
+            "op": "join", "peer": "127.0.0.1:28299"})
+        assert status == 421
+        assert body["ok"] is False
+        assert body["reason"] == "not_leader"
+        assert body["holder"] == MEMBER_PEERS[0]
+        assert agg._ring.epoch == 1  # the non-holder changed nothing
 
 
 class TestWireV2HeaderCoercion:

@@ -549,7 +549,8 @@ class TestMultiHostChaos:
                    for k, d in enumerate(mesh_devs)}
         return mesh_devs, proc_of.get
 
-    def _make_agg(self, process_index: int, fabric, device_process):
+    def _make_agg(self, process_index: int, fabric, device_process,
+                  **kw):
         ticks = [1e9]
         agg = Aggregator(
             APIServer(), model_mode="mlp", node_bucket=8,
@@ -560,7 +561,7 @@ class TestMultiHostChaos:
                                 "fabric": fabric},
             peers=list(self.PEERS),
             self_peer=self.PEERS[process_index],
-            clock=lambda: ticks[0])
+            clock=lambda: ticks[0], **kw)
         agg.test_clock = ticks
         agg.init()
         return agg
@@ -696,3 +697,159 @@ class TestMultiHostChaos:
         ref.shutdown()
         survivor.shutdown()
         aggs[1].shutdown()
+
+    def test_dead_host_rejoins_takes_shards_back_bit_equal(self):
+        """The elastic rejoin leg (ISSUE 16): after a host death and
+        succession, the dead host COMES BACK — a fresh process under a
+        NEW fabric incarnation registers with the lease holder over
+        ``/v1/membership``. It re-elects no one (the incumbent lease
+        survives), the multi-host tier is restored, the rejoiner owns
+        ring shards again, and the recovered multi-host window is
+        bit-equal to a fault-free single-host reference. Zero windows
+        lost across the whole death/rejoin cycle."""
+        import json as _json
+        import threading
+
+        from kepler_tpu.fleet.aggregator import (
+            RUNG_NAME_MESH_DEGRADED, RUNG_NAME_MULTIHOST)
+        from kepler_tpu.fleet.ring import MeshRing
+        from kepler_tpu.fleet.window import HostLocalFabric
+
+        mesh_devs, device_process = self._topology()
+        alive = set(self.PEERS)
+        aggs: dict[str, Aggregator] = {}
+
+        class Req:
+            command = "POST"
+
+            def __init__(self, body):
+                self.body = body
+
+        def make(p, fabric):
+            def deliver(target, payload):
+                if target not in alive:
+                    raise OSError("connection refused")
+                status, _, body = aggs[target]._handle_membership(
+                    Req(_json.dumps(payload).encode()))
+                return _json.loads(body)
+
+            return self._make_agg(
+                p, fabric, device_process,
+                membership_topology={
+                    "peer_alive": lambda q: q in alive,
+                    "deliver": deliver})
+
+        fabric1 = HostLocalFabric(2, timeout=60)
+        aggs[self.PEERS[0]] = make(0, fabric1)
+        aggs[self.PEERS[1]] = make(1, fabric1)
+        all_names = [f"n{i:02d}" for i in range(10)]
+
+        def owned_by(ring):
+            return {p: [n for n in all_names
+                        if ring.owner(n) == self.PEERS[p]]
+                    for p in (0, 1)}
+
+        def window_on_both(win, owned):
+            published = {0: None, 1: None}
+            errs = {0: None, 1: None}
+
+            def run(p):
+                try:
+                    agg = aggs[self.PEERS[p]]
+                    agg.test_clock[0] += 5.0
+                    self._seed(agg, owned[p], win)
+                    published[p] = agg.aggregate_once()
+                except BaseException as e:
+                    errs[p] = e
+
+            ts = [threading.Thread(target=run, args=(p,))
+                  for p in (0, 1)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=180)
+            for e in errs.values():
+                if e is not None:
+                    raise e
+            return published
+
+        # -- one healthy multi-host window ------------------------------
+        owned = owned_by(aggs[self.PEERS[0]]._ring)
+        assert owned[0] and owned[1], owned
+        published = window_on_both(0, owned)
+        for p in (0, 1):
+            assert sorted(published[p].names) == sorted(owned[p])
+
+        # -- host 1 dies: succession heals the ring ---------------------
+        alive.discard(self.PEERS[1])
+        fabric1.kill()
+        dead = aggs.pop(self.PEERS[1])
+        dead.shutdown()
+        survivor = aggs[self.PEERS[0]]
+        survivor.test_clock[0] += 5.0
+        self._seed(survivor, owned[0], 1)
+        result = survivor.aggregate_once()
+        assert result is not None
+        assert survivor._ring.epoch == 2
+        assert survivor._membership_applied.get("succession") == 1
+        assert survivor._lease.holder == self.PEERS[0]
+        assert survivor._rung_display(RUNG_PIPELINED) == \
+            RUNG_NAME_MESH_DEGRADED
+        assert survivor._ring.owner(owned[1][0]) == self.PEERS[0]
+
+        # -- host 1 REJOINS under a fresh fabric incarnation ------------
+        fabric2 = HostLocalFabric(2, timeout=60)
+        survivor.arm_mesh(fabric2)
+        alive.add(self.PEERS[1])
+        rejoined = make(1, fabric2)
+        aggs[self.PEERS[1]] = rejoined
+        reply = rejoined.request_join(mesh=True)
+        assert reply["ok"] is True
+
+        # re-elects NO ONE: the incumbent lease survives the rejoin
+        for agg in aggs.values():
+            assert agg._lease.holder == self.PEERS[0]
+            assert agg._ring.epoch == 3  # death bump + join bump
+            assert isinstance(agg._ring, MeshRing)
+            assert agg._mesh_degraded is False
+        assert "succession" not in rejoined._membership_applied
+
+        # the rejoiner owns shards again, and both rings agree
+        owned_after = owned_by(survivor._ring)
+        assert owned_after[1], owned_after
+        for name in all_names:
+            assert survivor._ring.owner(name) == \
+                rejoined._ring.owner(name)
+
+        # -- recovered multi-host window on the restored tier -----------
+        published = window_on_both(2, owned_after)
+        for p in (0, 1):
+            assert published[p] is not None
+            assert sorted(published[p].names) == sorted(owned_after[p])
+            assert aggs[self.PEERS[p]]._rung_display(RUNG_PIPELINED) \
+                == RUNG_NAME_MULTIHOST
+        assert survivor._stats["windows_lost_total"] == 0
+
+        # bit-equal to a fault-free single-host reference over the
+        # same fleet (window 2 reports for every node)
+        ref = make_agg(depth=1)
+        ref.test_clock[0] = survivor.test_clock[0] - 5.0
+        for p in (0, 1):
+            self._seed(ref, owned_after[p], 2)
+        ref.test_clock[0] += 5.0
+        reference = ref.aggregate_once()
+        assert sorted(reference.names) == sorted(all_names)
+        for p in (0, 1):
+            win = published[p]
+            for name in win.names:
+                i, j = win.rows[name], reference.rows[name]
+                np.testing.assert_array_equal(
+                    win.node_power_uw[i], reference.node_power_uw[j])
+                np.testing.assert_array_equal(
+                    win.node_energy_uj[i], reference.node_energy_uj[j])
+                np.testing.assert_array_equal(
+                    win.wl_power_uw[i, :win.counts[i]],
+                    reference.wl_power_uw[j, :reference.counts[j]])
+        ref.shutdown()
+        for agg in aggs.values():
+            agg.shutdown()

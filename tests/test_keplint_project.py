@@ -283,6 +283,50 @@ _RING_REDIRECT_GOOD = {
     """,
 }
 
+# the elastic-membership idiom (ISSUE 16): a lease-registration reply
+# names the holder and lease id the joiner will adopt, log, and key
+# metrics by — both are wire input and must pass the membership
+# sanitizer chokepoints (sanitize_peer / sanitize_lease_id) first
+_LEASE_REGISTER_BAD = {
+    "kepler_tpu/membership_mod.py": """
+        # keplint: sanitizes
+        def sanitize_peer(name):
+            return name[:256]
+
+        # keplint: sanitizes
+        def sanitize_lease_id(value):
+            return value[:256]
+    """,
+    "kepler_tpu/join_mod.py": """
+        # keplint: taint-source
+        def parse_grant(reply):
+            return reply.get("holder"), reply.get("lease")
+
+        def register(fam, reply) -> None:
+            holder, lease = parse_grant(reply)
+            fam.labels(holder)
+            fam.labels(lease)
+    """,
+}
+
+_LEASE_REGISTER_GOOD = {
+    "kepler_tpu/membership_mod.py":
+        _LEASE_REGISTER_BAD["kepler_tpu/membership_mod.py"],
+    "kepler_tpu/join_mod.py": """
+        from kepler_tpu.membership_mod import (sanitize_lease_id,
+                                               sanitize_peer)
+
+        # keplint: taint-source
+        def parse_grant(reply):
+            return reply.get("holder"), reply.get("lease")
+
+        def register(fam, reply) -> None:
+            holder, lease = parse_grant(reply)
+            fam.labels(sanitize_peer(holder))
+            fam.labels(sanitize_lease_id(lease))
+    """,
+}
+
 _TAINT_STORE_BAD = {
     "kepler_tpu/taint_mod.py": """
         # keplint: taint-source
@@ -470,6 +514,18 @@ class TestTaint:
         assert ids(diags) == ["KTL112"]
         assert "parse_redirect" in diags[0].message
         assert plint(_RING_REDIRECT_GOOD) == []
+
+    def test_lease_registration_fields_must_be_sanitized(self, plint):
+        """ISSUE 16: the join reply's holder/lease values steer which
+        peer a replica dials and what the lease metrics say — raw use
+        as a label is flagged; laundered through the membership
+        module's `sanitizes` chokepoints it is clean — the shipped
+        `fleet/membership.py` sanitize_peer/sanitize_lease_id
+        pattern."""
+        diags = plint(_LEASE_REGISTER_BAD)
+        assert ids(diags) == ["KTL112", "KTL112"]
+        assert "parse_grant" in diags[0].message
+        assert plint(_LEASE_REGISTER_GOOD) == []
 
     def test_store_key_sink_flagged(self, plint):
         diags = plint(_TAINT_STORE_BAD)

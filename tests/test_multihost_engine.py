@@ -483,11 +483,11 @@ class TestAggregatorMultihost:
         with pytest.raises(ValueError, match="process index"):
             agg.init()
 
-    def test_takeover_skipped_on_larger_meshes(self):
-        """Auto-takeover is gated to 2-host meshes: on a 3-host mesh
-        every survivor claiming 100% at the same epoch would
-        split-brain ingest, so the ring is left for an operator
-        apply_membership."""
+    @staticmethod
+    def _three_host_agg(process_index: int, alive: set[str],
+                        delivered: list | None = None) -> Aggregator:
+        """A 3-host virtual aggregator with injected liveness/delivery
+        seams — the succession tier above 2 hosts (ISSUE 16)."""
         jax = _jax()
 
         devs = jax.devices()
@@ -498,26 +498,89 @@ class TestAggregatorMultihost:
         proc_of = {d: min(k // per, 2)
                    for k, d in enumerate(mesh_devs)}
         peers3 = PEERS + ["127.0.0.1:28293"]
+
+        def deliver(peer, payload):
+            if delivered is not None:
+                delivered.append((peer, payload))
+            return {"ok": True}
+
         agg = Aggregator(
             APIServer(), model_mode="mlp", stale_after=1e9,
             node_bucket=8, workload_bucket=8,
             multihost_enabled=True,
-            multihost_topology={"process_index": 0,
+            multihost_topology={"process_index": process_index,
                                 "device_process": proc_of.get},
-            peers=list(peers3), self_peer=peers3[0],
+            membership_topology={"peer_alive": lambda p: p in alive,
+                                 "deliver": deliver},
+            peers=list(peers3), self_peer=peers3[process_index],
             mesh=make_mesh([3 * per], ["node"], devices=mesh_devs))
         agg.init()
+        return agg
+
+    def test_succession_on_three_host_mesh(self):
+        """The 2-host-only takeover gate is GONE: on a 3-host mesh a
+        host death elects exactly ONE issuer (the lease holder, alive)
+        who bumps the epoch over the survivor set and broadcasts it —
+        no operator in the loop."""
+        peers3 = PEERS + ["127.0.0.1:28293"]
+        delivered = []
+        # host 2 dies; hosts 0 and 1 survive; 0 is the incumbent holder
+        agg = self._three_host_agg(0, alive=set(peers3[:2]),
+                                   delivered=delivered)
+        try:
+            agg._packed_engine(RUNG_PIPELINED)
+            epoch_before = agg._ring.epoch
+            agg._handle_device_failure(
+                DeviceWindowError("host_dead", "peer lost"))
+            assert agg._mesh_degraded is True
+            # exactly one issuer (self = incumbent holder): epoch
+            # bumped over the survivors, dead peer excised
+            assert agg._ring.epoch == epoch_before + 1
+            assert set(agg._ring.peers) == set(peers3[:2])
+            assert agg._lease.holder == peers3[0]
+            assert agg._lease.epoch == agg._ring.epoch
+            probe = agg.window_health()
+            assert probe["multihost"]["awaiting_membership"] is False
+            # the membership was broadcast to the OTHER survivor only
+            targets = [p for p, _ in delivered]
+            assert targets == [peers3[1]]
+            assert delivered[0][1]["op"] == "apply"
+            assert delivered[0][1]["epoch"] == agg._ring.epoch
+        finally:
+            agg.shutdown()
+
+    def test_non_issuer_survivor_awaits_membership(self):
+        """The survivor that is NOT the succession issuer must NOT
+        bump the epoch (that second writer is the split-brain the
+        equal-epoch conflict detector exists for) — it flags itself
+        'degraded, awaiting membership' until the issuer's broadcast
+        lands, then recovers by adopting it."""
+        peers3 = PEERS + ["127.0.0.1:28293"]
+        delivered = []
+        # host 2 dies; survivor 1 is NOT the holder (0 is, and alive)
+        agg = self._three_host_agg(1, alive=set(peers3[:2]),
+                                   delivered=delivered)
         try:
             agg._packed_engine(RUNG_PIPELINED)
             epoch_before = agg._ring.epoch
             owner_before = agg._ring.owner("some-node")
             agg._handle_device_failure(
                 DeviceWindowError("host_dead", "peer lost"))
-            assert agg._mesh_degraded is True
-            # no takeover: epoch and ownership untouched, operator owns
-            # the rebalance
+            # not the issuer: epoch and ownership untouched, no
+            # broadcast sent, probe degraded awaiting membership
             assert agg._ring.epoch == epoch_before
             assert agg._ring.owner("some-node") == owner_before
+            assert delivered == []
+            probe = agg.window_health()
+            assert probe["ok"] is False
+            assert probe["multihost"]["awaiting_membership"] is True
+            assert agg.ring_health()["awaiting_membership"] is True
+            # the issuer's broadcast arrives → adopt and recover
+            agg.apply_membership(peers3[:2], epoch_before + 1,
+                                 source="wire", issuer=peers3[0])
+            probe = agg.window_health()
+            assert probe["multihost"]["awaiting_membership"] is False
+            assert agg._lease.holder == peers3[0]
         finally:
             agg.shutdown()
 

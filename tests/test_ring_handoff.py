@@ -211,11 +211,19 @@ class TestRedirectFlow:
     def test_membership_requires_increasing_epoch(self):
         servers, aggs, peers, ctxs = make_tier(2)
         try:
-            with pytest.raises(Exception):
-                aggs[0].apply_membership(peers, 1)  # not an increase
+            # equal epoch + SAME set: idempotent replay, not an error
+            # (a re-delivered broadcast must converge silently)
+            assert aggs[0].apply_membership(peers, 1) == 0
+            assert aggs[0]._ring.epoch == 1
+            # equal epoch + DIFFERENT set: the split-brain detector
+            with pytest.raises(ValueError):
+                aggs[0].apply_membership([peers[0]], 1)
             dropped = aggs[0].apply_membership([peers[0]], 2)
             assert dropped == 0  # nothing stored yet
             assert aggs[0]._ring.epoch == 2
+            # stale epoch after the bump
+            with pytest.raises(ValueError):
+                aggs[0].apply_membership(peers, 1)
         finally:
             shutdown_tier(servers, aggs, ctxs)
 
