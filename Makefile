@@ -87,7 +87,7 @@ native-asan: ## AddressSanitizer pass over the native scanner/renderer
 .PHONY: lint
 lint:
 	$(PYTHON) -m compileall -q kepler_tpu tests hack benchmarks
-	$(PYTHON) -m kepler_tpu.analysis --device-tier kepler_tpu hack benchmarks
+	$(PYTHON) -m kepler_tpu.analysis --device-tier --protocol-tier kepler_tpu hack benchmarks
 	$(PYTHON) hack/gen_lint_docs.py --check
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check kepler_tpu tests hack; \
@@ -112,9 +112,13 @@ kepljax: ## device tier alone: trace registered programs, run KTL120-123
 kepljax-snapshots: ## regenerate the KTL123 golden program fingerprints (.kepljax.json)
 	$(PYTHON) -m kepler_tpu.analysis --update-snapshots
 
+.PHONY: protocheck
+protocheck: ## kepmc protocol tier alone: exhaustively explore the registered protocol models, run KTL130-132
+	$(PYTHON) -m kepler_tpu.analysis --protocol-tier --only=KTL130,KTL131,KTL132 kepler_tpu
+
 .PHONY: keplint-sarif
-keplint-sarif: ## keplint + device-tier findings as SARIF 2.1.0 (CI annotation feed; stdout is pipeable JSON)
-	@$(PYTHON) -m kepler_tpu.analysis --device-tier --format=sarif kepler_tpu hack benchmarks
+keplint-sarif: ## keplint + device/protocol-tier findings as SARIF 2.1.0 (CI annotation feed; stdout is pipeable JSON)
+	@$(PYTHON) -m kepler_tpu.analysis --device-tier --protocol-tier --format=sarif kepler_tpu hack benchmarks
 
 .PHONY: keplint-baseline
 keplint-baseline: ## refreeze the keplint baseline (after fixing findings)

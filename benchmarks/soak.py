@@ -75,8 +75,9 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              kill_at: float = 0.0, shed: bool = False,
              rebalance_after: float = 0.0, diurnal: bool = False) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
-    from kepler_tpu.fleet.wire import (encode_report, encode_report_batch,
-                                       restamp_transmit)
+    from kepler_tpu.fleet.wire import (encode_delta_v2, encode_report,
+                                       encode_report_batch,
+                                       encode_report_v2, restamp_transmit)
     from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
     from kepler_tpu.parallel.mesh import make_mesh
     from kepler_tpu.server.http import APIServer
@@ -159,6 +160,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     errors = np.zeros(n_agents, np.int64)
     redirects = np.zeros(n_agents, np.int64)
     replays = np.zeros(n_agents, np.int64)
+    kf_409s = np.zeros(n_agents, np.int64)  # structured needs-keyframe
     throttled = np.zeros(n_agents, np.int64)
     drain_requests = np.zeros(n_agents, np.int64)
     drain_records = np.zeros(n_agents, np.int64)
@@ -198,9 +200,25 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         # de-synchronized start so 1000 agents don't phase-lock
         time.sleep((idx / n_agents) * interval)
         lat = latencies[idx]
+        kf_base: bytes | None = None  # last ACKED v2 keyframe bytes
         while not stop.is_set():
             seq += 1
-            base = encode_report(rep, zones, seq=seq, run=f"r{idx}")
+            if diurnal:
+                # the diurnal leg speaks wire v2 — deltas against the
+                # last acked keyframe with the structured-409 recovery
+                # loop — because scale events are exactly what displaces
+                # shards onto owners with no base row; the gate bounds
+                # the resulting needs-keyframe burst (keyframe cadence:
+                # every 5th window ships full regardless)
+                full = encode_report_v2(rep, zones, seq=seq,
+                                        run=f"r{idx}")
+                frame = (encode_delta_v2(full, kf_base)
+                         if kf_base is not None and seq % 5 else None)
+                is_kf = frame is None
+                base = full if is_kf else frame
+            else:
+                full, is_kf = b"", False
+                base = encode_report(rep, zones, seq=seq, run=f"r{idx}")
             first_target = t_idx
             # at-least-once: retry THIS seq until a replica concludes
             # it — a replica outage then shows up as duplicates and
@@ -249,8 +267,25 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                     continue
                 lat.append((time.monotonic(),
                             (time.perf_counter() - t0) * 1e3))
+                if status == 409 and diurnal and not is_kf:
+                    # structured needs-keyframe: the owner has no base
+                    # for this delta (hand-off/eviction) — resend THIS
+                    # window full; anything else 409-shaped falls
+                    # through to the reject accounting
+                    try:
+                        needs_kf = bool(json.loads(data)
+                                        .get("needs_keyframe"))
+                    except (ValueError, UnicodeDecodeError,
+                            AttributeError):
+                        needs_kf = False
+                    if needs_kf:
+                        kf_409s[idx] += 1
+                        base, is_kf = full, True
+                        continue
                 if status == 204:
                     acked = seq
+                    if diurnal and is_kf:
+                        kf_base = full
                     if t_idx != first_target:
                         # the window concluded on a DIFFERENT replica
                         # than first tried — a membership change (or
@@ -479,6 +514,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         killer.start()
 
     scale_events = [0]
+    departed_kf = [0]  # keyframe 409s served by replicas that left
     if diurnal:
         def membership_post(holder: str, payload: dict) -> None:
             h, _, p = holder.rpartition(":")
@@ -533,6 +569,8 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             time.sleep(min(2.0, interval))
             for i in left:
                 live.discard(i)
+                departed_kf[0] += int(
+                    aggs[i]._stats.get("keyframe_requests_total", 0))
                 ctxs[i].cancel()
                 servers[i].shutdown()
                 aggs[i].shutdown()
@@ -625,6 +663,12 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             # reports concluded on a different replica than first
             # tried — displaced shards replayed to their new owners
             "soak_rejoin_replays": int(replays.sum()),
+            # wire-v2 hand-off recovery: structured 409s served fleet-
+            # wide (survivors + departed leavers) vs observed by agents
+            "soak_keyframe_requests": (
+                int(stats.get("keyframe_requests_total", 0))
+                + departed_kf[0]),
+            "soak_keyframe_409s_seen": int(kf_409s.sum()),
             "soak_final_replicas": len(live),
             "soak_final_epoch": max(
                 aggs[i]._ring.epoch for i in sorted(live)),
@@ -698,6 +742,23 @@ def gate(row: dict, p99_budget_ms: float = 250.0,
             failures.append(
                 f"diurnal schedule ended at {row['soak_final_replicas']} "
                 "replicas (expected 2)")
+        # bounded keyframe burst: a displaced shard's first delta at
+        # its new owner earns exactly ONE structured 409 before the
+        # keyframe lands (kepmc KTL132 pins the convergence), so the
+        # fleet-wide 409 count must stay within a small constant of
+        # the displaced-shard replay count — a needs-keyframe loop or
+        # a thrashing base-row cache blows straight past this
+        kf_budget = 4 * max(1, row["soak_rejoin_replays"])
+        if row["soak_keyframe_requests"] > kf_budget:
+            failures.append(
+                f"{row['soak_keyframe_requests']} keyframe requests "
+                f"(409s) > {kf_budget} = 4 x "
+                f"max(1, {row['soak_rejoin_replays']} displaced-shard "
+                "replays): needs-keyframe recovery is not converging")
+        if not row["soak_keyframe_requests"]:
+            failures.append(
+                "zero keyframe requests across the scale schedule: the "
+                "wire-v2 delta leg never exercised hand-off recovery")
     if row.get("soak_shed"):
         # herd mode: batched drain must measurably cut request count —
         # the deep recovery replay ships ≥ 8 records in one request
@@ -740,9 +801,12 @@ def main() -> None:
                    help="elastic-membership mode (ISSUE 16): a 1 -> "
                         "peak -> 2 replica schedule under live load "
                         "driven through /v1/membership join/leave; "
-                        "emits soak_scale_events / soak_rejoin_replays "
-                        "and gates ZERO windows lost across every "
-                        "scale event (peak = --replicas, min 4)")
+                        "agents speak wire v2 (deltas + 409 keyframe "
+                        "recovery); emits soak_scale_events / "
+                        "soak_rejoin_replays / soak_keyframe_requests "
+                        "and gates ZERO windows lost plus a BOUNDED "
+                        "post-rebalance keyframe burst (<= 4x the "
+                        "displaced-shard replay count; ISSUE 17)")
     p.add_argument("--rebalance-after", type=float, default=None,
                    help="seconds AFTER the kill before survivors adopt "
                         "the shrunken membership (ownership-convergence "

@@ -50,6 +50,7 @@ python -m kepler_tpu.analysis --list-rules
 python -m kepler_tpu.analysis --format=sarif   # SARIF 2.1.0 (make keplint-sarif)
 python -m kepler_tpu.analysis --per-file       # disable cross-module analysis
 python -m kepler_tpu.analysis --device-tier    # + trace device programs (KTL120-123)
+python -m kepler_tpu.analysis --protocol-tier  # + explore protocol models (KTL130-132)
 python -m kepler_tpu.analysis --only=KTL120    # single-rule iteration loop
 ```
 
@@ -58,7 +59,9 @@ violations, `2` usage errors. `--format=json|sarif` emits
 machine-readable reports (SARIF 2.1.0 minimal profile, consumable as
 CI annotations). `--only=KTLxxx[,KTLxxx]` restricts a run to the named
 rules so a single-rule iteration loop does not pay every family's cost
-— in particular the device tier's trace cost.
+— in particular the device tier's trace cost. Naming a KTL12x id in
+`--only` implies `--device-tier`; a KTL130-132 id implies
+`--protocol-tier`.
 
 ## Whole-program analysis
 
@@ -139,6 +142,77 @@ TPU casts do not appear), and fingerprints describe structure, not
 cost. A jax upgrade can legitimately shift a fingerprint; regenerating
 snapshots then is expected and the diff shows the cause.
 
+## Protocol tier (kepmc, KTL130-133)
+
+The host tiers read source; the device tier reads jaxprs; neither can
+see an *ordering* bug — a safety violation that only a specific
+interleaving of deliveries, crashes, restarts and scale events
+produces (PR 16 shipped three of them). `--protocol-tier` (wired into
+`make lint`; `make protocheck` runs it alone) runs **kepmc**
+(`kepler_tpu/analysis/protocol/`): an explicit-state model checker
+that exhaustively explores every reachable interleaving of a small
+fleet and checks safety invariants in every state.
+
+The models are thin adapters, not re-implementations: each transition
+calls the SAME pure decision functions production runs —
+`plan_membership_apply`/`CoordinatorLease.adopt`/`plan_succession`
+(`fleet/membership.py`), `SeqTracker.observe`/`seed_fresh_tracker`/
+`reseed_on_ownership_return`/`keyframe_wanted`/`delta_base_matches`/
+`plan_ack_cursor`/`plan_rewind_tail` (`fleet/delivery.py`,
+`fleet/spool.py`). A model bug is possible; a model/production *drift*
+requires changing a shared function both see. KTL133 (below) fences
+the other direction: protocol state may not move outside those
+functions.
+
+Specs are declarative registry entries
+(`kepler_tpu/analysis/protocol/registry.py`), mirroring the device
+tier's `ProgramSpec` shape: a `ProtocolSpec` names the model factory,
+the production source module its transitions drive, the invariants to
+check, and bounded `ProtocolCase`s (2-3 replicas, 1-2 agents, a
+handful of windows/epochs — the scope where these protocols' bugs
+live, small enough for exhaustive BFS in seconds). Each case carries a
+`max_states` ceiling; blowing it raises `StateExplosionError` — lint
+FAILS rather than silently truncating the search.
+
+Event vocabulary (per model, composed from): message `deliver` /
+`duplicate` / reorder (messages persist in the state, so any delivery
+order is explored), dropped responses, `crash` / `restart`, `leave` /
+join succession, false-`suspect` probing, `rewind` / replay,
+ownership `scale` swaps, keyframe/delta sends with loss and `409`
+responses, base-row eviction.
+
+- **KTL130 protocol-epoch-safety** — lease/membership: at most one
+  self-believed holder per epoch (crash-heal scope), the holder is a
+  member of its own peer set, epochs stay contiguous (no skipped or
+  double-minted bumps), and no replica wedges awaiting a transfer that
+  can never arrive.
+- **KTL131 protocol-loss-accounting** — delivery/spool: no reachable
+  schedule fabricates loss (counts a delivered window as lost), the
+  spool ack cursor never skips an unsent record, stale acks are
+  rejected, rewinds stay bounded to already-acked tails.
+- **KTL132 protocol-replay-idempotence** — replayed windows are
+  duplicates, never loss; after a 409 the next send is always a
+  keyframe (the needs-keyframe loop converges in one round-trip);
+  duplicate keyframes still plant the delta base.
+
+A violation prints as a **counterexample**: the minimal event trace
+(BFS guarantees shortest-path) from the initial state to the violating
+state, one event per line, ending with the violated invariant and the
+state that broke it. Read it top-down as a schedule — each line is one
+atomic event the fleet could execute in that order; reproduce it by
+replaying the same calls against the real objects (the pinned
+regression tests in `tests/test_protocol.py` do exactly that). The
+committed baseline stays empty for this tier too: a counterexample on
+the shipped tree is a bug to fix, never to grandfather.
+
+KTL133 (`protocol-transition-marker`) is the lexical fence that keeps
+the tier honest: inside `kepler_tpu/fleet/`, assignments to protocol
+state attributes (lease epoch/holder, ring epoch, seq watermarks,
+spool cursor, keyframe base rows) are only legal inside functions
+marked `# keplint: protocol-transition`. An unmarked write is exactly
+a transition the checker does not know about. It is an ordinary
+per-file rule and always runs.
+
 ## Suppressing
 
 Append `# keplint: disable=KTL1xx` to the offending line (or put it on
@@ -170,6 +244,7 @@ instead of hardcoding module lists:
 | `# keplint: taint-source` (above a `def`) | KTL112: this function's return value is untrusted input |
 | `# keplint: sanitizes` (above a `def`) | KTL112: passing a value through this function launders its taint |
 | `# keplint: taint-sink[=label]` (above a `def`) | KTL112: tainted arguments to this function are findings |
+| `# keplint: protocol-transition` (above a `def`) | KTL133: this function is a declared protocol transition — the one place protocol state attributes may be written (and the kepmc models cover it) |
 
 ## Baseline ratchet
 
@@ -187,11 +262,12 @@ regeneration is an explicit, reviewable act.
 
 The same ratchet stance applies to typing: `pyproject.toml` declares a
 strict mypy tier (`config/`, `monitor/snapshot`, `fleet/wire`,
-`fleet/window`, `fleet/scoreboard`, `fleet/aggregator`, `fault/`,
-`analysis/`, `parallel/packed`, `parallel/mesh`, `parallel/compat` —
-fully typed, `disallow_untyped_defs`) and a checked tier (`monitor/`,
-`fleet/`, `service/` — `check_untyped_defs`); modules move *up* tiers,
-never down.
+`fleet/window`, `fleet/scoreboard`, `fleet/aggregator`,
+`fleet/membership`, `fleet/delivery`, `fault/`, `analysis/` (the
+protocol tier included), `parallel/packed`, `parallel/mesh`,
+`parallel/compat` — fully typed, `disallow_untyped_defs`) and a
+checked tier (`monitor/`, `fleet/`, `service/` —
+`check_untyped_defs`); modules move *up* tiers, never down.
 
 ## Extending
 
@@ -205,7 +281,15 @@ and implement `check_trace(report)` over a
 `kepler_tpu.analysis.device.trace.TraceReport`; new device programs
 register a `ProgramSpec` (factory + cases + contract) in
 `kepler_tpu/analysis/device/registry.py` and commit regenerated
-snapshots. Either way: set `id`/`name`/`severity`/`summary`/
+snapshots. Protocol-tier rules subclass `ProtocolRule` and implement
+`check_model(report)` over a
+`kepler_tpu.analysis.protocol.ModelReport` (the spec, the case, the
+exploration result with its counterexamples); new protocol machines
+register a `ProtocolSpec` (model factory + bounded cases +
+invariants) in `kepler_tpu/analysis/protocol/registry.py`, drive REAL
+pure functions from `kepler_tpu/fleet/` in their transitions, and
+mark those functions `# keplint: protocol-transition` so KTL133 keeps
+the write surface closed. Either way: set `id`/`name`/`severity`/`summary`/
 `rationale` (and `tree_scope` if the rule polices `hack/` or
 `benchmarks/` too), decorate with `@register`, add a good/bad fixture
 pair to `tests/test_keplint.py` (cross-module fixtures for project
@@ -225,13 +309,15 @@ def render() -> str:
         raise SystemExit(
             f"gen_lint_docs: rules missing summary/rationale: {missing}")
     from kepler_tpu.analysis import ProjectRule
-    from kepler_tpu.analysis.engine import DeviceRule
+    from kepler_tpu.analysis.engine import DeviceRule, ProtocolRule
 
     lines = [PREAMBLE]
     lines.append("| Rule | Name | Tier | Scope | Severity | Invariant |")
     lines.append("| --- | --- | --- | --- | --- | --- |")
     for r in rules:
-        if isinstance(r, DeviceRule):
+        if isinstance(r, ProtocolRule):
+            tier, scope = "protocol", "explored protocol models"
+        elif isinstance(r, DeviceRule):
             tier, scope = "device", "traced device programs"
         elif isinstance(r, ProjectRule):
             tier = "whole-program"

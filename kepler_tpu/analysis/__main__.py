@@ -20,6 +20,14 @@ fingerprints (``.kepljax.json``) and exits. ``--only=KTL110,KTL120``
 restricts a run to the named rules — a single-rule iteration loop no
 longer pays every other family's cost (the device tier's trace cost
 made that painful).
+
+``--protocol-tier`` exhaustively explores the registered protocol
+models (``kepler_tpu/analysis/protocol``, the kepmc checker) and runs
+the KTL130-132 families over their reachable state spaces — a couple
+of seconds of BFS, opt-in like the device tier (``make lint`` passes
+it; ``make protocheck`` runs it alone). Naming a KTL13x id in
+``--only`` implies the tier. KTL133 (the protocol-transition marker
+fence) is an ordinary per-file rule and always runs.
 """
 
 from __future__ import annotations
@@ -81,9 +89,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     parser.add_argument("--update-snapshots", action="store_true",
                         help="regenerate the KTL123 golden program "
                              "fingerprints (.kepljax.json) and exit")
+    parser.add_argument("--protocol-tier", action="store_true",
+                        help="also explore the registered protocol "
+                             "models (kepmc) and run the KTL130-132 "
+                             "state-space checks")
     parser.add_argument("--only", default=None, metavar="KTLxxx[,KTLxxx]",
                         help="run only the named rules; naming a KTL12x "
-                             "id implies --device-tier")
+                             "id implies --device-tier, a KTL130-132 id "
+                             "implies --protocol-tier")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -142,10 +155,13 @@ def main(argv: Sequence[str] | None = None) -> int:
     # skipping the only rules the user named (and printing "clean")
     # would be a false all-clear
     device_ids = {"KTL120", "KTL121", "KTL122", "KTL123"}
+    protocol_ids = {"KTL130", "KTL131", "KTL132"}
     if only_ids is None:
         device_wanted = args.device_tier
+        protocol_wanted = args.protocol_tier
     else:
         device_wanted = bool(only_ids & device_ids)
+        protocol_wanted = bool(only_ids & protocol_ids)
 
     def run_lint() -> LintResult:
         result = lint_paths(paths, root=root, rules=rules,
@@ -155,6 +171,13 @@ def main(argv: Sequence[str] | None = None) -> int:
 
             result.diagnostics.extend(
                 analyze_device_programs(root, only=only_ids))
+            result.diagnostics.sort()
+        if protocol_wanted:
+            from kepler_tpu.analysis.protocol import (
+                analyze_protocol_specs)
+
+            result.diagnostics.extend(
+                analyze_protocol_specs(root, only=only_ids))
             result.diagnostics.sort()
         return result
 

@@ -40,6 +40,7 @@ __all__ = [
     "FileContext",
     "LintResult",
     "ProjectRule",
+    "ProtocolRule",
     "REGISTRY",
     "Rule",
     "build_file_context",
@@ -64,7 +65,7 @@ _DIRECTIVE = re.compile(
     r"(?P<kind>disable-file|disable|monotonic-only|hot-loop|"
     r"guarded-by|requires-lock|donates|layout-definition|"
     r"thread-role|role-boundary|role-registrar|forbid-role|allow-role|"
-    r"taint-source|taint-sink|sanitizes)"
+    r"taint-source|taint-sink|sanitizes|protocol-transition)"
     r"(?:=(?P<arg>[A-Za-z0-9_,\- ]+))?")
 
 
@@ -266,6 +267,24 @@ class DeviceRule(Rule):
         return ()
 
     def check_trace(self, report: "object") -> Iterable[Diagnostic]:
+        raise NotImplementedError
+
+
+class ProtocolRule(Rule):
+    """A protocol-tier rule: checks the EXPLORED state space of a
+    registered protocol model (``kepler_tpu/analysis/protocol/``), not
+    source files. The kepmc explorer walks every interleaving of a
+    small fleet through the shipped pure transition code and hands each
+    rule the exploration report; a counterexample (minimal event trace)
+    becomes the diagnostic body. Runs only when the CLI is invoked with
+    ``--protocol-tier`` (exhaustive exploration costs real seconds; the
+    per-file tiers stay instant); registered here so the catalog, SARIF
+    driver and ``--list-rules`` stay complete."""
+
+    def check(self, ctx: FileContext) -> Iterable[Diagnostic]:
+        return ()
+
+    def check_model(self, report: "object") -> Iterable[Diagnostic]:
         raise NotImplementedError
 
 

@@ -17,7 +17,11 @@ whole-program :class:`~kepler_tpu.analysis.project.ProjectContext`
 (call graph, thread roles, lock summaries, taint propagation);
 KTL120-123 are :class:`~kepler_tpu.analysis.engine.DeviceRule`
 families over traced device-program jaxprs
-(:mod:`kepler_tpu.analysis.device`, opt-in via ``--device-tier``).
+(:mod:`kepler_tpu.analysis.device`, opt-in via ``--device-tier``);
+KTL130-132 are :class:`~kepler_tpu.analysis.engine.ProtocolRule`
+families over exhaustively explored protocol state spaces
+(:mod:`kepler_tpu.analysis.protocol`, opt-in via ``--protocol-tier``),
+with KTL133 as their per-file marker-discipline fence.
 """
 
 from __future__ import annotations
@@ -37,3 +41,5 @@ from kepler_tpu.analysis.rules import taint  # noqa: F401  KTL112
 from kepler_tpu.analysis.rules import roles  # noqa: F401  KTL113
 from kepler_tpu.analysis.rules import layout  # noqa: F401  KTL114
 from kepler_tpu.analysis import device as _device  # noqa: F401  KTL120-123
+from kepler_tpu.analysis import protocol as _protocol  # noqa: F401  KTL130-132
+from kepler_tpu.analysis.rules import protocol  # noqa: F401  KTL133

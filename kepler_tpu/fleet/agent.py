@@ -69,6 +69,7 @@ import uuid
 from typing import Any, Callable, Sequence
 
 from kepler_tpu import fault, telemetry
+from kepler_tpu.fleet.delivery import keyframe_wanted
 from kepler_tpu.fleet.ring import coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.spool import Spool, SpoolRecord
 from kepler_tpu.fleet.wire import (WireError, WireLayoutV2,
@@ -311,6 +312,7 @@ def _parse_target(endpoint: str, tls_skip_verify: bool) -> _PeerTarget:
 
 
 class FleetAgent:
+    # keplint: protocol-transition — delivery-state birth
     def __init__(
         self,
         monitor: PowerMonitor,
@@ -558,8 +560,7 @@ class FleetAgent:
                     log.info("shutdown flush stopped (throttled): %s", err)
                     break
                 except NeedsKeyframeError:
-                    self._needs_keyframe = True
-                    self._stats["keyframe_resends"] += 1
+                    self._on_needs_keyframe()
                     continue
                 except _WireDowngradeError:
                     self._v1_until[self._target.url] = \
@@ -745,8 +746,7 @@ class FleetAgent:
                 # the SAME window retries as a full keyframe: the tier
                 # answered (breaker-closing evidence), nothing dropped,
                 # nothing counted as a failure — a 421 in wire clothing
-                self._needs_keyframe = True
-                self._stats["keyframe_resends"] += 1
+                self._on_needs_keyframe()
                 self._note_send_success()
                 continue
             except _WireDowngradeError:
@@ -834,7 +834,7 @@ class FleetAgent:
                 # delivered watermark (any replica's 2xx): stamped into
                 # every transmit header so a NEW owner's gap detection
                 # never counts windows a previous owner acknowledged
-                self._acked_through = max(self._acked_through, sent_seq)
+                self._advance_acked(sent_seq)
             if self._target is not self._last_ok_target:
                 if self._last_ok_target is not None:
                     self._handoff_rewind()
@@ -986,8 +986,7 @@ class FleetAgent:
         loop — the hop budget is frozen at the CONFIGURED peer count
         (not the learned list, which a hostile replica could grow) and
         resets only on a successful send."""
-        if err.epoch is not None and err.epoch > self._ring_epoch:
-            self._ring_epoch = err.epoch
+        self._adopt_epoch(err.epoch)
         if err.owner is None:
             return False
         self._redirect_hops += 1
@@ -1118,11 +1117,16 @@ class FleetAgent:
         if self._wire_version < 2 or self._target_downgraded():
             return transcode_to_v1(body), None
         run, seq = peek_identity(body)
-        want_kf = (self._needs_keyframe or path != "fresh"
-                   or self._kf_base is None
-                   or run != self._run_nonce
-                   or self._since_keyframe + 1 >= self._keyframe_every)
-        if not want_kf:
+        # the keyframe/delta choice is the PURE predicate
+        # (fleet/delivery.py, model-checked by kepmc) — the 409
+        # convergence property lives there
+        want_kf = keyframe_wanted(
+            needs_keyframe=self._needs_keyframe, delivery_path=path,
+            has_base=self._kf_base is not None,
+            run_matches=(run == self._run_nonce),
+            since_keyframe=self._since_keyframe,
+            keyframe_every=self._keyframe_every)
+        if not want_kf and self._kf_base is not None:
             delta = encode_delta_v2(body, self._kf_base[1])
             if delta is not None:
                 return delta, ("delta",)
@@ -1130,15 +1134,38 @@ class FleetAgent:
             return body, ("kf", seq, body)
         return body, None
 
+    # keplint: protocol-transition — adopt an ACCEPTED keyframe as the
+    # delta base (runs for spooled keyframes the owner concluded too)
+    def _adopt_kf_base(self, seq: int, body: bytes) -> None:
+        self._kf_base = (seq, body)
+        self._since_keyframe = 0
+        self._needs_keyframe = False
+
+    # keplint: protocol-transition — a 409 latches the forced keyframe:
+    # the NEXT send of this window always ships full (convergence)
+    def _on_needs_keyframe(self) -> None:
+        self._needs_keyframe = True
+        self._stats["keyframe_resends"] += 1
+
+    # keplint: protocol-transition — delivered watermark: a seq SOME
+    # replica 2xx'd; monotonic, stamped into every transmit header
+    def _advance_acked(self, seq: int) -> None:
+        self._acked_through = max(self._acked_through, seq)
+
+    # keplint: protocol-transition — the ring epoch only ratchets
+    # forward (stale redirects/accepts can never regress it)
+    def _adopt_epoch(self, epoch: int | None) -> None:
+        if epoch is not None and epoch > self._ring_epoch:
+            self._ring_epoch = epoch
+
+    # keplint: protocol-transition — delta-cadence tick
     def _after_wire_success(self, info: "tuple | None") -> None:
         """A 2xx landed: adopt the keyframe as the delta base, or tick
         the delta cadence toward the next scheduled keyframe."""
         if info is None:
             return
         if info[0] == "kf":
-            self._kf_base = (info[1], info[2])
-            self._since_keyframe = 0
-            self._needs_keyframe = False
+            self._adopt_kf_base(info[1], info[2])
             self._stats["keyframes_sent"] += 1
         else:
             self._since_keyframe += 1
@@ -1242,10 +1269,8 @@ class FleetAgent:
     def _learn_epoch(self, headers: Any) -> None:
         """Lazy epoch learning: accepts advertise the ring epoch too,
         so a settled agent still notices a membership bump."""
-        epoch = coerce_epoch(
-            _epoch_from_header(headers.get("X-Kepler-Epoch")))
-        if epoch is not None and epoch > self._ring_epoch:
-            self._ring_epoch = epoch
+        self._adopt_epoch(coerce_epoch(
+            _epoch_from_header(headers.get("X-Kepler-Epoch"))))
 
     def _post(self, body: bytes, path: str = "fresh",
               appended_at: float | None = None) -> None:
@@ -1454,11 +1479,9 @@ class FleetAgent:
             break  # per-record 5xx: not concluded; retries later
         self._stats["drain_batch_records"] += concluded
         if top_seq:
-            self._acked_through = max(self._acked_through, top_seq)
+            self._advance_acked(top_seq)
         if kf_base is not None:
-            self._kf_base = kf_base
-            self._since_keyframe = 0
-            self._needs_keyframe = False
+            self._adopt_kf_base(kf_base[0], kf_base[1])
         if wire_downgrade and concluded == 0:
             # nothing concluded: surface the downgrade so the drain
             # marks the target v1-only and retries the SAME batch

@@ -51,6 +51,12 @@ from kepler_tpu.fleet.admission import (
     PRIORITY_REPLAY_GROUND,
     AdmissionController,
 )
+from kepler_tpu.fleet.delivery import (
+    SeqTracker,
+    delta_base_matches,
+    reseed_on_ownership_return,
+    seed_fresh_tracker,
+)
 from kepler_tpu.fleet.membership import (
     AutoscaleDecision,
     AutoscalePolicy,
@@ -58,6 +64,7 @@ from kepler_tpu.fleet.membership import (
     CoordinatorLease,
     MembershipError,
     elect_successor,
+    plan_membership_apply,
     plan_succession,
     validate_membership_payload,
 )
@@ -280,56 +287,11 @@ class _FetchWorker:
             return None
 
 
-class _SeqTracker:
-    """Per-(node, run) sequence accounting: a bounded window of recently
-    seen seqs (dedup — spool replays are idempotent) plus gap detection
-    (a seq jump is LOST windows, surfaced as a per-node counter instead
-    of silence). Caller holds the aggregator's store lock."""
-
-    __slots__ = ("run", "max_seen", "seen", "order", "window", "touched",
-                 "epoch")
-
-    def __init__(self, run: str, window: int) -> None:
-        self.run = run
-        self.max_seen = 0
-        self.seen: set[int] = set()
-        self.order: collections.deque[int] = collections.deque()
-        self.window = max(1, window)
-        self.touched = 0.0  # aggregator clock; drives cap eviction
-        self.epoch = 0  # ring epoch at last observe (ownership-return)
-
-    def observe(self, seq: int) -> tuple[bool, int]:
-        """→ (is_duplicate, windows_lost_by_this_arrival).
-
-        A seq inside the dedup window that was already seen — or one so
-        old it fell out of the window — is a duplicate (at-least-once
-        redelivery): ack-worthy but not ingestable. A seq jumping past
-        ``max_seen + 1`` reports the skipped windows as lost; a late
-        out-of-order FILL of a previously-counted gap is ingested but
-        cannot retroactively decrement the loss counter (counters only
-        go up; ordered spool replay makes real fills rare).
-
-        Accounting is CONSERVATIVE: loss = windows this tracker never
-        saw. A fresh aggregator meeting a mid-run stream (aggregator
-        restart) counts the pre-restart windows as a one-time spike —
-        indistinguishable, from seq alone, from an agent whose first
-        windows died before delivery, and the latter must be counted."""
-        if seq in self.seen:
-            return True, 0
-        if seq <= self.max_seen - self.window:
-            return True, 0  # beyond the window: can't tell — stay idempotent
-        self.seen.add(seq)
-        self.order.append(seq)
-        while len(self.order) > self.window:
-            self.seen.discard(self.order.popleft())
-        lost = 0
-        if seq > self.max_seen + 1:
-            # seq numbers start at 1 within a run: a first-seen seq of N
-            # means windows 1..N-1 died before delivery (ring overflow,
-            # spool eviction, disk failure)
-            lost = seq - self.max_seen - 1
-        self.max_seen = max(self.max_seen, seq)
-        return False, lost
+# the dedup/gap tracker moved to the PURE decision layer
+# (fleet/delivery.py) so the kepmc protocol checker drives the exact
+# observe/seed transitions this ingest path runs; the old private name
+# stays as the module-local spelling
+_SeqTracker = SeqTracker
 
 
 class FleetResults:
@@ -436,6 +398,7 @@ class FleetResults:
 class Aggregator:
     """Service: report store + periodic sharded attribution."""
 
+    # keplint: protocol-transition — ingest-state birth
     def __init__(
         self,
         server: APIServer,
@@ -1212,6 +1175,7 @@ class Aggregator:
                     b"replica down (fault injection)\n")
         return self._ingest_payload(request.body, parsed)
 
+    # keplint: protocol-transition — base-row LRU touch
     def _delta_base_for(self, parsed: "ParsedHeader"
                         ) -> "_BaseRow | None":
         """Resolve a v2 delta frame's base keyframe. None = answer a
@@ -1227,8 +1191,9 @@ class Aggregator:
         run = parsed.header.get("run")
         with self._lock:
             base = self._base_rows.get(name)
-            if (base is None or base.run != run
-                    or base.seq != parsed.base_seq):
+            if (base is None or not isinstance(run, str)
+                    or not delta_base_matches(base.run, base.seq,
+                                              run, parsed.base_seq)):
                 self._stats["keyframe_requests_total"] += 1
                 return None
             self._base_rows[name] = self._base_rows.pop(name)  # LRU touch
@@ -1244,6 +1209,7 @@ class Aggregator:
                       **self._epoch_headers()}, body)
 
     # keplint: requires-lock=_lock
+    # keplint: protocol-transition — keyframe plants the delta base
     def _store_base_locked(self, name: str, run: str, seq: int,
                            report: NodeReport,
                            zones: tuple[str, ...]) -> None:
@@ -1463,35 +1429,20 @@ class Aggregator:
                             self._seq_trackers,
                             key=lambda n: self._seq_trackers[n].touched))
                     tracker = _SeqTracker(stored.run, self._dedup_window)
-                    if acked_through > 0 and stored.seq > 0:
-                        # hand-off / restart seeding: the agent asserts
-                        # every seq ≤ acked_through got a 2xx from SOME
-                        # replica — delivered to a previous owner (or a
-                        # previous incarnation of this one), not lost.
-                        # min() clamps a stale or hostile watermark to
-                        # this report's own leading gap, so an agent can
-                        # only vouch for (or hide) its OWN stream.
-                        tracker.max_seen = min(acked_through,
-                                               stored.seq - 1)
+                    # hand-off / restart seeding from the agent's
+                    # delivered watermark (pure rule: fleet/delivery.py)
+                    seed_fresh_tracker(tracker, acked_through,
+                                       stored.seq)
                     self._seq_trackers[report.node_name] = tracker
                 tracker.touched = received
-                # ownership RETURN (elastic membership): this replica
-                # owned the node under an earlier epoch, lost it to a
-                # join/scale-up, and got it back on a leave/succession.
-                # Its tracker slept through the away period, but the
-                # agent's watermark vouches those windows were 2xx'd by
-                # the interim owner — delivered, not lost. Gated on an
-                # actual epoch advance and min()-clamped exactly like
-                # fresh-tracker seeding, so with membership at rest an
-                # inflated watermark still hides nothing.
+                # ownership RETURN (elastic membership): the PR 16
+                # re-seed rule — the away period's windows were 2xx'd
+                # by the interim owner, not lost (pure rule:
+                # fleet/delivery.py, model-checked by kepmc)
                 ring_epoch = (self._ring.epoch
                               if self._ring is not None else 0)
-                if (ring_epoch > tracker.epoch
-                        and acked_through > tracker.max_seen):
-                    tracker.max_seen = max(
-                        tracker.max_seen,
-                        min(acked_through, stored.seq - 1))
-                tracker.epoch = ring_epoch
+                reseed_on_ownership_return(tracker, ring_epoch,
+                                           acked_through, stored.seq)
                 dup, lost = tracker.observe(stored.seq)
                 if dup:
                     # at-least-once redelivery (spool replay, LB retry):
@@ -1624,49 +1575,21 @@ class Aggregator:
             raise MembershipError(
                 "ring_disabled",
                 "ingest ring is not enabled (aggregator.peers is empty)")
-        ep = coerce_epoch(epoch)
-        if ep is None or ep < 1:
-            raise MembershipError(
-                "bad_epoch",
-                f"membership epoch must be a positive int, got {epoch!r}")
-        cleaned: list[str] = []
-        for raw in peers:
-            peer = sanitize_peer(raw)
-            if peer is None:
-                raise MembershipError(
-                    "bad_peer", f"invalid membership peer {raw!r}")
-            if peer not in cleaned:
-                cleaned.append(peer)
-        if not cleaned:
-            raise MembershipError("bad_peer",
-                                  "membership needs at least one peer")
         current = self._ring
-        if ep < current.epoch:
-            raise MembershipError(
-                "stale_epoch",
-                f"membership epoch {ep} is behind the current epoch "
-                f"{current.epoch}")
-        if ep == current.epoch:
-            if set(cleaned) == set(current.peers):
-                # idempotent replay: a re-delivered broadcast, or an
-                # operator re-running the change they already made
-                log.info("membership replay at epoch %d ignored (same "
-                         "peer set, digest %s)", ep,
-                         current.membership_digest)
-                return 0
-            raise MembershipError(
-                "equal_epoch_conflict",
-                f"membership at epoch {ep} already applied with a "
-                f"DIFFERENT peer set (digest "
-                f"{current.membership_digest}); a second writer "
-                f"proposed {sorted(set(cleaned))!r}")
-        retired = self._self_peer not in cleaned
-        if retired and source == "operator":
-            raise MembershipError(
-                "self_excluded",
-                f"self peer {self._self_peer!r} is not in the new "
-                f"membership {sorted(cleaned)!r}")
-        new = self._build_ring(cleaned, ep, mesh=mesh)
+        # the whole epoch/peer-set state machine is the PURE decision
+        # (fleet/membership.py, model-checked by kepmc); this method
+        # only wires its verdict to the ring/lease/stores
+        decision = plan_membership_apply(
+            current.epoch, current.peers, current.membership_digest,
+            epoch, peers, self._self_peer, source)
+        ep = decision.epoch
+        if decision.action == "replay":
+            log.info("membership replay at epoch %d ignored (same "
+                     "peer set, digest %s)", ep,
+                     current.membership_digest)
+            return 0
+        retired = decision.retired
+        new = self._build_ring(list(decision.peers), ep, mesh=mesh)
         who = issuer or plan_succession(
             self._lease.holder if self._lease is not None else "",
             new.peers)

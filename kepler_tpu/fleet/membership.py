@@ -54,9 +54,11 @@ __all__ = [
     "AutoscalePolicy",
     "AutoscaleSignals",
     "CoordinatorLease",
+    "MembershipDecision",
     "MembershipError",
     "elect_successor",
     "lease_id_of",
+    "plan_membership_apply",
     "plan_succession",
     "sanitize_lease_id",
     "validate_membership_payload",
@@ -147,6 +149,7 @@ class CoordinatorLease:
 
     __slots__ = ("_holder", "_epoch")
 
+    # keplint: protocol-transition — birth of a lease belief
     def __init__(self, holder: str, epoch: int = 1) -> None:
         cleaned = sanitize_peer(holder)
         if cleaned is None:
@@ -176,6 +179,7 @@ class CoordinatorLease:
         """Who issues the next membership over ``survivors``."""
         return plan_succession(self._holder, survivors)
 
+    # keplint: protocol-transition — the ONLY way a lease belief moves
     def adopt(self, holder: str, epoch: int) -> None:
         """Advance the lease to ``(holder, epoch)``. Monotonic: a stale
         epoch is rejected, and an equal-epoch HOLDER conflict (two
@@ -205,6 +209,79 @@ class CoordinatorLease:
     def describe(self) -> dict:
         return {"holder": self._holder, "epoch": self._epoch,
                 "lease_id": self.lease_id}
+
+
+# -- membership apply -------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MembershipDecision:
+    """The pure verdict on one membership proposal against the current
+    ring: apply it (at ``epoch`` over ``peers``, possibly retiring this
+    replica) or treat it as an idempotent replay. Rejections are raised,
+    never returned — a decision object always means "safe to act"."""
+
+    action: str  # "apply" | "replay"
+    epoch: int
+    peers: tuple[str, ...]
+    retired: bool = False
+
+
+def plan_membership_apply(current_epoch: int,
+                          current_peers: Sequence[str],
+                          current_digest: str,
+                          epoch: object, peers: Iterable[object],
+                          self_peer: str,
+                          source: str) -> MembershipDecision:
+    """Decide one membership proposal. Pure: the whole epoch/peer-set
+    state machine — epoch coercion, peer laundering + order-preserving
+    dedupe, the stale/replay/equal-epoch-conflict ladder, and the
+    retirement-vs-typo rule for a set that excludes ``self_peer`` —
+    with no ring, lock, or counter in sight, so kepmc can walk every
+    proposal order a fleet of replicas could produce.
+
+    Raises :class:`MembershipError` (``bad_epoch`` / ``bad_peer`` /
+    ``stale_epoch`` / ``equal_epoch_conflict`` / ``self_excluded``) on
+    any proposal that must not touch the ring."""
+    ep = coerce_epoch(epoch)
+    if ep is None or ep < 1:
+        raise MembershipError(
+            "bad_epoch",
+            f"membership epoch must be a positive int, got {epoch!r}")
+    cleaned: list[str] = []
+    for raw in peers:
+        peer = sanitize_peer(raw)
+        if peer is None:
+            raise MembershipError(
+                "bad_peer", f"invalid membership peer {raw!r}")
+        if peer not in cleaned:
+            cleaned.append(peer)
+    if not cleaned:
+        raise MembershipError("bad_peer",
+                              "membership needs at least one peer")
+    if ep < current_epoch:
+        raise MembershipError(
+            "stale_epoch",
+            f"membership epoch {ep} is behind the current epoch "
+            f"{current_epoch}")
+    if ep == current_epoch:
+        if set(cleaned) == set(current_peers):
+            # idempotent replay: a re-delivered broadcast, or an
+            # operator re-running the change they already made
+            return MembershipDecision(action="replay", epoch=ep,
+                                      peers=tuple(cleaned))
+        raise MembershipError(
+            "equal_epoch_conflict",
+            f"membership at epoch {ep} already applied with a "
+            f"DIFFERENT peer set (digest {current_digest}); a second "
+            f"writer proposed {sorted(set(cleaned))!r}")
+    retired = self_peer not in cleaned
+    if retired and source == "operator":
+        raise MembershipError(
+            "self_excluded",
+            f"self peer {self_peer!r} is not in the new membership "
+            f"{sorted(cleaned)!r}")
+    return MembershipDecision(action="apply", epoch=ep,
+                              peers=tuple(cleaned), retired=retired)
 
 
 # -- membership wire payloads ----------------------------------------------

@@ -100,6 +100,8 @@ class HashRing:
 
     __slots__ = ("_peers", "_epoch", "_vnodes", "_points", "_owners")
 
+    # keplint: protocol-transition — a ring (and its epoch) is born
+    # immutable; with_members builds a NEW ring at a HIGHER epoch
     def __init__(self, peers: Iterable[str], epoch: int = 1,
                  vnodes: int = DEFAULT_VNODES) -> None:
         cleaned: list[str] = []

@@ -59,6 +59,7 @@ from dataclasses import dataclass
 from typing import BinaryIO, Callable
 
 from kepler_tpu import fault
+from kepler_tpu.fleet.delivery import plan_ack_cursor, plan_rewind_tail
 from kepler_tpu.utils.atomicio import atomic_write_json
 
 log = logging.getLogger("kepler.fleet.spool")
@@ -114,6 +115,7 @@ class Spool:
     write (+ a batched fsync at most once per ``fsync_interval``).
     """
 
+    # keplint: protocol-transition — cursor birth state
     def __init__(
         self,
         directory: str,
@@ -165,6 +167,7 @@ class Spool:
     # -- open / recovery ---------------------------------------------------
 
     # keplint: requires-lock=_lock
+    # keplint: protocol-transition — recovery clamps the persisted cursor
     def _open(self) -> None:
         os.makedirs(self._dir, exist_ok=True)
         cursor = self._load_cursor()
@@ -405,6 +408,7 @@ class Spool:
     # -- eviction (byte/record caps) ----------------------------------------
 
     # keplint: requires-lock=_lock
+    # keplint: protocol-transition — eviction hops the cursor off dead segments
     def _evict_for_locked(self, incoming: int) -> None:
         """Evict oldest sealed segments until the incoming frame fits the
         caps. Unacked records in an evicted segment are LOST — counted in
@@ -446,6 +450,7 @@ class Spool:
 
     # -- drain (peek / ack) --------------------------------------------------
 
+    # keplint: protocol-transition — the exhausted-segment cursor hop
     def peek(self) -> SpoolRecord | None:
         """Next unacked record, or None when fully drained. Repeated
         peeks without an ack return the same record."""
@@ -525,6 +530,7 @@ class Spool:
         return out
 
     # keplint: requires-lock=_lock
+    # keplint: protocol-transition — corrupt-region skip moves the cursor
     def _read_at_locked(self, seg: int, offset: int) -> SpoolRecord | None:
         if self._read_fh is None or self._read_seg != seg:
             self._close_read_locked()
@@ -579,6 +585,7 @@ class Spool:
                            segment=seg, offset=offset,
                            recovered=(seg, offset) < self._open_tail)
 
+    # keplint: protocol-transition
     def ack(self, rec: SpoolRecord | None = None) -> None:
         """Advance the cursor past ``rec`` (the record whose delivery
         concluded — 2xx or permanent 4xx) and persist it.
@@ -593,30 +600,27 @@ class Spool:
                 rec = self._peeked
             if rec is None:
                 return
-            if (rec.segment, rec.offset) != (self._cursor_seg,
-                                             self._cursor_off):
-                # batched acks (peek_batch) walk records the cursor has
-                # not peeked: crossing a rotation leaves the cursor at a
-                # sealed segment's END while the record is the FIRST
-                # frame of the next segment — the hop peek() would have
-                # performed. Accept exactly that case; anything else
-                # means the cursor moved underneath us (cap eviction, a
-                # concurrent re-peek) and acking would skip a different
-                # record.
-                end = (self._active_bytes
-                       if self._cursor_seg == self._active
-                       else self._segments.get(self._cursor_seg,
-                                               (0, 0))[1])
-                nxt = [i for i in [*self._segments, self._active]
-                       if i > self._cursor_seg]
-                if not (self._cursor_off >= end and nxt
-                        and rec.segment == min(nxt)
-                        and rec.offset == 0):
-                    return
+            # validation against the CURRENT cursor — including the ONE
+            # segment hop batched acks (peek_batch) legitimately cross —
+            # is the PURE cursor rule (fleet/delivery.py, model-checked
+            # by kepmc); anything it rejects means the cursor moved
+            # underneath us (cap eviction, a concurrent re-peek) and
+            # acking would skip a record that was never sent
+            end = (self._active_bytes
+                   if self._cursor_seg == self._active
+                   else self._segments.get(self._cursor_seg,
+                                           (0, 0))[1])
+            nxt = [i for i in [*self._segments, self._active]
+                   if i > self._cursor_seg]
+            new_cursor = plan_ack_cursor(
+                (self._cursor_seg, self._cursor_off),
+                (rec.segment, rec.offset),
+                rec.offset + _FRAME.size + len(rec.payload),
+                end, min(nxt) if nxt else None)
+            if new_cursor is None:
+                return
             self._peeked = None
-            self._cursor_seg = rec.segment
-            self._cursor_off = (rec.offset + _FRAME.size
-                                + len(rec.payload))
+            self._cursor_seg, self._cursor_off = new_cursor
             self._pending_records = max(0, self._pending_records - 1)
             self._stats["acked_total"] += 1
             self._persist_cursor_locked()
@@ -629,6 +633,7 @@ class Spool:
                 except OSError:
                     pass
 
+    # keplint: protocol-transition
     def rewind(self, max_records: int) -> int:
         """Move the ack cursor BACK over up to ``max_records`` already-
         acknowledged records so they re-deliver.
@@ -671,8 +676,10 @@ class Spool:
                 log.warning("spool rewind failed (%s); tail not "
                             "re-delivered", err)
                 return 0
-            tail = [s for s in starts if s < self._cursor_off]
-            tail = tail[-max_records:]
+            # which acked frames re-deliver is the PURE rewind rule
+            # (fleet/delivery.py, model-checked by kepmc)
+            tail = plan_rewind_tail(starts, self._cursor_off,
+                                    max_records)
             if not tail:
                 return 0
             self._cursor_off = tail[0]

@@ -45,11 +45,11 @@ def ids(diags):
 
 
 class TestEngine:
-    def test_registry_has_eighteen_domain_rules(self):
+    def test_registry_has_twenty_two_domain_rules(self):
         rules = all_rules()
         assert [r.id for r in rules] == sorted(r.id for r in rules)
-        assert len(rules) == 18
-        assert len({r.name for r in rules}) == 18
+        assert len(rules) == 22
+        assert len({r.name for r in rules}) == 22
         for r in rules:
             assert r.summary and r.rationale, f"{r.id} lacks docs"
         ids = {r.id for r in rules}
@@ -57,6 +57,8 @@ class TestEngine:
         assert {"KTL111", "KTL112", "KTL113"} <= ids
         # ISSUE 10: the layout contract + device-tier families
         assert {"KTL114", "KTL120", "KTL121", "KTL122", "KTL123"} <= ids
+        # ISSUE 17: the kepmc protocol tier + the transition-marker fence
+        assert {"KTL130", "KTL131", "KTL132", "KTL133"} <= ids
 
     def test_syntax_error_reports_ktl000(self, lint):
         diags = lint("def broken(:\n")

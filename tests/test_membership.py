@@ -2,12 +2,19 @@
 properties, runtime join/leave over the /v1/membership plane, the
 equal-epoch split-brain detector, and the autoscale hysteresis policy.
 
-The succession suite is property-style: every subset of a 5-peer set
-elects exactly one issuer, concurrent deaths converge, and a rejoining
-peer never self-elects over a live lease. The aggregator tier runs five
-REAL aggregators wired through injected liveness/delivery seams (no
-sockets), so the "exactly one survivor bumps the epoch" pin covers the
-actual `_demote_mesh` → `apply_membership` → broadcast code path.
+The succession properties have ONE source of truth since ISSUE 17: the
+kepmc lease model (`kepler_tpu/analysis/protocol`) drives the SAME
+pure functions — `plan_succession`, `plan_membership_apply`,
+`CoordinatorLease.adopt` — through EVERY interleaving of crash, leave,
+false-suspect probing, duplicate/reordered delivery and restart at the
+declared scopes, and the KTL130 invariants (no split-brain,
+holder-in-peers, contiguous epochs, no await-wedge) are checked in
+every reachable state. This suite asserts against that explored state
+space; the hand-rolled 5-peer subset sweeps remain as concrete
+regression anchors on the pure functions. The aggregator tier runs
+five REAL aggregators wired through injected liveness/delivery seams
+(no sockets), so the "exactly one survivor bumps the epoch" pin covers
+the actual `_demote_mesh` → `apply_membership` → broadcast code path.
 """
 
 from __future__ import annotations
@@ -53,6 +60,49 @@ def every_subset(peers):
 
 
 class TestSuccessionProperties:
+    """Universal claims are model-checked (kepmc explores every
+    interleaving, not a subset sweep); the 5-peer pins below anchor the
+    pure functions against concrete inputs."""
+
+    @staticmethod
+    def _explored(spec_name):
+        from kepler_tpu.analysis.protocol import (explore_case,
+                                                  spec_by_name)
+
+        spec = spec_by_name(spec_name)
+        return spec, [(case, explore_case(spec, case).result)
+                      for case in spec.cases]
+
+    def test_succession_state_space_has_no_counterexamples(self):
+        """The former exactly-one-leader / concurrent-deaths-converge /
+        no-self-elect sweeps, generalized: over EVERY reachable
+        interleaving of the lease model (crash, leave, delivery in any
+        order and multiplicity, restart), the KTL130 invariant set
+        holds. A regression in plan_succession or the lease adopt rules
+        surfaces here as a minimal counterexample trace."""
+        spec, runs = self._explored("lease.succession")
+        assert {"no-split-brain", "holder-in-peers",
+                "contiguous-epochs", "no-await-wedge"} \
+            <= set(spec.invariants)
+        for case, result in runs:
+            assert result.ok, "\n\n".join(
+                cex.format() for cex in result.counterexamples)
+            # exhaustive exploration, not a smoke probe: the N=3 case
+            # must visit thousands of states
+            assert result.states >= 50, (case.name, result.states)
+
+    def test_partitioned_probe_state_space_has_no_counterexamples(self):
+        """False-suspect probing (a partitioned prober declares the
+        live holder dead and mints a competing lease): transient dual
+        holders are legal there, but the holder stays a member of its
+        own peer set and epochs stay contiguous — the equal-epoch
+        conflict rejection does the rest (pinned directly below)."""
+        spec, runs = self._explored("lease.partitioned")
+        for case, result in runs:
+            assert result.ok, "\n\n".join(
+                cex.format() for cex in result.counterexamples)
+            assert result.states >= 1000, (case.name, result.states)
+
     def test_every_subset_elects_exactly_one_leader(self):
         """For EVERY non-empty subset of a 5-peer set, every survivor
         computes the same single issuer — the "exactly one writer"

@@ -1,0 +1,684 @@
+"""kepmc protocol-tier tests: explorer semantics, registry hygiene,
+the shipped-tree exhaustive explorations (zero counterexamples, with
+state counts pinned as coverage floors), the PR 16 bug variants
+re-discovered as minimal counterexample traces, the KTL133 marker
+fence, and the CLI/SARIF surface.
+
+The bug-variant tests are the negative-path proof the ISSUE asks for:
+each re-introduces exactly one pre-fix behavior (``models.py``
+variants), asserts kepmc finds it, pins the minimal event schedule,
+and REPLAYS that schedule step-by-step through the model's successor
+relation to show the trace is a real executable counterexample, not a
+formatting artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from kepler_tpu.analysis import all_rules
+from kepler_tpu.analysis.__main__ import main as keplint_main
+from kepler_tpu.analysis.__main__ import render_sarif
+from kepler_tpu.analysis.engine import LintResult, ProtocolRule, lint_file
+from kepler_tpu.analysis.protocol import (
+    Counterexample,
+    ExplorationResult,
+    INVARIANT_RULE,
+    MODEL_BUILDERS,
+    ModelReport,
+    PROTOCOL_RULE_IDS,
+    PROTOCOL_SPECS,
+    ProtocolCase,
+    ProtocolSpec,
+    StateExplosionError,
+    analyze_protocol_specs,
+    build_model,
+    clear_exploration_cache,
+    explore,
+    explore_case,
+    spec_by_name,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def replay(model, trace):
+    """Execute an event trace against the model's successor relation
+    and return the state it lands in — every label must be enabled in
+    order, so a passing replay proves the counterexample schedule is
+    executable from the initial state."""
+    state = model.initial()
+    for label in trace:
+        succ = dict(model.successors(state))
+        assert label in succ, (
+            f"trace event {label!r} not enabled; "
+            f"enabled: {sorted(succ)}")
+        state = succ[label]
+    return state
+
+
+def violated(model, state):
+    return {inv for inv, _ in model.violations(state)}
+
+
+# ---------------------------------------------------------------------------
+# explorer semantics (tiny hand-rolled models)
+# ---------------------------------------------------------------------------
+
+
+class _Chain:
+    """0 -> 1 -> ... -> n with the invariant violated only at n."""
+
+    def __init__(self, n=3):
+        self.n = n
+
+    def initial(self):
+        return 0
+
+    def successors(self, state):
+        if state < self.n:
+            yield f"step({state + 1})", state + 1
+
+    def violations(self, state):
+        if state == self.n:
+            yield "too-far", "walked off the end of the chain"
+
+    def describe_state(self, state):
+        return f"s={state}"
+
+
+class _TwoRoutes:
+    """A 1-event and a 2-event route to the same bad state: BFS must
+    report the short one."""
+
+    def initial(self):
+        return "a"
+
+    def successors(self, state):
+        if state == "a":
+            yield "long-1", "b"
+            yield "short", "bad"
+        elif state == "b":
+            yield "long-2", "bad"
+
+    def violations(self, state):
+        if state == "bad":
+            yield "boom", "reached the bad state"
+
+    def describe_state(self, state):
+        return state
+
+
+class _Cycle:
+    """a <-> b with a self-loop: duplicate/reorder edges revisit seen
+    states and exploration must still terminate."""
+
+    def initial(self):
+        return "a"
+
+    def successors(self, state):
+        yield "swap", ("b" if state == "a" else "a")
+        yield "stay", state
+
+    def violations(self, state):
+        return ()
+
+    def describe_state(self, state):
+        return state
+
+
+class _Wedge:
+    """0 can hop to 2 and back, but 1 is a dead end: with goal `at 0`
+    the possibility check must flag 1 as a wedge."""
+
+    goal_name = "home-reachable"
+
+    def initial(self):
+        return 0
+
+    def successors(self, state):
+        if state == 0:
+            yield "stick", 1
+            yield "hop", 2
+        elif state == 2:
+            yield "home", 0
+
+    def violations(self, state):
+        return ()
+
+    def describe_state(self, state):
+        return f"s={state}"
+
+    @staticmethod
+    def goal(state):
+        return state == 0
+
+
+class TestExplorer:
+    def test_chain_counts_and_minimal_trace(self):
+        result = explore(_Chain(3))
+        assert result.states == 4
+        assert result.transitions == 3
+        assert result.depth == 3
+        assert not result.ok
+        (cex,) = result.counterexamples
+        assert cex.invariant == "too-far"
+        assert cex.trace == ("step(1)", "step(2)", "step(3)")
+        assert cex.state_repr == "s=3"
+
+    def test_format_shows_numbered_schedule(self):
+        (cex,) = explore(_Chain(2)).counterexamples
+        text = cex.format()
+        assert "invariant `too-far` violated" in text
+        assert "minimal trace (2 event(s))" in text
+        assert "  1. step(1)" in text
+        assert "  2. step(2)" in text
+        assert "=> s=2" in text
+
+    def test_initial_state_violation_has_empty_trace(self):
+        class Born:
+            def initial(self):
+                return "bad"
+
+            def successors(self, state):
+                return ()
+
+            def violations(self, state):
+                yield "born-bad", "initial state violates"
+
+            def describe_state(self, state):
+                return state
+
+        result = explore(Born())
+        (cex,) = result.counterexamples
+        assert cex.trace == ()
+        assert "(initial state)" in cex.format()
+
+    def test_bfs_reports_shortest_route(self):
+        (cex,) = explore(_TwoRoutes()).counterexamples
+        assert cex.trace == ("short",)
+
+    def test_revisits_terminate_and_count_once(self):
+        result = explore(_Cycle())
+        assert result.ok
+        assert result.states == 2
+        # every edge is walked (2 per state), revisits just dedupe
+        assert result.transitions == 4
+
+    def test_state_explosion_raises_instead_of_truncating(self):
+        with pytest.raises(StateExplosionError, match="scope cap"):
+            explore(_Chain(100), max_states=10)
+        model = build_model("lease", {"replicas": 2, "epoch_cap": 4})
+        with pytest.raises(StateExplosionError):
+            explore(model, max_states=10)
+
+    def test_goal_check_flags_unrecoverable_state(self):
+        result = explore(_Wedge())
+        (cex,) = result.counterexamples
+        assert cex.invariant == "home-reachable"
+        assert cex.trace == ("stick",)
+        assert "1 reachable state(s) can NEVER reach the goal" in cex.detail
+
+    def test_goal_event_filter_restricts_recovery_edges(self):
+        model = _Wedge()
+        model.goal_event_ok = lambda label: label != "home"
+        (cex,) = explore(model).counterexamples
+        assert cex.invariant == "home-reachable"
+        # without the home edge, state 2 is wedged too
+        assert "2 reachable state(s)" in cex.detail
+
+    def test_determinism_same_exploration_state_for_state(self):
+        model = build_model("lease", {"replicas": 2, "epoch_cap": 4})
+        a = explore(model)
+        b = explore(build_model("lease", {"replicas": 2,
+                                          "epoch_cap": 4}))
+        assert (a.states, a.transitions, a.depth) == \
+            (b.states, b.transitions, b.depth) == (77, 102, 7)
+
+
+# ---------------------------------------------------------------------------
+# registry hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_every_declared_invariant_is_rule_mapped(self):
+        for spec in PROTOCOL_SPECS:
+            unmapped = set(spec.invariants) - set(INVARIANT_RULE)
+            assert not unmapped, (spec.name, unmapped)
+
+    def test_invariant_rule_targets_are_protocol_rules(self):
+        assert set(INVARIANT_RULE.values()) == set(PROTOCOL_RULE_IDS)
+
+    def test_models_and_sources_exist(self):
+        names = [spec.name for spec in PROTOCOL_SPECS]
+        assert len(names) == len(set(names))
+        for spec in PROTOCOL_SPECS:
+            assert spec.model in MODEL_BUILDERS
+            assert os.path.exists(os.path.join(REPO, spec.source)), \
+                spec.source
+            case_names = [c.name for c in spec.cases]
+            assert case_names and len(case_names) == len(set(case_names))
+
+    def test_spec_by_name_roundtrip(self):
+        assert spec_by_name("lease.succession").model == "lease"
+        with pytest.raises(KeyError):
+            spec_by_name("no.such.spec")
+
+    def test_build_model_rejects_unknown_model(self):
+        with pytest.raises(ValueError, match="unknown protocol model"):
+            build_model("nope")
+
+    def test_protocol_rules_registered_with_docs(self):
+        by_id = {r.id: r for r in all_rules()}
+        for rid in PROTOCOL_RULE_IDS:
+            assert rid in by_id
+            assert isinstance(by_id[rid], ProtocolRule)
+            assert by_id[rid].summary and by_id[rid].rationale
+        # the marker fence is an ordinary per-file rule, not tier-gated
+        assert "KTL133" in by_id
+        assert not isinstance(by_id["KTL133"], ProtocolRule)
+
+
+# ---------------------------------------------------------------------------
+# shipped tree: exhaustive, clean, at meaningful scope
+# ---------------------------------------------------------------------------
+
+# measured reachable-state counts double as coverage fingerprints: a
+# model edit that silently hollows out the state space (and with it the
+# all-clear) trips these floors
+STATE_FLOORS = {
+    "lease.succession/n2_e4": 70,
+    "lease.succession/n3_e5": 4_000,
+    "lease.partitioned/n3_e4_suspects": 15_000,
+    "seq.delivery/k6_w2_e4": 30_000,
+    "spool.cursor/r5_s2": 85,
+    "keyframe.delta/k4_every2": 400,
+}
+
+ALL_CASES = [(spec, case) for spec in PROTOCOL_SPECS
+             for case in spec.cases]
+
+
+class TestShippedStateSpaces:
+    @pytest.mark.parametrize(
+        "spec,case", ALL_CASES,
+        ids=[f"{s.name}/{c.name}" for s, c in ALL_CASES])
+    def test_exhaustive_exploration_is_clean(self, spec, case):
+        report = explore_case(spec, case)
+        result = report.result
+        print(f"{report.key}: {result.states} states / "
+              f"{result.transitions} transitions / depth {result.depth}")
+        assert result.ok, "\n\n".join(
+            cex.format() for cex in result.counterexamples)
+        floor = STATE_FLOORS[report.key]
+        assert result.states >= floor, (
+            f"{report.key} explored only {result.states} states "
+            f"(< {floor}): the scope no longer covers the schedule "
+            f"classes it was sized for")
+        assert result.transitions >= result.states - 1
+
+    def test_registry_covers_every_floor(self):
+        keys = {f"{s.name}/{c.name}" for s, c in ALL_CASES}
+        assert keys == set(STATE_FLOORS)
+
+
+# ---------------------------------------------------------------------------
+# PR 16 bug variants: rediscovered as minimal counterexample traces
+# ---------------------------------------------------------------------------
+
+
+class TestBugVariants:
+    def _explore_variant(self, model_name, params, variant,
+                         max_states=400_000):
+        model = build_model(model_name, params, variant)
+        return model, explore(model, max_states=max_states)
+
+    def _cex(self, result, invariant):
+        for cex in result.counterexamples:
+            if cex.invariant == invariant:
+                return cex
+        raise AssertionError(
+            f"no {invariant!r} counterexample; got "
+            f"{[c.invariant for c in result.counterexamples]}")
+
+    def test_hardcoded_issuer_breaks_holder_handoff(self):
+        """PR 16 bug 1: a leaver naming ITSELF as lease issuer hands
+        the lease to a node outside the surviving membership."""
+        model, result = self._explore_variant(
+            "lease", {"replicas": 2, "epoch_cap": 4},
+            "hardcoded_issuer")
+        cex = self._cex(result, "holder-in-peers")
+        assert cex.trace == (
+            "leave(a)",
+            "deliver(epoch=2,peers={b},issuer=a -> b)",
+        )
+        final = replay(model, cex.trace)
+        assert "holder-in-peers" in violated(model, final)
+
+    def test_skip_demote_early_return_wedges_awaiting_peer(self):
+        """PR 16 bug 2: noticing a death whose membership is already
+        reflected must be a no-op; the pre-fix code awaited an apply
+        that can never arrive."""
+        model, result = self._explore_variant(
+            "lease", {"replicas": 3, "epoch_cap": 5},
+            "skip_demote_early_return", max_states=60_000)
+        cex = self._cex(result, "no-await-wedge")
+        assert cex.trace == (
+            "leave(a)",
+            "deliver(epoch=2,peers={b,c},issuer=b -> c)",
+            "notice(c:awaits b)",
+        )
+        final = replay(model, cex.trace)
+        assert "no-await-wedge" in violated(model, final)
+
+    def test_skip_ownership_reseed_fabricates_loss(self):
+        """PR 16 bug 3: a replica regaining ownership without
+        re-seeding its watermark counts the windows its peer ingested
+        as lost."""
+        model, result = self._explore_variant(
+            "seq", {}, "skip_ownership_reseed")
+        cex = self._cex(result, "no-fabricated-loss")
+        assert len(cex.trace) == 8
+        assert cex.trace[-3:] == (
+            "deliver(seq=2 -> r1)",
+            "scale(owner -> r0)",
+            "deliver(seq=3 -> r0)",
+        )
+        final = replay(model, cex.trace)
+        assert "no-fabricated-loss" in violated(model, final)
+        assert "lost" in cex.detail
+
+    def test_ignore_needs_flag_loops_on_409(self):
+        """Keyframe variant: an agent dropping the needs_keyframe flag
+        re-sends the delta and draws a second 409 for the same window
+        — the recovery loop never converges."""
+        model, result = self._explore_variant(
+            "keyframe", {}, "ignore_needs_flag")
+        cex = self._cex(result, "409-converges")
+        assert cex.trace == (
+            "send_kf_ok(seq=1 -> r0)",
+            "evict_base(r0)",
+            "recv_409(seq=2 from r0)",
+            "recv_409(seq=2 from r0)",
+        )
+        final = replay(model, cex.trace)
+        assert "409-converges" in violated(model, final)
+
+    def test_dup_keyframe_must_still_plant_base(self):
+        """Keyframe variant: dedup-dropping a duplicate keyframe
+        WITHOUT planting the base leaves the hand-off target unable to
+        re-arm deltas."""
+        model, result = self._explore_variant(
+            "keyframe", {}, "dup_kf_skips_base")
+        cex = self._cex(result, "dup-keyframe-plants-base")
+        assert cex.trace == (
+            "send_kf_ok(seq=1 -> r0)",
+            "handoff(-> r1)",
+            "dup_kf(seq=1 -> r1)",
+        )
+        final = replay(model, cex.trace)
+        assert "dup-keyframe-plants-base" in violated(model, final)
+
+    def test_variant_counterexample_flows_through_rule(self):
+        """A variant's counterexample rides the normal rule machinery:
+        the owning family yields a Diagnostic anchored at the spec
+        source with the full minimal trace inline."""
+        spec = spec_by_name("lease.succession")
+        case = spec.cases[0]
+        model = build_model(spec.model, case.params, "hardcoded_issuer")
+        report = ModelReport(spec=spec, case=case,
+                             result=explore(model,
+                                            max_states=case.max_states))
+        rule = next(r for r in all_rules() if r.id == "KTL130")
+        diags = list(rule.check_model(report))
+        assert len(diags) == 1
+        diag = diags[0]
+        assert diag.rule_id == "KTL130"
+        assert diag.path == spec.source
+        assert "holder-in-peers" in diag.message
+        assert "leave(a)" in diag.message
+        assert f"[{spec.name}/{case.name}]" in diag.message
+
+
+# ---------------------------------------------------------------------------
+# the protocol-tier runner
+# ---------------------------------------------------------------------------
+
+
+class TestProtocolTierRunner:
+    def test_shipped_registry_reports_zero_diagnostics(self):
+        assert analyze_protocol_specs(REPO) == []
+
+    def test_only_filter_restricts_rules(self):
+        assert analyze_protocol_specs(REPO, only={"KTL130"}) == []
+        # no protocol rule named: nothing explored, nothing reported
+        assert analyze_protocol_specs(REPO, only={"KTL101"}) == []
+
+    def test_full_registry_within_wall_clock_budget(self):
+        clear_exploration_cache()
+        t0 = time.monotonic()
+        diags = analyze_protocol_specs(REPO)
+        elapsed = time.monotonic() - t0
+        assert diags == []
+        assert elapsed < 30.0, (
+            f"full-registry exploration took {elapsed:.1f}s (budget "
+            f"30s): a model scope grew past what make lint can afford")
+
+    def test_broken_spec_reports_ktl000(self):
+        bad = ProtocolSpec(
+            name="broken.spec", source="kepler_tpu/fleet/membership.py",
+            description="fixture", model="no-such-model",
+            cases=(ProtocolCase("c"),), invariants=())
+        diags = analyze_protocol_specs(REPO, specs=(bad,))
+        assert [d.rule_id for d in diags] == ["KTL000"]
+        assert "failed to build/explore" in diags[0].message
+        assert "ValueError" in diags[0].message
+
+    def test_state_explosion_reports_ktl000(self):
+        tight = ProtocolSpec(
+            name="lease.tight-cap",
+            source="kepler_tpu/fleet/membership.py",
+            description="fixture", model="lease",
+            cases=(ProtocolCase(
+                "tiny", params={"replicas": 2, "epoch_cap": 4},
+                max_states=10),),
+            invariants=("no-split-brain",))
+        diags = analyze_protocol_specs(REPO, specs=(tight,))
+        assert [d.rule_id for d in diags] == ["KTL000"]
+        assert "StateExplosionError" in diags[0].message
+
+    def test_unmapped_invariant_surfaces_as_ktl000(self, monkeypatch):
+        spec = spec_by_name("spool.cursor")
+        case = spec.cases[0]
+        fake = ModelReport(
+            spec=spec, case=case,
+            result=ExplorationResult(
+                states=1, transitions=0, depth=0,
+                counterexamples=(Counterexample(
+                    invariant="mystery-invariant", detail="d",
+                    trace=("e1",), state_repr="s"),)))
+        monkeypatch.setattr(
+            "kepler_tpu.analysis.protocol.checks.explore_case",
+            lambda s, c: fake)
+        diags = analyze_protocol_specs(REPO, specs=(spec,))
+        assert [d.rule_id for d in diags] == ["KTL000"]
+        assert "unmapped invariant 'mystery-invariant'" in diags[0].message
+
+
+# ---------------------------------------------------------------------------
+# KTL133: the protocol-transition marker fence
+# ---------------------------------------------------------------------------
+
+KTL133 = next(r for r in all_rules() if r.id == "KTL133")
+
+
+@pytest.fixture()
+def lint133(tmp_path):
+    """Lint one fixture with only KTL133, inside a fake repo root."""
+    (tmp_path / "pyproject.toml").write_text("")
+
+    def run(source, rel="kepler_tpu/fleet/mod.py"):
+        import textwrap
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+        return lint_file(str(path), str(tmp_path), rules=[KTL133])
+
+    return run
+
+
+class TestTransitionMarker:
+    def test_unmarked_write_fires(self, lint133):
+        diags = lint133("""
+            class Lease:
+                def bump(self):
+                    self._epoch = 2
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert "`._epoch`" in diags[0].message
+        assert "bump()" in diags[0].message
+
+    def test_marked_function_is_legal(self, lint133):
+        assert lint133("""
+            class Lease:
+                # keplint: protocol-transition
+                def bump(self):
+                    self._epoch = 2
+        """) == []
+
+    def test_init_is_not_exempt(self, lint133):
+        diags = lint133("""
+            class Lease:
+                def __init__(self):
+                    self._holder = "a"
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert lint133("""
+            class Lease:
+                # keplint: protocol-transition
+                def __init__(self):
+                    self._holder = "a"
+        """) == []
+
+    def test_tuple_unpack_target_fires(self, lint133):
+        diags = lint133("""
+            class Tracker:
+                def seed(self, hi):
+                    self.max_seen, extra = hi, None
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert "`.max_seen`" in diags[0].message
+
+    def test_subscript_write_through_attr_fires(self, lint133):
+        diags = lint133("""
+            class Agg:
+                def plant(self, node, row):
+                    self._base_rows[node] = row
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert "`._base_rows`" in diags[0].message
+
+    def test_augassign_fires(self, lint133):
+        diags = lint133("""
+            class Tracker:
+                def flip(self):
+                    self.ring_epoch += 1
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+
+    def test_nested_def_needs_its_own_marker(self, lint133):
+        diags = lint133("""
+            class Spool:
+                # keplint: protocol-transition
+                def ack(self):
+                    def later():
+                        self._acked_through = 3
+                    return later
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert "later()" in diags[0].message
+
+    def test_module_level_write_fires(self, lint133):
+        diags = lint133("""
+            tracker = object()
+            tracker.max_seen = 0
+        """)
+        assert [d.rule_id for d in diags] == ["KTL133"]
+        assert "module level" in diags[0].message
+
+    def test_unprotected_attribute_is_quiet(self, lint133):
+        assert lint133("""
+            class Lease:
+                def note(self):
+                    self.payload = 1
+        """) == []
+
+    def test_reads_and_index_expressions_are_not_writes(self, lint133):
+        assert lint133("""
+            class Agg:
+                # keplint: protocol-transition
+                def plant(self, node, row):
+                    self._base_rows[node] = row
+
+                def peek(self, node):
+                    return self._base_rows[node]
+
+                def copy_into(self, out):
+                    out[self.max_seen] = self.ring_epoch
+        """) == []
+
+    def test_scoped_to_fleet_tree(self, lint133):
+        source = """
+            class Lease:
+                def bump(self):
+                    self._epoch = 2
+        """
+        assert lint133(source, rel="kepler_tpu/core/mod.py") == []
+        assert [d.rule_id for d in
+                lint133(source, rel="kepler_tpu/fleet/sub/mod.py")] \
+            == ["KTL133"]
+
+
+# ---------------------------------------------------------------------------
+# CLI + SARIF surface
+# ---------------------------------------------------------------------------
+
+
+class TestCliSurface:
+    def test_only_protocol_rule_implies_protocol_tier(
+            self, tmp_path, monkeypatch, capsys):
+        """--only=KTL130 without --protocol-tier must RUN the tier
+        (mirror of the device-tier false-all-clear fix)."""
+        calls = []
+
+        def fake_analyze(root, only=None, **kw):
+            calls.append(set(only or ()))
+            return []
+
+        monkeypatch.setattr(
+            "kepler_tpu.analysis.protocol.analyze_protocol_specs",
+            fake_analyze)
+        (tmp_path / "pyproject.toml").write_text("")
+        mod = tmp_path / "kepler_tpu" / "m.py"
+        mod.parent.mkdir()
+        mod.write_text("x = 1\n")
+        assert keplint_main(["--only=KTL130", str(mod)]) == 0
+        assert calls == [{"KTL130"}]
+        # ...and --protocol-tier with only host rules named skips the
+        # exploration entirely
+        assert keplint_main(["--protocol-tier", "--only=KTL101",
+                             str(mod)]) == 0
+        assert calls == [{"KTL130"}]
+        # the plain flag runs the tier unrestricted
+        assert keplint_main(["--protocol-tier", str(mod)]) == 0
+        assert calls == [{"KTL130"}, set()]
+        capsys.readouterr()
+
+    def test_sarif_catalog_carries_protocol_rules(self):
+        sarif = render_sarif(LintResult())
+        ids = {r["id"] for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
+        assert {"KTL130", "KTL131", "KTL132", "KTL133"} <= ids
