@@ -26,10 +26,15 @@ test-verbose:
 	$(PYTHON) -m pytest tests/ -v
 
 .PHONY: chaos
-chaos: ## fault-injection resilience subset (chaos marker): spool crash/replay, faulted pipelines, ring kill/rebalance, overload herd, diurnal scale soak
+chaos: ## fault-injection resilience subset (chaos marker) + randomized kepchaos sweep (25 schedules, shrinks on red) + diurnal scale soak
 	$(PYTHON) -m pytest tests/ -q -m chaos
+	$(PYTHON) -m kepler_tpu.chaos --seed 1 --schedules 25
 	$(PYTHON) -m benchmarks.soak --agents 40 --seconds 36 --interval 3 \
 		--workloads 20 --diurnal
+
+.PHONY: chaos-long
+chaos-long: ## extended kepchaos sweep: 100 randomized schedules from seed 1
+	$(PYTHON) -m kepler_tpu.chaos --seed 1 --schedules 100
 
 .PHONY: verify
 verify: lint chaos multihost ## the lint surface plus the chaos subset and the multi-host dryrun — the PR gate's sibling path
@@ -89,6 +94,7 @@ lint:
 	$(PYTHON) -m compileall -q kepler_tpu tests hack benchmarks
 	$(PYTHON) -m kepler_tpu.analysis --device-tier --protocol-tier kepler_tpu hack benchmarks
 	$(PYTHON) hack/gen_lint_docs.py --check
+	$(PYTHON) hack/gen_fault_docs.py --check
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check kepler_tpu tests hack; \
 	else \
@@ -128,6 +134,10 @@ keplint-baseline: ## refreeze the keplint baseline (after fixing findings)
 gen-lint-docs: ## regenerate docs/developer/static-analysis.md from the registry
 	$(PYTHON) hack/gen_lint_docs.py
 
+.PHONY: gen-fault-docs
+gen-fault-docs: ## regenerate the resilience.md fault-site table from fault.SITE_CATALOG
+	$(PYTHON) hack/gen_fault_docs.py
+
 # -- docs ---------------------------------------------------------------------
 .PHONY: gen-metric-docs
 gen-metric-docs: ## regenerate docs/user/metrics.md from the live collectors
@@ -142,6 +152,7 @@ check-metric-docs:
 	$(PYTHON) hack/gen_metric_docs.py --check
 	$(PYTHON) hack/gen_config_docs.py --check
 	$(PYTHON) hack/gen_lint_docs.py --check
+	$(PYTHON) hack/gen_fault_docs.py --check
 
 # -- run ----------------------------------------------------------------------
 .PHONY: run

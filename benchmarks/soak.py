@@ -39,6 +39,7 @@ from __future__ import annotations
 # time; an NTP step mid-soak must not corrupt the gated numbers
 
 import argparse
+import contextlib
 import http.client
 import json
 import os
@@ -73,7 +74,8 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              interval: float = 5.0, workloads: int = 100,
              model_mode: str | None = "mlp", replicas: int = 1,
              kill_at: float = 0.0, shed: bool = False,
-             rebalance_after: float = 0.0, diurnal: bool = False) -> dict:
+             rebalance_after: float = 0.0, diurnal: bool = False,
+             seed: int = 0) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
     from kepler_tpu.fleet.wire import (encode_delta_v2, encode_report,
                                        encode_report_batch,
@@ -104,10 +106,17 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     # and replay to the new owners. The gate requires ZERO windows
     # lost across every scale event.
     replicas = max(1, int(replicas))
+    # every stochastic stream derives from --seed (default 0 keeps the
+    # historical runs bit-identical); printed up front so any soak line
+    # in a log is replayable
+    mode = ("diurnal" if diurnal else "shed" if shed
+            else "kill" if kill_at else "steady")
+    print(f"# soak seed={seed} mode={mode} agents={n_agents} "
+          f"replicas={replicas} interval={interval}", file=sys.stderr)
     admission_kw = dict(
         admission_enabled=True, admission_max_inflight=64,
         admission_latency_budget=0.25, admission_retry_after=0.5,
-        admission_retry_after_max=5.0, admission_jitter_seed=0,
+        admission_retry_after_max=5.0, admission_jitter_seed=seed,
     ) if shed else {}
     servers: list[APIServer] = []
     for _ in range(replicas):
@@ -149,7 +158,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     time.sleep(0.2)
     victim = replicas - 1 if replicas > 1 and kill_at > 0 else -1
 
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     zones = ["package", "core", "dram", "uncore"]
     # pre-encode each agent's report ONCE per seq (the arrays change per
     # window in production but the encode cost is the agent's, not the
@@ -170,8 +179,9 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
 
     def agent(idx: int) -> None:
         # per-thread generator: np.random.Generator is NOT thread-safe,
-        # and all agents draw at thread start
-        rng_local = np.random.default_rng(idx)
+        # and all agents draw at thread start (seed=0 preserves the
+        # historical per-agent streams exactly)
+        rng_local = np.random.default_rng(seed * 1_000_003 + idx)
         cpu = rng_local.uniform(0.1, 5.0, workloads).astype(np.float32)
         rep = NodeReport(
             node_name=f"soak-{idx:04d}",
@@ -303,7 +313,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
         backlog (the spool stand-in) and drains it BATCHED through
         /v1/reports — 429s honored (bounded), 421s followed, outages
         survived by the backlog rather than a blocking retry loop."""
-        rng_local = np.random.default_rng(idx)
+        rng_local = np.random.default_rng(seed * 1_000_003 + idx)
         cpu = rng_local.uniform(0.1, 5.0, workloads).astype(np.float32)
         rep = NodeReport(
             node_name=f"soak-{idx:04d}",
@@ -629,6 +639,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     if not flat:
         flat = sorted(v for _, v in all_samples)
     out = {
+        "soak_seed": seed,
         "soak_agents": n_agents,
         "soak_seconds": round(duration, 1),
         "soak_reports_sent": len(all_samples),
@@ -807,6 +818,22 @@ def main() -> None:
                         "and gates ZERO windows lost plus a BOUNDED "
                         "post-rebalance keyframe burst (<= 4x the "
                         "displaced-shard replay count; ISSUE 17)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="base seed for every stochastic stream (agent "
+                        "report contents, admission jitter); default 0 "
+                        "reproduces the historical runs bit-for-bit and "
+                        "the chosen value is echoed in the header and "
+                        "the soak_seed output field")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="conductor-driven mode: arm the kepchaos "
+                        "schedule generate(chaos_seed, chaos_schedule) "
+                        "for the whole soak (fault events only — op "
+                        "events need the in-process conductor, "
+                        "python -m kepler_tpu.chaos); fires are "
+                        "reported in soak_chaos_fires. Randomized "
+                        "pressure usually wants --no-gate")
+    p.add_argument("--chaos-schedule", type=int, default=0,
+                   help="schedule index within --chaos-seed")
     p.add_argument("--rebalance-after", type=float, default=None,
                    help="seconds AFTER the kill before survivors adopt "
                         "the shrunken membership (ownership-convergence "
@@ -829,11 +856,38 @@ def main() -> None:
     rebalance_after = args.rebalance_after
     if rebalance_after is None:
         rebalance_after = 8 * args.interval if args.shed else 0.0
-    row = run_soak(args.agents, args.seconds, args.interval,
-                   args.workloads, replicas=args.replicas,
-                   kill_at=args.kill_at, shed=args.shed,
-                   rebalance_after=rebalance_after,
-                   diurnal=args.diurnal)
+    plan = None
+    if args.chaos_seed is not None:
+        # the conductor's schedule grammar, lowered onto the soak's wall
+        # clock: the same (seed, index) key names the same fault events
+        # here and under `python -m kepler_tpu.chaos`
+        from kepler_tpu import fault as fault_mod
+        from kepler_tpu.chaos.schedule import (compile_fault_specs,
+                                               generate)
+
+        sched = generate(args.chaos_seed, args.chaos_schedule,
+                         horizon=max(1, int(args.seconds
+                                            / args.interval)),
+                         members=["soak"], standbys=[])
+        specs = compile_fault_specs(sched.events, args.interval)
+        plan = fault_mod.FaultPlan(
+            specs,
+            seed=args.chaos_seed * 1_000_003 + args.chaos_schedule)
+        print(f"# soak chaos schedule armed: seed={args.chaos_seed} "
+              f"index={args.chaos_schedule} "
+              f"fault_events={len(specs)} "
+              f"sites={','.join(sorted(plan.sites()))}",
+              file=sys.stderr)
+    ctx = (fault_mod.installed(plan) if plan is not None
+           else contextlib.nullcontext())
+    with ctx:
+        row = run_soak(args.agents, args.seconds, args.interval,
+                       args.workloads, replicas=args.replicas,
+                       kill_at=args.kill_at, shed=args.shed,
+                       rebalance_after=rebalance_after,
+                       diurnal=args.diurnal, seed=args.seed)
+    if plan is not None:
+        row["soak_chaos_fires"] = dict(sorted(plan.fires.items()))
     row["soak_rss_growth_budget_mib"] = args.rss_budget_mib
     failures = ([] if args.no_gate
                 else gate(row, args.p99_budget_ms, args.rss_budget_mib))

@@ -1,0 +1,60 @@
+"""kepchaos: randomized fault-schedule conductor over the real fleet.
+
+The concrete-execution complement to ``kepler_tpu.kepmc`` (which model-
+checks the pure decision layer exhaustively at small scope): kepchaos
+generates randomized, time-phased fault schedules over the full
+composed surface — fault-site injections, replica kill/restart,
+membership join/leave/autoscale ops — drives them against an
+in-process fleet of real aggregators and wire-faithful agents, and
+judges five global invariants on every run. Runs are keyed by
+``(seed, schedule index)`` and replay bit-identically; failing
+schedules shrink to a minimal fault subsequence via delta debugging.
+
+Exports resolve lazily (PEP 562): ``python -m kepler_tpu.chaos``
+imports this module before ``__main__`` gets a chance to pin the JAX
+platform env, so nothing here may import the fleet (and thus jax) at
+module import time.
+
+See docs/developer/resilience.md "Randomized chaos" and run
+``python -m kepler_tpu.chaos --help``.
+"""
+
+from typing import Any
+
+_EXPORTS = {
+    "ChaosAgent": "kepler_tpu.chaos.harness",
+    "ChaosConfig": "kepler_tpu.chaos.harness",
+    "ChaosEvent": "kepler_tpu.chaos.schedule",
+    "ChaosFleet": "kepler_tpu.chaos.harness",
+    "ChaosReport": "kepler_tpu.chaos.conductor",
+    "EXCLUDED_SITES": "kepler_tpu.chaos.schedule",
+    "FAULT_POOL": "kepler_tpu.chaos.schedule",
+    "MembershipView": "kepler_tpu.chaos.invariants",
+    "RowRecord": "kepler_tpu.chaos.invariants",
+    "RunRecord": "kepler_tpu.chaos.invariants",
+    "RunResult": "kepler_tpu.chaos.conductor",
+    "Schedule": "kepler_tpu.chaos.schedule",
+    "Trace": "kepler_tpu.chaos.trace",
+    "Violation": "kepler_tpu.chaos.invariants",
+    "WindowRecord": "kepler_tpu.chaos.invariants",
+    "check_all": "kepler_tpu.chaos.invariants",
+    "compile_fault_specs": "kepler_tpu.chaos.schedule",
+    "ddmin": "kepler_tpu.chaos.schedule",
+    "generate": "kepler_tpu.chaos.schedule",
+    "repro_command": "kepler_tpu.chaos.conductor",
+    "run_many": "kepler_tpu.chaos.conductor",
+    "run_schedule": "kepler_tpu.chaos.conductor",
+    "shrink": "kepler_tpu.chaos.conductor",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str) -> Any:
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(module), name)

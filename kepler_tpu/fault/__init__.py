@@ -3,6 +3,7 @@ tests and soak runs (see docs/developer/resilience.md)."""
 
 from kepler_tpu.fault.plan import (
     KNOWN_SITES,
+    SITE_CATALOG,
     FaultPlan,
     FaultSpec,
     active,
@@ -15,6 +16,7 @@ from kepler_tpu.fault.plan import (
 
 __all__ = [
     "KNOWN_SITES",
+    "SITE_CATALOG",
     "FaultPlan",
     "FaultSpec",
     "active",

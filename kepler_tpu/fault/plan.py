@@ -37,33 +37,62 @@ from typing import Any, Callable, Iterable, Iterator, Mapping
 
 log = logging.getLogger("kepler.fault")
 
-# canonical injection sites and the layer that consults them
-KNOWN_SITES = (
-    "device.read_error",    # monitor: a zone read fails this tick
-    "device.counter_wrap",  # monitor: a zone counter wraps (delta via max)
-    "net.refuse",           # agent: connect/send refused
-    "net.slow",             # agent: send stalls for `arg` seconds
-    "net.corrupt_body",     # agent: report body corrupted on the wire
-    "report.clock_skew",    # agent: report stamped `arg` seconds off
-    "disk.write_error",     # spool: an append fails cleanly (no bytes land)
-    "disk.fsync_error",     # spool: fsync fails (record stays in page cache)
-    "disk.torn_tail",       # spool: partial frame written, append "dies"
-    "telemetry.drop",       # telemetry: a completed cycle trace is dropped
+# Canonical injection sites: ``(site, consulting layer, effect)``. The
+# catalog is the single source of truth — ``KNOWN_SITES`` (the
+# validation set), the resilience.md fault-site table
+# (``hack/gen_fault_docs.py``), the dead-site fence test, and the
+# kepchaos schedule generator are all derived from it, so a site cannot
+# be added without its documentation (or documented without a consumer).
+SITE_CATALOG: tuple[tuple[str, str, str], ...] = (
+    ("device.read_error", "monitor",
+     "zone read fails → zone masked this window"),
+    ("device.counter_wrap", "monitor",
+     "counter forced to `arg` → wraparound-delta path"),
+    ("net.refuse", "agent",
+     "connect/send raises `ConnectionRefusedError`"),
+    ("net.slow", "agent",
+     "send stalls `arg` seconds (≤ timeout)"),
+    ("net.corrupt_body", "agent",
+     "report body truncated → server-side `WireError`"),
+    ("report.clock_skew", "agent",
+     "`sent_at` stamped `arg` seconds off"),
+    ("disk.write_error", "spool",
+     "append fails cleanly (no bytes land) → in-memory fallback"),
+    ("disk.fsync_error", "spool",
+     "fsync fails; record stays in page cache, counted"),
+    ("disk.torn_tail", "spool",
+     "partial frame written, append raises — kill -9 mid-write stand-in"),
+    ("telemetry.drop", "telemetry",
+     "a completed cycle trace is dropped before the ring buffer"),
     # device-plane window leg (aggregator degradation ladder,
     # docs/developer/resilience.md "Device-plane faults")
-    "device.dispatch_error",  # window: the XLA dispatch raises
-    "device.compile_error",   # window: a cold program/update compile fails
-    "device.oom_on_grow",     # window: a bucket-growth recompile OOMs
-    "device.stall",           # window: the fetch hangs `arg` seconds
+    ("device.dispatch_error", "window",
+     "the XLA dispatch raises → ladder demotion"),
+    ("device.compile_error", "window",
+     "a cold program/update compile fails (fires before the cache entry "
+     "lands)"),
+    ("device.oom_on_grow", "window",
+     "a bucket-growth recompile OOMs"),
+    ("device.stall", "window",
+     "the fetch hangs `arg` seconds → dispatch-timeout demotion"),
     # HA ingest tier (consistent-hash replicated aggregators,
     # docs/developer/resilience.md "Ingest hand-off")
-    "net.partition",          # agent: report delivered, response dropped
-    "replica.down",           # aggregator: ingest answers 503 (replica dead)
+    ("net.partition", "agent",
+     "one-way partition: report delivered, response dropped → "
+     "re-delivery, dedup absorbs"),
+    ("replica.down", "aggregator",
+     "ingest answers 503 (dying replica) → agent failover + spool"),
     # overload control (admission + shedding,
     # docs/developer/resilience.md "Overload and backpressure")
-    "net.throttle",           # agent: send answered 429 (arg = Retry-After)
-    "aggregator.ingest_slow",  # aggregator: ingest stalls `arg` seconds
+    ("net.throttle", "agent",
+     "send answered 429 with `arg` as Retry-After → throttle path (no "
+     "breaker/failover)"),
+    ("aggregator.ingest_slow", "aggregator",
+     "ingest stalls `arg` seconds → latency EWMA climbs, admission "
+     "sheds"),
 )
+
+KNOWN_SITES: tuple[str, ...] = tuple(s for s, _, _ in SITE_CATALOG)
 
 
 @dataclass(frozen=True)

@@ -108,6 +108,15 @@ log = logging.getLogger("kepler.fleet.aggregator")
 # body is buffered
 MAX_REPORT_BYTES = 64 << 20
 
+# TEST-ONLY chaos regression seed: when flipped (monkeypatched by the
+# kepchaos shrinking-proof test, never set in production code), the
+# membership fan-out stamps this replica as the issuer instead of the
+# current lease holder — the historical holder-self-leave bug, where
+# receivers adopt the DEPARTED peer as lease holder. kepchaos must
+# catch this from a randomized schedule and shrink it to the minimal
+# repro; see tests/test_chaos_conductor.py.
+_BUG_BROADCAST_SELF_ISSUER = False
+
 # degradation-ladder rungs for the window's device leg
 # (docs/developer/resilience.md "Device-plane faults"): every device
 # failure demotes ONE rung; `repromote_after` consecutive clean windows
@@ -1782,7 +1791,8 @@ class Aggregator:
         # its successor in the local apply, and the fan-out must carry
         # that successor or receivers would adopt the departed holder
         issuer = self._self_peer
-        if self._lease is not None and self._lease.holder:
+        if not _BUG_BROADCAST_SELF_ISSUER \
+                and self._lease is not None and self._lease.holder:
             issuer = self._lease.holder
         payload: dict[str, Any] = {
             "op": "apply", "peers": list(peers), "epoch": int(epoch),

@@ -1,0 +1,377 @@
+"""kepchaos tests: grammar, shrinking, invariant teeth, determinism.
+
+Four layers, matching the module split:
+
+- schedule grammar (pure): generation is a pure function of
+  ``(seed, index)``, JSON round-trips, validation rejects malformed
+  events, fault events lower onto ``FaultSpec`` virtual-time windows;
+- ``ddmin`` (pure): classic delta-debugging minimizes to the culprit
+  subset and enforces its precondition;
+- invariant teeth: every checker FIRES on a hand-built violating
+  record and stays quiet on a clean one — a checker that cannot fail
+  is worse than none;
+- conductor runs (marked ``chaos``): bit-identical replay of the same
+  key, a green sweep, and the shrinking proof — a reintroduced PR 16
+  membership bug (test-only flag) is caught by a *randomized* schedule
+  and shrunk to a minimal repro.
+"""
+
+import json
+
+import pytest
+
+from kepler_tpu.chaos.invariants import (
+    MembershipView, RowRecord, RunRecord, WindowRecord, check_all,
+    check_conservation, check_convergence, check_ladder,
+    check_no_duplicates, check_no_fabricated_loss)
+from kepler_tpu.chaos.schedule import (
+    FAULT_POOL, LADDER_SITES, MAX_LADDER_EVENTS, ChaosEvent, Schedule,
+    compile_fault_specs, ddmin, generate)
+
+MEMBERS = [f"10.99.0.{i + 1}:28283" for i in range(3)]
+STANDBYS = ["10.99.0.4:28283"]
+
+
+def gen(index: int, seed: int = 1) -> Schedule:
+    return generate(seed, index, horizon=12, members=MEMBERS,
+                    standbys=STANDBYS)
+
+
+class TestScheduleGrammar:
+    def test_generate_is_pure(self):
+        for index in (0, 7, 24):
+            assert gen(index).to_json() == gen(index).to_json()
+
+    def test_keys_diversify(self):
+        texts = {gen(i).to_json() for i in range(10)}
+        assert len(texts) >= 8
+
+    def test_events_sorted_and_bounded(self):
+        for index in range(20):
+            sched = gen(index)
+            assert len(sched.events) >= 3
+            keys = [(e.at, e.kind, e.site, e.target)
+                    for e in sched.events]
+            assert keys == sorted(keys)
+            ladder = [e for e in sched.events if e.site in LADDER_SITES]
+            assert len(ladder) <= MAX_LADDER_EVENTS
+            for e in ladder:
+                assert e.count == 1 and e.probability == 1.0
+            for e in sched.events:
+                if e.kind == "fault":
+                    assert e.site in FAULT_POOL
+                    assert 0 <= e.at < 12
+
+    def test_json_round_trip(self):
+        sched = gen(3).subset([0, 2])
+        again = Schedule.from_json(sched.to_json())
+        assert again == sched
+        assert again.keep == (0, 2)
+
+    def test_validation_rejects_malformed(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            ChaosEvent(at=0, kind="fault", site="disk.not_a_site")
+        with pytest.raises(ValueError, match="unknown event kind"):
+            ChaosEvent(at=0, kind="explode")
+        with pytest.raises(ValueError, match="window index"):
+            ChaosEvent(at=-1, kind="kill", target=MEMBERS[0])
+        with pytest.raises(ValueError, match="duration"):
+            ChaosEvent(at=0, kind="fault", site="net.refuse", windows=0)
+        with pytest.raises(ValueError, match="unknown keys"):
+            ChaosEvent.from_dict({"at": 0, "kind": "kill", "when": 3})
+        with pytest.raises(ValueError, match="out of range"):
+            gen(0).subset([99])
+
+    def test_compile_fault_specs(self):
+        events = [
+            ChaosEvent(at=2, kind="fault", site="net.refuse", windows=2),
+            ChaosEvent(at=0, kind="kill", target=MEMBERS[0]),
+        ]
+        specs = compile_fault_specs(events, interval=5.0)
+        assert len(specs) == 1          # op events don't lower
+        assert specs[0].site == "net.refuse"
+        # clock advances BEFORE window w is processed, so elapsed at
+        # window a+1 (1-based) is (a+1)*interval; the spec opens at
+        # (a+0.5)*interval and stays up for `windows` windows
+        assert specs[0].start == pytest.approx(12.5)
+        assert specs[0].duration == pytest.approx(10.0)
+
+
+class TestDdmin:
+    def test_single_culprit(self):
+        out = ddmin(range(10), lambda keep: 5 in keep)
+        assert out == (5,)
+
+    def test_pair_culprit(self):
+        out = ddmin(range(12), lambda keep: {2, 6} <= set(keep))
+        assert sorted(out) == [2, 6]
+
+    def test_precondition(self):
+        with pytest.raises(ValueError, match="full set must fail"):
+            ddmin(range(4), lambda keep: False)
+
+
+# -- invariant teeth ---------------------------------------------------------
+# Scales chosen WAY above the checker tolerance (ATOL 1e3 uW + 1% rtol)
+# so each violation is unambiguous.
+
+R1, R2 = MEMBERS[0], MEMBERS[1]
+
+
+def clean_row(node: str = "n0") -> RowRecord:
+    return RowRecord(
+        node=node, dt=5.0,
+        energy_uj=(1e7, 5e6), power_uw=(2e6, 1e6),
+        wl_power_sum_uw=(1e6, 5e5), wl_ids=("w0", "w1"),
+        usage_ratio=0.5, emitted_energy_uj=(1e7, 5e6))
+
+
+def clean_record(**overrides) -> RunRecord:
+    view = MembershipView(epoch=2, peers=(R1, R2), holder=R1)
+    base = dict(
+        windows=[WindowRecord(replica=R1, win=1, rows=[clean_row()])],
+        stats={f"{R1}#0": {"windows_lost_total": 0}},
+        timelines={f"{R1}#0": [
+            {"rung": 1, "rung_name": "jit", "from_rung": 0,
+             "from_rung_name": "pipelined", "reason": "dispatch_error"},
+            {"rung": 0, "rung_name": "pipelined", "from_rung": 1,
+             "from_rung_name": "jit", "reason": "repromoted",
+             "windows_at_prev_rung": 2},
+        ]},
+        repromote_after=1, abandoned_windows=0,
+        membership={R1: view,
+                    R2: MembershipView(epoch=2, peers=(R1, R2),
+                                       holder=R1)},
+        alive=frozenset({R1, R2}),
+        health_ok={R1: True, R2: True},
+        window_health_ok={R1: True, R2: True},
+        pending={"cn00": 0})
+    base.update(overrides)
+    return RunRecord(**base)
+
+
+class TestInvariantTeeth:
+    def test_clean_record_passes(self):
+        assert check_all(clean_record()) == []
+
+    def test_conservation_energy_vs_power(self):
+        row = clean_row()
+        row.energy_uj = (1e7, 1e6)     # zone 1 off by 5x
+        rec = clean_record(
+            windows=[WindowRecord(replica=R1, win=1, rows=[row])])
+        out = check_conservation(rec)
+        assert out and all(v.invariant == "conservation" for v in out)
+        assert any("zone=1" in v.detail for v in out)
+
+    def test_conservation_published_vs_emitted(self):
+        row = clean_row()
+        row.emitted_energy_uj = (1e7, 9e6)   # agent never sent this
+        out = check_conservation(clean_record(
+            windows=[WindowRecord(replica=R1, win=1, rows=[row])]))
+        assert any("!= emitted" in v.detail for v in out)
+
+    def test_conservation_workload_plane(self):
+        row = clean_row()
+        row.wl_power_sum_uw = (1e6, 1e4)     # plane lost zone 1
+        out = check_conservation(clean_record(
+            windows=[WindowRecord(replica=R1, win=1, rows=[row])]))
+        assert any("workload plane" in v.detail for v in out)
+
+    def test_conservation_arity(self):
+        row = clean_row()
+        row.power_uw = (2e6,)
+        out = check_conservation(clean_record(
+            windows=[WindowRecord(replica=R1, win=1, rows=[row])]))
+        assert any("arity" in v.detail for v in out)
+
+    def test_fabricated_loss_fires(self):
+        rec = clean_record(
+            stats={f"{R1}#0": {"windows_lost_total": 2},
+                   f"{R2}#0": {"windows_lost_total": 1}})
+        out = check_no_fabricated_loss(rec)
+        assert len(out) == 1 and out[0].invariant == "loss"
+        assert "windows_lost_total=3" in out[0].detail
+        # loss the agents really caused is not fabricated
+        rec.abandoned_windows = 3
+        assert check_no_fabricated_loss(rec) == []
+
+    def test_duplicate_window_owner_fires(self):
+        rec = clean_record(windows=[
+            WindowRecord(replica=R1, win=4, rows=[clean_row()]),
+            WindowRecord(replica=R2, win=4, rows=[clean_row()])])
+        out = check_no_duplicates(rec)
+        assert any("published by both" in v.detail for v in out)
+
+    def test_duplicate_workload_id_fires(self):
+        row = clean_row()
+        row.wl_ids = ("w0", "w0")
+        out = check_no_duplicates(clean_record(
+            windows=[WindowRecord(replica=R1, win=1, rows=[row])]))
+        assert any("repeated workload id" in v.detail for v in out)
+
+    def test_ladder_two_rung_demotion_fires(self):
+        rec = clean_record(timelines={f"{R1}#0": [
+            {"rung": 2, "from_rung": 0, "reason": "compile_error"}]})
+        out = check_ladder(rec)
+        assert any("exactly one rung" in v.detail for v in out)
+
+    def test_ladder_unknown_reason_fires(self):
+        rec = clean_record(timelines={f"{R1}#0": [
+            {"rung": 1, "from_rung": 0, "reason": "cosmic_ray"}]})
+        out = check_ladder(rec)
+        assert any("unknown transition reason" in v.detail for v in out)
+
+    def test_ladder_early_repromotion_fires(self):
+        rec = clean_record(timelines={f"{R1}#0": [
+            {"rung": 0, "from_rung": 1, "reason": "repromoted",
+             "windows_at_prev_rung": 0}]})
+        rec.repromote_after = 1
+        out = check_ladder(rec)
+        assert any("clean" in v.detail for v in out)
+
+    def test_ladder_repromotion_skips_rung_fires(self):
+        rec = clean_record(timelines={f"{R1}#0": [
+            {"rung": 0, "from_rung": 2, "reason": "repromoted",
+             "windows_at_prev_rung": 5}]})
+        out = check_ladder(rec)
+        assert any("climb exactly one" in v.detail for v in out)
+
+    def test_convergence_divergent_views_fire(self):
+        rec = clean_record()
+        rec.membership[R2] = MembershipView(
+            epoch=3, peers=(R1, R2), holder=R1)
+        out = check_convergence(rec)
+        assert any("views diverge" in v.detail for v in out)
+
+    def test_convergence_departed_holder_fires(self):
+        # the PR 16 bug shape: everyone still names a peer that is no
+        # longer in the ring as lease holder
+        gone = "10.99.0.9:28283"
+        rec = clean_record(membership={
+            R1: MembershipView(epoch=3, peers=(R1, R2), holder=gone),
+            R2: MembershipView(epoch=3, peers=(R1, R2), holder=gone)})
+        out = check_convergence(rec)
+        assert any("not a ring member" in v.detail for v in out)
+
+    def test_convergence_dead_holder_fires(self):
+        rec = clean_record(alive=frozenset({R2}), membership={
+            R2: MembershipView(epoch=3, peers=(R1, R2), holder=R1)})
+        out = check_convergence(rec)
+        assert any("is dead" in v.detail for v in out)
+
+    def test_convergence_red_probes_fire(self):
+        rec = clean_record(health_ok={R1: False, R2: True},
+                           window_health_ok={R1: True, R2: False})
+        out = check_convergence(rec)
+        assert any("health probe still red" in v.detail for v in out)
+        assert any("window health still red" in v.detail for v in out)
+
+    def test_convergence_backlog_fires(self):
+        out = check_convergence(clean_record(pending={"cn00": 3}))
+        assert any("undelivered" in v.detail for v in out)
+
+    def test_convergence_no_members_fires(self):
+        out = check_convergence(clean_record(
+            membership={}, alive=frozenset()))
+        assert any("no live member" in v.detail for v in out)
+
+
+# -- conductor runs (real fleet, virtual clock) ------------------------------
+
+
+@pytest.mark.chaos
+class TestConductor:
+    def test_replay_is_bit_identical(self):
+        from kepler_tpu.chaos.conductor import run_schedule
+
+        sched = gen(0)
+        first = run_schedule(sched)
+        second = run_schedule(sched)
+        assert first.ok, [str(v) for v in first.violations]
+        assert first.trace_hash == second.trace_hash
+        assert first.trace.canonical() == second.trace.canonical()
+        assert first.windows_published == second.windows_published > 0
+
+    def test_small_sweep_green_and_artifact_shape(self):
+        from kepler_tpu.chaos.conductor import run_many
+
+        report = run_many(1, 3)
+        assert report.ok
+        art = report.to_artifact()
+        assert art["schedules_run"] == 3
+        assert art["verdicts"] == {"green": 3, "red": 0}
+        assert art["windows_published"] > 0
+        assert isinstance(art["fault_fires"], dict)
+        assert len(art["trace_hashes"]) == 3
+        json.dumps(art)     # artifact must be plain JSON
+
+    def test_kill_holder_handoff_stays_green(self):
+        from kepler_tpu.chaos.conductor import run_schedule
+
+        sched = Schedule(seed=0, index=0, events=(
+            ChaosEvent(at=1, kind="kill", target=MEMBERS[0]),
+            ChaosEvent(at=4, kind="restart", target=MEMBERS[0]),
+        ))
+        result = run_schedule(sched)
+        assert result.ok, [str(v) for v in result.violations]
+        # succession really happened: somebody other than the initial
+        # holder held the lease while it was down, and the fleet
+        # reconverged on one view by the end
+        views = {(v.epoch, tuple(sorted(v.peers)), v.holder)
+                 for v in result.record.membership.values()}
+        assert len(views) == 1
+
+    def test_repro_command(self):
+        from kepler_tpu.chaos.conductor import repro_command
+
+        sched = gen(24)
+        assert repro_command(sched) == (
+            "python -m kepler_tpu.chaos --seed 1 --schedule 24")
+        shrunk = sched.subset([0, 3])
+        assert repro_command(shrunk) == (
+            "python -m kepler_tpu.chaos --seed 1 --schedule 24 "
+            "--keep 0,3")
+
+
+@pytest.mark.chaos
+class TestShrinkingProof:
+    """Reintroduce the PR 16 broadcast-issuer bug behind its test-only
+    flag and show the pipeline end to end: a *randomized* schedule
+    catches it (holder-self-leave is the only path where issuer !=
+    holder matters), ddmin shrinks the repro to a minimal event
+    subsequence, and the same schedule is green with the flag off."""
+
+    def test_randomized_schedule_catches_and_shrinks(self, monkeypatch):
+        from kepler_tpu.chaos.conductor import run_schedule, shrink
+        from kepler_tpu.fleet import aggregator
+
+        # seed=1 index=24 contains a leave of the initial lease holder
+        # (found by scanning generated schedules, as a long sweep would)
+        sched = gen(24)
+        assert any(e.kind == "leave" and e.target == MEMBERS[0]
+                   for e in sched.events)
+
+        monkeypatch.setattr(
+            aggregator, "_BUG_BROADCAST_SELF_ISSUER", True)
+        broken = run_schedule(sched)
+        assert not broken.ok
+        assert any(v.invariant == "convergence"
+                   and "not a ring member" in v.detail
+                   for v in broken.violations), (
+            [str(v) for v in broken.violations])
+
+        shrunk, runs = shrink(sched)
+        assert 1 <= len(shrunk.events) <= 5
+        assert runs >= 1
+        # the minimal repro still contains the culprit: the holder
+        # leaving (the broadcast whose issuer matters)
+        assert any(e.kind == "leave" and e.target == MEMBERS[0]
+                   for e in shrunk.events)
+        assert not run_schedule(shrunk).ok
+
+        # same key, bug flag off: green — the schedule is a regression
+        # test for the fix, not flaky noise
+        monkeypatch.setattr(
+            aggregator, "_BUG_BROADCAST_SELF_ISSUER", False)
+        fixed = run_schedule(sched)
+        assert fixed.ok, [str(v) for v in fixed.violations]

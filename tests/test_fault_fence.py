@@ -1,0 +1,126 @@
+"""Dead-site fence: the fault catalog and the code can never drift.
+
+``fault.plan.SITE_CATALOG`` is the single source of truth for
+injection sites — the docs table (hack/gen_fault_docs.py), the chaos
+generator pool (kepler_tpu.chaos.schedule) and validation
+(FaultSpec/ChaosEvent) all derive from it. This module walks the
+package's AST for literal ``fire("...")`` call sites and pins the
+fence in BOTH directions:
+
+- every fired site is cataloged (an uncataloged site would be
+  invisible to docs, chaos and config validation), and
+- every cataloged site is actually fired somewhere (a dead catalog
+  entry documents an injection point that no longer exists).
+
+Plus: the chaos pool partition (FAULT_POOL disjoint-union
+EXCLUDED_SITES == KNOWN_SITES) and the generated-doc freshness.
+"""
+
+import ast
+import importlib.util
+import os
+import pathlib
+
+from kepler_tpu.fault import KNOWN_SITES, SITE_CATALOG
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "kepler_tpu"
+
+
+def load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_fault_docs",
+        os.path.join(REPO, "hack", "gen_fault_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def fired_sites() -> dict[str, list[str]]:
+    """site -> ["relpath:lineno", ...] for every literal fire("...")
+    call in the package (both ``fault.fire(...)`` and a bare
+    ``fire(...)`` import alias)."""
+    sites: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name != "fire":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                where = f"{path.relative_to(REPO)}:{node.lineno}"
+                sites.setdefault(arg.value, []).append(where)
+    return sites
+
+
+class TestSiteFence:
+    def test_every_fired_site_is_cataloged(self):
+        known = set(KNOWN_SITES)
+        rogue = {s: w for s, w in fired_sites().items()
+                 if s not in known}
+        assert not rogue, (
+            f"fire() call sites not in fault.SITE_CATALOG: {rogue} — "
+            "add them to kepler_tpu/fault/plan.py (and run "
+            "python hack/gen_fault_docs.py)")
+
+    def test_every_cataloged_site_is_fired(self):
+        fired = set(fired_sites())
+        dead = [s for s in KNOWN_SITES if s not in fired]
+        assert not dead, (
+            f"SITE_CATALOG entries with no fire() call site: {dead} — "
+            "the injection point was removed; retire the catalog row")
+
+    def test_catalog_is_well_formed(self):
+        sites = [s for s, _, _ in SITE_CATALOG]
+        assert sites == sorted(set(sites)) or len(sites) == len(
+            set(sites)), f"duplicate catalog sites: {sites}"
+        for site, layer, effect in SITE_CATALOG:
+            assert "." in site, site
+            assert layer.strip(), f"{site}: empty layer"
+            assert effect.strip(), f"{site}: empty effect"
+        assert tuple(sites) == KNOWN_SITES
+
+    def test_chaos_pool_partitions_the_catalog(self):
+        """Every known site is either in the deterministic chaos pool
+        or explicitly excluded WITH a reason — a new site cannot be
+        silently invisible to kepchaos."""
+        from kepler_tpu.chaos.schedule import EXCLUDED_SITES, FAULT_POOL
+
+        pool = set(FAULT_POOL)
+        excluded = set(EXCLUDED_SITES)
+        assert not pool & excluded, sorted(pool & excluded)
+        assert pool | excluded == set(KNOWN_SITES), (
+            f"uncovered: {sorted(set(KNOWN_SITES) - pool - excluded)}; "
+            f"unknown: {sorted((pool | excluded) - set(KNOWN_SITES))}")
+        for site, reason in EXCLUDED_SITES.items():
+            assert reason.strip(), f"{site}: exclusion needs a reason"
+
+
+class TestGenFaultDocs:
+    def test_doc_is_fresh(self):
+        gen = load_generator()
+        current = gen.DOC.read_text()
+        assert gen.updated_doc(current) == current, (
+            "docs/developer/resilience.md fault-site table is stale; "
+            "run: python hack/gen_fault_docs.py")
+
+    def test_every_site_has_a_table_row(self):
+        gen = load_generator()
+        block = gen.render()
+        for site in KNOWN_SITES:
+            assert f"| `{site}` |" in block
+
+    def test_missing_markers_fail_loudly(self):
+        gen = load_generator()
+        try:
+            gen.updated_doc("no markers here")
+        except SystemExit as err:
+            assert "marker block not found" in str(err)
+        else:
+            raise AssertionError("marker-less doc did not fail")
