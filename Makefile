@@ -63,6 +63,10 @@ multihost: ## multi-host fleet window dryrun: virtual 2-host leg (bit-equal, cap
 introspect: ## smoke the introspection plane: /debug/window + /debug/fleet on a local aggregator
 	$(PYTHON) hack/introspect_smoke.py
 
+.PHONY: blackbox
+blackbox: ## 2-replica kill+rejoin; assert the merged black-box timeline names the succession and is bit-deterministic
+	$(PYTHON) hack/blackbox_smoke.py
+
 # -- native -------------------------------------------------------------------
 .PHONY: native
 native: ## build the C++ batched procfs/sysfs scanner (ctypes, no pybind11)
@@ -95,6 +99,7 @@ lint:
 	$(PYTHON) -m kepler_tpu.analysis --device-tier --protocol-tier kepler_tpu hack benchmarks
 	$(PYTHON) hack/gen_lint_docs.py --check
 	$(PYTHON) hack/gen_fault_docs.py --check
+	$(PYTHON) hack/gen_journal_docs.py --check
 	@if command -v ruff >/dev/null 2>&1; then \
 		ruff check kepler_tpu tests hack; \
 	else \
@@ -138,6 +143,10 @@ gen-lint-docs: ## regenerate docs/developer/static-analysis.md from the registry
 gen-fault-docs: ## regenerate the resilience.md fault-site table from fault.SITE_CATALOG
 	$(PYTHON) hack/gen_fault_docs.py
 
+.PHONY: gen-journal-docs
+gen-journal-docs: ## regenerate the observability.md journal-kind table from journal.KIND_CATALOG
+	$(PYTHON) hack/gen_journal_docs.py
+
 # -- docs ---------------------------------------------------------------------
 .PHONY: gen-metric-docs
 gen-metric-docs: ## regenerate docs/user/metrics.md from the live collectors
@@ -153,6 +162,7 @@ check-metric-docs:
 	$(PYTHON) hack/gen_config_docs.py --check
 	$(PYTHON) hack/gen_lint_docs.py --check
 	$(PYTHON) hack/gen_fault_docs.py --check
+	$(PYTHON) hack/gen_journal_docs.py --check
 
 # -- run ----------------------------------------------------------------------
 .PHONY: run

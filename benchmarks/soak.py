@@ -77,6 +77,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
              rebalance_after: float = 0.0, diurnal: bool = False,
              seed: int = 0) -> dict:
     from kepler_tpu.fleet.aggregator import Aggregator
+    from kepler_tpu.fleet.journal import EventJournal
     from kepler_tpu.fleet.wire import (encode_delta_v2, encode_report,
                                        encode_report_batch,
                                        encode_report_v2, restamp_transmit)
@@ -142,6 +143,13 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                          stale_after=interval * 3,
                          model_mode=model_mode, node_bucket=64,
                          workload_bucket=128, pipeline_depth=2,
+                         # the diurnal gate reconstructs the scale story
+                         # from the merged black-box journals; the pure
+                         # latency soaks keep the journal at its
+                         # disabled-default cost
+                         journal=(EventJournal(enabled=True,
+                                               node=peers[i])
+                                  if diurnal else None),
                          **peer_kw, **admission_kw)
         agg._mesh = make_mesh()
         agg.init()
@@ -525,6 +533,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
 
     scale_events = [0]
     departed_kf = [0]  # keyframe 409s served by replicas that left
+    departed_journals: list[list[dict]] = []  # leavers' rings, at exit
     if diurnal:
         def membership_post(holder: str, payload: dict) -> None:
             h, _, p = holder.rpartition(":")
@@ -581,6 +590,7 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                 live.discard(i)
                 departed_kf[0] += int(
                     aggs[i]._stats.get("keyframe_requests_total", 0))
+                departed_journals.append(aggs[i]._journal.snapshot())
                 ctxs[i].cancel()
                 servers[i].shutdown()
                 aggs[i].shutdown()
@@ -684,6 +694,21 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
             "soak_final_epoch": max(
                 aggs[i]._ring.epoch for i in sorted(live)),
         })
+        # the black-box cross-check: merge every replica's journal
+        # (survivors + departed leavers) into one fleet timeline; each
+        # enacted scale event bumped the ring epoch exactly once, so
+        # the merged journal must hold a membership.apply at >= that
+        # many distinct post-initial epochs
+        from kepler_tpu.blackbox import merge_events
+        merged = merge_events(
+            [aggs[i]._journal.snapshot() for i in sorted(live)]
+            + departed_journals)
+        apply_epochs = {e["fields"]["epoch"] for e in merged
+                        if e["kind"] == "membership.apply"}
+        out.update({
+            "soak_journal_events": len(merged),
+            "soak_journal_scale_applies": len(apply_epochs),
+        })
     if shed:
         shed_total = sum(
             sum(aggs[i]._admission.shed_by_reason().values())
@@ -753,6 +778,16 @@ def gate(row: dict, p99_budget_ms: float = 250.0,
             failures.append(
                 f"diurnal schedule ended at {row['soak_final_replicas']} "
                 "replicas (expected 2)")
+        # fleet black box (ISSUE 19): every ENACTED scale event must be
+        # reconstructable from the merged journals — a join/leave that
+        # moved the ring without a membership.apply event is a silent
+        # transition the incident timeline would never show
+        if row["soak_journal_scale_applies"] < row["soak_scale_events"]:
+            failures.append(
+                f"merged journal shows {row['soak_journal_scale_applies']} "
+                f"membership applies for {row['soak_scale_events']} "
+                "enacted scale events (black-box journal missed a "
+                "transition)")
         # bounded keyframe burst: a displaced shard's first delta at
         # its new owner earns exactly ONE structured 409 before the
         # keyframe lands (kepmc KTL132 pins the convergence), so the

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""`make blackbox`: a 2-replica kill + succession + rejoin scenario on
+the chaos harness's virtual clock, reconstructed through the REAL
+black-box pipeline — per-incarnation journals and live `/debug/bundle`
+documents written to disk, merged by `python -m kepler_tpu.blackbox`.
+
+Proves the reconstruction contract end to end:
+
+- the merged timeline NAMES the succession (a membership apply that
+  excludes the dead peer at a bumped epoch, then a re-join apply that
+  readmits it, in causally-consistent HLC order), and
+- the CLI is bit-deterministic: the same bundles — in any source
+  order — produce byte-identical canonical JSON and one SHA-256.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _check(cond: bool, what: str) -> None:
+    if not cond:
+        print(f"FAIL: {what}", file=sys.stderr)
+        sys.exit(1)
+
+
+def _cli(args: list[str]) -> str:
+    from kepler_tpu.blackbox.__main__ import main as blackbox_main
+
+    raw = io.BytesIO()
+    out = io.TextIOWrapper(raw, encoding="utf-8")   # --format json
+    with contextlib.redirect_stdout(out):           # writes to .buffer
+        code = blackbox_main(args)
+        out.flush()
+    _check(code == 0, f"blackbox CLI exited {code} for {args}")
+    return raw.getvalue().decode()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from kepler_tpu.blackbox import analyze, merge_events
+    from kepler_tpu.chaos.harness import ChaosConfig, ChaosFleet
+    from kepler_tpu.chaos.trace import Trace
+    from kepler_tpu.fleet.journal import canonical_json
+
+    cfg = ChaosConfig(replicas=2, standbys=0, agents=0, workloads=1)
+    fleet = ChaosFleet(cfg, Trace())
+    try:
+        victim, survivor = fleet.members0
+        step = cfg.interval
+
+        fleet.ticks[0] += step
+        _check(fleet.kill(victim), f"kill {victim}")
+        fleet.ticks[0] += step
+        fleet.succession_tick()            # survivor demotes the corpse
+        fleet.ticks[0] += step
+        _check(fleet.restart(victim), f"restart {victim}")
+        fleet.ticks[0] += step
+
+        with tempfile.TemporaryDirectory() as tmp:
+            sources: list[str] = []
+            # the dead incarnation's journal, snapshotted at kill time
+            # (what an operator recovers from the crashed host's spool)
+            for inc, events in sorted(fleet.retired_journals.items()):
+                path = os.path.join(tmp, inc.replace(":", "_") + ".json")
+                with open(path, "w") as f:
+                    json.dump(list(events), f)
+                sources.append(path)
+            # live replicas: the real incident-bundle documents
+            for peer in sorted(fleet.alive):
+                bundle = fleet.aggs[peer].bundle()
+                path = os.path.join(
+                    tmp, peer.replace(":", "_") + ".bundle.json")
+                with open(path, "wb") as f:
+                    f.write(canonical_json(bundle) + b"\n")
+                sources.append(path)
+            _check(len(sources) == 3,
+                   f"3 sources (1 retired + 2 live), got {len(sources)}")
+
+            # -- the merged timeline names the succession -----------------
+            journals = []
+            for src in sources:
+                from kepler_tpu.blackbox import load_source
+                journals.extend(load_source(src))
+            merged = merge_events(journals)
+            _check(merged, "merged timeline is non-empty")
+            keys = [(e["hlc"]["phys_us"], e["hlc"]["logical"],
+                     e["hlc"]["node"]) for e in merged]
+            _check(keys == sorted(keys), "timeline is in HLC order")
+
+            applies = [e for e in merged
+                       if e["kind"] == "membership.apply"]
+            succession = [e for e in applies
+                          if victim not in e["fields"]["peers"]
+                          and e["fields"]["epoch"] > 1]
+            _check(succession, "succession apply excludes the victim")
+            rejoin = [e for e in applies
+                      if victim in e["fields"]["peers"]
+                      and e["fields"]["epoch"]
+                      > succession[0]["fields"]["epoch"]]
+            _check(rejoin, "re-join apply readmits the victim")
+            _check(merged.index(succession[0]) < merged.index(rejoin[0]),
+                   "succession precedes re-join causally")
+            adopts = [e for e in merged if e["kind"] == "lease.adopt"]
+            _check(any(e["fields"]["holder"] == survivor
+                       for e in adopts),
+                   f"lease adoption names the survivor {survivor}")
+            brains = [f for f in analyze(merged)
+                      if f["finding"].startswith("split_brain")]
+            _check(not brains, f"no split-brain findings: {brains}")
+
+            # -- bit-determinism: same bundles -> one SHA-256 -------------
+            sha_fwd = _cli(sources + ["--sha"]).strip()
+            sha_rev = _cli(list(reversed(sources)) + ["--sha"]).strip()
+            _check(len(sha_fwd) == 64, f"sha shape {sha_fwd!r}")
+            _check(sha_fwd == sha_rev,
+                   f"source order changed the timeline: "
+                   f"{sha_fwd} != {sha_rev}")
+            json_fwd = _cli(sources + ["--format", "json"])
+            json_rev = _cli(list(reversed(sources)) + ["--format",
+                                                       "json"])
+            _check(json_fwd == json_rev, "canonical JSON not "
+                                         "byte-identical across runs")
+            n_events = len(json.loads(json_fwd)["events"])
+            _check(n_events == len(merged),
+                   f"CLI merged {n_events} events, library {len(merged)}")
+
+        print(f"blackbox smoke OK: events={len(merged)} "
+              f"succession_epoch={succession[0]['fields']['epoch']} "
+              f"rejoin_epoch={rejoin[0]['fields']['epoch']} "
+              f"sha={sha_fwd[:16]}")
+        return 0
+    finally:
+        fleet.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

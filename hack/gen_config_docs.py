@@ -438,6 +438,32 @@ DESCRIPTIONS = {
                                   "10ms–6h — the tail reaches hours "
                                   "because spool replays carry outage "
                                   "durations).",
+    "telemetry.journal.enabled": "Fleet black box: the HLC-stamped "
+                                 "causal event journal behind "
+                                 "`/debug/journal` and `/debug/bundle`, "
+                                 "plus the `X-Kepler-HLC` clock "
+                                 "piggyback on fleet wire exchanges. "
+                                 "Disabled emission costs one global "
+                                 "read per call (see "
+                                 "docs/developer/observability.md).",
+    "telemetry.journal.ring_size": "Journal events kept in memory "
+                                   "(newest win) — the `/debug/journal` "
+                                   "page and the bundle's journal "
+                                   "section.",
+    "telemetry.journal.dir": "Durable journal spool directory (empty = "
+                             "ring only). CRC-framed `.kepj` files, one "
+                             "per node, readable by "
+                             "`python -m kepler_tpu.blackbox` after a "
+                             "crash.",
+    "telemetry.journal.max_bytes": "Durable spool cap per file; at the "
+                                   "cap the file rotates once to "
+                                   "`.kepj.1` (bounded disk, newest "
+                                   "events always on disk).",
+    "aggregator.hlc_max_drift": "HLC clamp: an inbound clock stamp may "
+                                "advance this replica's clock at most "
+                                "this far past local wall time. Clamped "
+                                "stamps count in "
+                                "`kepler_fleet_hlc_clamped_total`.",
     "dev.fake_cpu_meter.enabled": "Dev-only synthetic meter (YAML-only, "
                                   "never a flag — reference "
                                   "config.go:104,189).",
@@ -534,6 +560,8 @@ FLAG_OF = {
     "tpu.platform": "--tpu.platform",
     "tpu.fleet_backend": "--tpu.fleet-backend",
     "telemetry.enabled": "--telemetry.enable / --no-telemetry.enable",
+    "telemetry.journal.enabled":
+        "--telemetry.journal.enable / --no-telemetry.journal.enable",
 }
 
 _SNAKE_TO_CAMEL = {v: k for k, v in _CANONICAL_YAML_KEYS.items()}
@@ -552,6 +580,7 @@ _DURATION_PATHS = {"monitor.interval", "monitor.staleness",
                    "agent.drain.retry_after_max",
                    "agent.wire.degraded_ttl",
                    "aggregator.membership.probe_timeout",
+                   "aggregator.hlc_max_drift",
                    "service.restart_backoff_initial",
                    "service.restart_backoff_max"}
 

@@ -42,6 +42,16 @@ RING_REQUIRED = {"enabled", "epoch", "self", "peers", "vnodes",
 INGEST_REQUIRED = {"ok", "shedding", "inflight", "max_inflight",
                    "latency_ewma_s", "latency_budget_s", "load",
                    "shed_total", "shed_by_reason"}
+# the fleet black box (ISSUE 19): observability.md "Fleet black box"
+JOURNAL_REQUIRED = {"node", "enabled", "stats", "events", "cursor"}
+JOURNAL_STATS_REQUIRED = {"enabled", "node", "events_total", "ring",
+                          "spool", "write_errors", "hlc_clamped_total",
+                          "hlc_drift_seconds"}
+BUNDLE_REQUIRED = {"schema", "node", "captured_hlc", "journal",
+                   "journal_stats", "rung", "rung_timeline",
+                   "scoreboard", "ring", "stats",
+                   "config_fingerprint"}
+HLC_REQUIRED = {"phys_us", "logical", "node"}
 NODE_REQUIRED = {"state", "state_code", "last_seen_age_s", "reports",
                  "duplicates", "windows_lost", "quarantined",
                  "delivery_ewma_s", "power_w", "power_mean_w",
@@ -60,6 +70,7 @@ def main() -> int:
     jax.config.update("jax_platforms", "cpu")
 
     from kepler_tpu.fleet.aggregator import Aggregator
+    from kepler_tpu.fleet.journal import EventJournal
     from kepler_tpu.fleet.wire import encode_report
     from kepler_tpu.parallel.fleet import MODE_MODEL, MODE_RATIO, NodeReport
     from kepler_tpu.server.http import APIServer
@@ -73,7 +84,9 @@ def main() -> int:
                      workload_bucket=16, stale_after=1e9,
                      peers=["127.0.0.1:28283"],
                      self_peer="127.0.0.1:28283",
-                     admission_enabled=True)
+                     admission_enabled=True,
+                     journal=EventJournal(enabled=True,
+                                          node="127.0.0.1:28283"))
     agg.init()
     server.init()
     ctx = CancelContext()
@@ -162,13 +175,62 @@ def main() -> int:
         _check(set(ingest["shed_by_reason"]) == {"inflight", "latency"},
                f"shed reasons {sorted(ingest['shed_by_reason'])}")
 
+        # a real membership transition (epoch 1 → 2) so the journal has
+        # fleet events to serve — initial ring construction is state,
+        # not a transition, and correctly emits nothing
+        agg.apply_membership(["127.0.0.1:28283", "127.0.0.1:28284"],
+                             2, source="operator")
+        with urllib.request.urlopen(f"{base}/debug/journal",
+                                    timeout=10) as resp:
+            journal = json.loads(resp.read())
+        missing = JOURNAL_REQUIRED - set(journal)
+        _check(not missing, f"/debug/journal missing keys {missing}")
+        _check(journal["enabled"] is True, "journal enabled")
+        gap = JOURNAL_STATS_REQUIRED - set(journal["stats"])
+        _check(not gap, f"journal stats missing keys {gap}")
+        kinds = {e.get("kind") for e in journal["events"]}
+        _check("membership.apply" in kinds,
+               f"membership.apply journaled (got {sorted(kinds)})")
+        _check("lease.adopt" in kinds, "lease.adopt journaled")
+        for entry in journal["events"]:
+            gap = {"hlc", "kind", "fields"} - set(entry)
+            _check(not gap, f"journal entry missing {gap}")
+            gap = HLC_REQUIRED - set(entry["hlc"])
+            _check(not gap, f"journal entry hlc missing {gap}")
+        _check(journal["cursor"], "non-empty page carries a cursor")
+        # cursor pagination: resuming at the last stamp yields nothing
+        with urllib.request.urlopen(
+                f"{base}/debug/journal?since={journal['cursor']}",
+                timeout=10) as resp:
+            page2 = json.loads(resp.read())
+        _check(page2["events"] == [], "cursor resume is strictly-after")
+
+        with urllib.request.urlopen(f"{base}/debug/bundle",
+                                    timeout=10) as resp:
+            bundle_raw = resp.read()
+        bundle = json.loads(bundle_raw)
+        missing = BUNDLE_REQUIRED - set(bundle)
+        _check(not missing, f"/debug/bundle missing keys {missing}")
+        _check(bundle["schema"] == "kepler-bundle/v1",
+               f"bundle schema {bundle.get('schema')!r}")
+        gap = HLC_REQUIRED - set(bundle["captured_hlc"] or {})
+        _check(not gap, f"bundle captured_hlc missing {gap}")
+        _check(len(bundle["journal"]) >= len(journal["events"]),
+               "bundle embeds the journal ring")
+        _check(bundle["ring"]["enabled"] is True, "bundle ring view")
+        # canonical JSON: re-encoding sorted/compact is byte-identical
+        recoded = json.dumps(bundle, sort_keys=True,
+                             separators=(",", ":")).encode() + b"\n"
+        _check(recoded == bundle_raw, "bundle is canonical JSON")
+
         print(f"introspect smoke OK: rung={window['rung_name']} "
               f"shards={window['shards']} "
               f"programs={len(programs)} "
               f"nodes={len(fleet['nodes'])} "
               f"states={fleet['states']} "
               f"ring_epoch={ring['epoch']} "
-              f"ingest_load={ingest['load']}")
+              f"ingest_load={ingest['load']} "
+              f"journal_events={journal['stats']['events_total']}")
         return 0
     finally:
         ctx.cancel()

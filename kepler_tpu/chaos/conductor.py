@@ -181,6 +181,8 @@ def _assemble(fleet: ChaosFleet, agents: list[ChaosAgent],
     stats: dict[str, dict[str, int]] = dict(fleet.retired_stats)
     timelines: dict[str, list[dict[str, Any]]] = {
         k: list(v) for k, v in fleet.retired_timelines.items()}
+    journals: dict[str, list[dict[str, Any]]] = {
+        k: list(v) for k, v in fleet.retired_journals.items()}
     membership: dict[str, MembershipView] = {}
     health_ok: dict[str, bool] = {}
     window_health_ok: dict[str, bool] = {}
@@ -189,6 +191,7 @@ def _assemble(fleet: ChaosFleet, agents: list[ChaosAgent],
         stats[fleet.incarnation(peer)] = dict(agg._stats)
         timelines[fleet.incarnation(peer)] = [
             dict(e) for e in agg._rung_timeline]
+        journals[fleet.incarnation(peer)] = agg._journal.snapshot()
         ring = agg._ring
         lease = agg._lease
         if ring is not None:
@@ -204,7 +207,8 @@ def _assemble(fleet: ChaosFleet, agents: list[ChaosAgent],
         abandoned_windows=0,
         membership=membership, alive=frozenset(fleet.alive),
         health_ok=health_ok, window_health_ok=window_health_ok,
-        pending={a.name: len(a.pending) for a in agents})
+        pending={a.name: len(a.pending) for a in agents},
+        journals=journals, schedule_ops=list(fleet.op_log))
 
 
 def _clean_timeline(timeline: list[dict[str, Any]]

@@ -33,6 +33,7 @@ from kepler_tpu import fault
 from kepler_tpu.chaos.trace import Trace
 from kepler_tpu.fleet import wire
 from kepler_tpu.fleet.aggregator import Aggregator
+from kepler_tpu.fleet.journal import EventJournal
 from kepler_tpu.parallel.fleet import MODE_RATIO, NodeReport
 from kepler_tpu.server.http import APIServer
 
@@ -245,6 +246,13 @@ class ChaosFleet:
         # by incarnation ("peer#generation")
         self.retired_stats: dict[str, dict[str, int]] = {}
         self.retired_timelines: dict[str, list[dict[str, Any]]] = {}
+        self.retired_journals: dict[str, list[dict[str, Any]]] = {}
+        # ground truth for invariant 6: schedule ops whose fleet effect
+        # is CERTAIN (a kill is only certain once a succession tick saw
+        # the peer still dead; a restart/join only when it actually
+        # re-registers an absent peer; autoscale only on an epoch bump)
+        self.op_log: list[dict[str, Any]] = []
+        self._pending_kills: list[dict[str, Any]] = []
         self._generation: dict[str, int] = {}
         for peer in self.members0:
             self._spawn(peer, self.members0)
@@ -295,7 +303,12 @@ class ChaosFleet:
             # conductor installs a policy only for commanded ticks
             membership_autoscale=False,
             membership_auto_apply=True,
-            membership_standby_peers=list(self.standby_peers))
+            membership_standby_peers=list(self.standby_peers),
+            # black-box journal on the fleet's virtual clock: HLC stamps
+            # derive from self.clock, so the merged timeline is as
+            # replay-stable as the trace
+            journal=EventJournal(enabled=True, node=peer,
+                                 clock=self.clock))
         agg.init()
         self.aggs[peer] = agg
         self.alive.add(peer)
@@ -305,6 +318,17 @@ class ChaosFleet:
     def incarnation(self, peer: str) -> str:
         return f"{peer}#{self._generation.get(peer, 0)}"
 
+    def _now_us(self) -> int:
+        return int(self.clock() * 1e6)
+
+    def _member_epoch(self) -> int:
+        """Ring epoch in the stable member view (0 when none)."""
+        for peer in sorted(self.alive):
+            ring = self.aggs[peer]._ring
+            if ring is not None and peer in ring.peers:
+                return int(ring.epoch)
+        return 0
+
     def kill(self, peer: str) -> bool:
         if peer not in self.alive:
             return False
@@ -312,10 +336,19 @@ class ChaosFleet:
         if peer in members and not [
                 m for m in members if m != peer and m in self.alive]:
             return False   # never kill the last live member
+        if peer in members:
+            # not yet CERTAIN: a restart in this same window would
+            # revive the peer before any succession demotes it — the
+            # op is sealed into op_log by the next succession tick
+            self._pending_kills.append({
+                "op": "kill", "peer": peer, "t_us": self._now_us(),
+                "epoch_before": self._member_epoch()})
         agg = self.aggs[peer]
         self.retired_stats[self.incarnation(peer)] = dict(agg._stats)
         self.retired_timelines[self.incarnation(peer)] = [
             dict(e) for e in agg._rung_timeline]
+        self.retired_journals[self.incarnation(peer)] = \
+            agg._journal.snapshot()
         self.alive.discard(peer)
         agg.shutdown()
         del self.aggs[peer]
@@ -327,10 +360,23 @@ class ChaosFleet:
         if peer in self.alive:
             return False
         hint = self.member_peers() or list(self.members0)
+        # a revive before the succession tick voids any pending kill:
+        # the excluding succession apply it would witness never happens
+        self._pending_kills = [
+            op for op in self._pending_kills if op["peer"] != peer]
+        was_member = peer in self.member_peers()
+        epoch_before = self._member_epoch()
         agg = self._spawn(peer, hint)
         try:
             agg.request_join()
             self.trace.emit("join", peer=peer, t=self.clock(), ok=True)
+            if not was_member:
+                # certain: registering an absent peer forces a
+                # membership apply that names it
+                self.op_log.append({
+                    "op": "restart", "peer": peer,
+                    "t_us": self._now_us(),
+                    "epoch_before": epoch_before})
             return True
         except Exception as err:
             self.trace.emit("join", peer=peer, t=self.clock(), ok=False,
@@ -347,9 +393,15 @@ class ChaosFleet:
         ring = agg._ring
         if ring is not None and peer in ring.peers:
             return False
+        was_member = peer in self.member_peers()
+        epoch_before = self._member_epoch()
         try:
             agg.request_join()
             self.trace.emit("join", peer=peer, t=self.clock(), ok=True)
+            if not was_member:
+                self.op_log.append({
+                    "op": "join", "peer": peer, "t_us": self._now_us(),
+                    "epoch_before": epoch_before})
             return True
         except Exception as err:
             self.trace.emit("join", peer=peer, t=self.clock(), ok=False,
@@ -363,6 +415,7 @@ class ChaosFleet:
         start = sorted(m for m in members if m in self.alive)
         if not start:
             return False
+        epoch_before = self._member_epoch()
         target = start[0]
         for _ in range(len(members) + 2):
             try:
@@ -378,6 +431,18 @@ class ChaosFleet:
                 continue
             self.trace.emit("leave", peer=peer, via=target,
                             ok=bool(reply.get("ok")), t=self.clock())
+            if reply.get("ok"):
+                # certain: an ok reply means the leader applied the
+                # excluding membership with an epoch bump
+                self.op_log.append({
+                    "op": "leave", "peer": peer, "t_us": self._now_us(),
+                    "epoch_before": epoch_before})
+                # a dead member leaving is the same excluding apply a
+                # pending kill of THAT peer is waiting on: certain now
+                self.op_log.extend(op for op in self._pending_kills
+                                   if op["peer"] == peer)
+                self._pending_kills = [op for op in self._pending_kills
+                                       if op["peer"] != peer]
             return bool(reply.get("ok"))
         self.trace.emit("leave", peer=peer, ok=False, t=self.clock())
         return False
@@ -389,6 +454,7 @@ class ChaosFleet:
         if not holder or holder not in self.alive:
             return False
         agg = self.aggs[holder]
+        epoch_before = int(agg._ring.epoch)
         agg._admission = _StubAdmission(2.0 if up else 0.0)
         agg._autoscale = AutoscalePolicy(up_windows=1, down_windows=1)
         try:
@@ -399,6 +465,12 @@ class ChaosFleet:
         self.trace.emit("autoscale", direction="up" if up else "down",
                         holder=holder, t=self.clock(),
                         epoch=agg._ring.epoch)
+        if int(agg._ring.epoch) > epoch_before:
+            # certain only when the tick actually enacted a scale (at
+            # the replica floor/ceiling nothing changes)
+            self.op_log.append({
+                "op": "autoscale", "peer": "", "t_us": self._now_us(),
+                "epoch_before": epoch_before})
         if up:
             # the autoscaler "provisioned" the promoted standby: give
             # any member peer without a live process one, and have it
@@ -435,6 +507,13 @@ class ChaosFleet:
         member that sees a dead ring peer runs mesh demotion, which
         probes survivors and lets exactly one issuer drive the epoch
         bump + broadcast."""
+        if self._pending_kills:
+            # a peer still dead at succession time WILL be demoted by
+            # this tick (the membership seams are deterministic): the
+            # pending kill's fleet effect is certain now
+            self.op_log.extend(op for op in self._pending_kills
+                               if op["peer"] not in self.alive)
+            self._pending_kills.clear()
         for peer in sorted(self.alive):
             agg = self.aggs[peer]
             ring = agg._ring

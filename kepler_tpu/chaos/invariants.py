@@ -27,6 +27,14 @@ The five invariants, matching docs/developer/resilience.md:
    fault: all member replicas agree on (epoch, peers, holder); the
    lease holder is a live member; health and window-health probes are
    green; every agent has drained its backlog.
+6. **Journal completeness + causal order** — every conductor schedule
+   op with a certain effect (a kill of a member, an accepted
+   join/leave/restart, an enacted autoscale) leaves matching evidence
+   in the merged black-box journal, each per-incarnation journal is
+   strictly HLC-increasing, and no event's HLC physical component
+   precedes the conductor's virtual-clock time of the op that caused
+   it — the journal can NEVER tell a story the ground-truth schedule
+   contradicts.
 """
 
 from __future__ import annotations
@@ -45,7 +53,8 @@ ATOL_UW = 1e3     # 1 mW absolute floor — masks pure float noise at 0
 
 @dataclass(frozen=True)
 class Violation:
-    invariant: str    # conservation | loss | duplicates | ladder | convergence
+    # conservation | loss | duplicates | ladder | convergence | journal
+    invariant: str
     detail: str
 
     def __str__(self) -> str:
@@ -95,6 +104,14 @@ class RunRecord:
     health_ok: dict[str, bool] = field(default_factory=dict)
     window_health_ok: dict[str, bool] = field(default_factory=dict)
     pending: dict[str, int] = field(default_factory=dict)
+    # black box (invariant 6): replica incarnation -> journal snapshot
+    # (live replicas at run end, killed incarnations at kill time) and
+    # the conductor's ground-truth op log — only ops whose EFFECT was
+    # certain (kill of a member, accepted join/leave/restart, enacted
+    # autoscale), each with the virtual-clock time it happened at
+    journals: dict[str, Sequence[Mapping[str, object]]] = \
+        field(default_factory=dict)
+    schedule_ops: list[Mapping[str, object]] = field(default_factory=list)
 
 
 def _close(a: float, b: float) -> bool:
@@ -245,9 +262,93 @@ def check_convergence(rec: RunRecord) -> list[Violation]:
     return out
 
 
+def _hlc_of(entry: Mapping[str, object]) -> tuple[int, int, str]:
+    h = entry.get("hlc")
+    if not isinstance(h, Mapping):
+        return (0, 0, "")
+    return (int(h.get("phys_us", 0)),    # type: ignore[arg-type]
+            int(h.get("logical", 0)),    # type: ignore[arg-type]
+            str(h.get("node", "")))
+
+
+def _op_evidence(op: Mapping[str, object],
+                 entry: Mapping[str, object]) -> bool:
+    """Does one journal event witness one schedule op?"""
+    kind = str(entry.get("kind", ""))
+    fields = entry.get("fields")
+    fields = fields if isinstance(fields, Mapping) else {}
+    peer = str(op.get("peer", ""))
+    epoch_before = int(op.get("epoch_before", 0))  # type: ignore[arg-type]
+    name = str(op.get("op", ""))
+    if name == "autoscale":
+        return (kind == "autoscale.enact"
+                and int(fields.get("epoch", 0)) > epoch_before)  # type: ignore[arg-type]
+    if kind != "membership.apply":
+        return False
+    peers = fields.get("peers")
+    peers = list(peers) if isinstance(peers, (list, tuple)) else []
+    epoch = int(fields.get("epoch", 0))  # type: ignore[arg-type]
+    if name == "kill":
+        # the survivors' succession apply: peer gone, epoch advanced
+        return peer not in peers and epoch > epoch_before
+    if name in ("restart", "join"):
+        return peer in peers
+    if name == "leave":
+        return peer not in peers and epoch > epoch_before
+    return False
+
+
+def check_journal_vs_schedule(rec: RunRecord) -> list[Violation]:
+    """Invariant 6: merged-journal completeness against the conductor's
+    ground-truth op log, per-node HLC monotonicity, and no HLC stamp
+    that predates the virtual-clock time of the op it witnesses."""
+    out: list[Violation] = []
+    merged: list[Mapping[str, object]] = []
+    for inc in sorted(rec.journals):
+        entries = list(rec.journals[inc])
+        merged.extend(entries)
+        # (a) strictly HLC-increasing within one incarnation's journal
+        for prev, cur in zip(entries, entries[1:]):
+            if _hlc_of(cur) <= _hlc_of(prev):
+                out.append(Violation(
+                    "journal",
+                    f"{inc}: journal not strictly HLC-increasing at "
+                    f"{_hlc_of(prev)} -> {_hlc_of(cur)}"))
+    if not rec.schedule_ops:
+        return out
+    if not merged:
+        out.append(Violation(
+            "journal",
+            f"{len(rec.schedule_ops)} schedule op(s) with certain "
+            f"effects but the merged journal is empty"))
+        return out
+    for op in rec.schedule_ops:
+        t_us = int(op.get("t_us", 0))  # type: ignore[arg-type]
+        witnesses = [e for e in merged if _op_evidence(op, e)]
+        label = (f"op={op.get('op')} peer={op.get('peer')} "
+                 f"t_us={t_us} epoch_before={op.get('epoch_before')}")
+        if not witnesses:
+            out.append(Violation(
+                "journal",
+                f"schedule {label}: no witnessing event in the merged "
+                f"journal"))
+            continue
+        # (b) causal order vs the conductor's virtual clock: at least
+        # one witness must be stamped AT or AFTER the op happened — a
+        # journal whose every witness precedes its cause is lying
+        if all(_hlc_of(e)[0] < t_us for e in witnesses):
+            stamps = sorted(_hlc_of(e)[0] for e in witnesses)
+            out.append(Violation(
+                "journal",
+                f"schedule {label}: every witnessing event is stamped "
+                f"before the op's virtual time ({stamps[-1]} < {t_us})"))
+    return out
+
+
 def check_all(rec: RunRecord) -> list[Violation]:
     return (check_conservation(rec)
             + check_no_fabricated_loss(rec)
             + check_no_duplicates(rec)
             + check_ladder(rec)
-            + check_convergence(rec))
+            + check_convergence(rec)
+            + check_journal_vs_schedule(rec))

@@ -77,6 +77,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     server = make_api_server([cfg.aggregator.listen_address],
                              cfg.web.config_file,
                              max_connections=cfg.web.max_connections)
+    # fleet black box: one journal per replica, installed process-wide
+    # (module emit sites) AND handed to the Aggregator (its /debug
+    # surfaces + metric families ride the aggregator's registration)
+    from kepler_tpu.fleet import journal as journal_mod
+    jnl = journal_mod.install_from_config(
+        cfg.telemetry,
+        node=(cfg.aggregator.self_peer or cfg.aggregator.listen_address),
+        max_drift_s=cfg.aggregator.hlc_max_drift)
     aggregator = Aggregator(
         server,
         interval=cfg.aggregator.interval,
@@ -127,6 +135,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         admission_retry_after_max=(
             cfg.aggregator.admission_retry_after_max),
         base_row_cache=cfg.aggregator.base_row_cache,
+        journal=jnl,
+        hlc_max_drift=cfg.aggregator.hlc_max_drift,
     )
     # self-telemetry traces (ingest/decode/merge, window cycles)
     server.register("/debug/traces", "Traces",

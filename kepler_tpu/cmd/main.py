@@ -117,9 +117,14 @@ def create_services(cfg: Config) -> list:
         services.append(pod_lookup)
     services += [resources, monitor, server]
     if cfg.monitor.interval > 0:
+        stall_journal = None
+        if cfg.telemetry.journal.enabled:
+            from kepler_tpu.fleet import journal
+            stall_journal = journal.active()
         watchdog = MonitorWatchdog(
             monitor, interval=cfg.monitor.interval,
-            stall_after=cfg.monitor.stall_after or None)
+            stall_after=cfg.monitor.stall_after or None,
+            journal=stall_journal)
         services.append(watchdog)
         # ONE monitor probe: the watchdog's (stall flag + age + stall
         # count) supersedes monitor.health, which reads the same flag
@@ -203,8 +208,16 @@ def create_services(cfg: Config) -> list:
         # scrape beside the power collectors; when telemetry is disabled
         # the recorder simply has no samples
         collectors.append(telemetry.collector())
-        if agent is not None and cfg.agent.spool.dir:
-            collectors.append(agent)  # kepler_fleet_spool_* durability plane
+        if cfg.telemetry.journal.enabled:
+            # kepler_fleet_journal_* / HLC families (black box). The
+            # import stays inside the gate: fleet pulls jax, and a
+            # journal-less monitor must not pay that
+            from kepler_tpu.fleet import journal
+            collectors.append(journal.collector())
+        if agent is not None:
+            # breaker-state gauge always; kepler_fleet_spool_* rides
+            # along when a spool is configured
+            collectors.append(agent)
         services.append(PrometheusExporter(
             server, collectors,
             debug_collectors=cfg.exporter.prometheus.debug_collectors))
@@ -236,6 +249,14 @@ def main(argv: Sequence[str] | None = None) -> int:
     try:
         fault.install_from_config(cfg.fault)
         telemetry.install_from_config(cfg.telemetry)
+        if cfg.telemetry.journal.enabled:
+            # black-box journal for the agent/monitor process (breaker,
+            # spool rewind, watchdog stall events); lazy import — the
+            # fleet package pulls jax
+            from kepler_tpu.fleet import journal
+            journal.install_from_config(
+                cfg.telemetry, node=cfg.kube.node_name,
+                max_drift_s=cfg.aggregator.hlc_max_drift)
         services = create_services(cfg)
     except Exception as err:
         log.error("failed to create services: %s", err)

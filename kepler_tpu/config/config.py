@@ -292,6 +292,24 @@ class AgentConfig:
 
 
 @dataclass
+class JournalConfig:
+    """Fleet black box (``kepler_tpu.fleet.journal``): the HLC-stamped
+    causal event journal behind ``/debug/journal`` and
+    ``/debug/bundle``. Disabled emission costs one global read per
+    event, same contract as spans."""
+
+    enabled: bool = False
+    # bounded in-memory event ring per process
+    ring_size: int = 512
+    # durable spool directory ("" = ring only); events are appended as
+    # CRC32-framed canonical JSON so a crashed replica's last moments
+    # survive for the incident bundle
+    dir: str = ""
+    # durable file size cap (one rotation to .1 beyond it)
+    max_bytes: int = 4_000_000
+
+
+@dataclass
 class TelemetryConfig:
     """Self-telemetry plane (``kepler_tpu.telemetry``): span tracing of
     the monitor/exporter/fleet hot paths, ``kepler_self_*`` metrics, and
@@ -308,6 +326,9 @@ class TelemetryConfig:
     # kepler_fleet_delivery_latency_seconds bucket bounds (seconds);
     # the default tail reaches hours because spool replays carry outages
     delivery_buckets: list[float] = field(default_factory=list)
+    # fleet black-box event journal (docs/developer/observability.md
+    # "Fleet black box")
+    journal: JournalConfig = field(default_factory=JournalConfig)
 
 
 @dataclass
@@ -441,6 +462,11 @@ class AggregatorConfig:
     # degraded after its last quarantined report
     skew_tolerance: float = 120.0
     degraded_ttl: float = 60.0
+    # HLC drift clamp (telemetry/hlc.py): an inbound journal clock
+    # stamp whose physical component is more than this far ahead of the
+    # local wall clock is clamped before merging, so one hostile or
+    # broken peer cannot vault the fleet's causal clocks
+    hlc_max_drift: float = 60.0
     # aggregator: per-node (run, seq) dedup window — spool replays and
     # retries are absorbed idempotently instead of double-ingesting
     dedup_window: int = 1024
@@ -762,6 +788,15 @@ class Config:
             errs.append("service.restartMax must be >= 0")
         if self.telemetry.ring_size < 1:
             errs.append("telemetry.ringSize must be >= 1")
+        journal = self.telemetry.journal
+        if journal.ring_size < 1:
+            errs.append("telemetry.journal.ringSize must be >= 1")
+        if journal.max_bytes < 4096:
+            errs.append("telemetry.journal.maxBytes must be >= 4096 "
+                        "(one rotation must fit at least a few frames)")
+        if self.aggregator.hlc_max_drift <= 0:
+            errs.append("aggregator.hlcMaxDrift must be > 0 (the clamp "
+                        "bound on inbound HLC physical clocks)")
         for name, buckets in (
                 ("telemetry.stageBuckets", self.telemetry.stage_buckets),
                 ("telemetry.deliveryBuckets",
@@ -876,6 +911,7 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "ringSize": "ring_size",
     "stageBuckets": "stage_buckets",
     "deliveryBuckets": "delivery_buckets",
+    "hlcMaxDrift": "hlc_max_drift",
 }
 
 
@@ -895,7 +931,7 @@ _DURATION_FIELDS = {"interval", "staleness", "stale_after", "stall_after",
                     "state_max_age", "fsync_interval", "dispatch_timeout",
                     "admission_latency_budget", "admission_retry_after",
                     "admission_retry_after_max", "retry_after_max",
-                    "init_timeout", "probe_timeout"}
+                    "init_timeout", "probe_timeout", "hlc_max_drift"}
 
 
 def _apply_mapping(obj: Any, data: Mapping[str, Any], path: str = "") -> None:
@@ -1161,6 +1197,10 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--telemetry.enable", dest="telemetry_enable", default=None,
         action=argparse.BooleanOptionalAction,
         help="self-telemetry span tracing + kepler_self_* metrics")
+    add("--telemetry.journal.enable", dest="telemetry_journal_enable",
+        default=None, action=argparse.BooleanOptionalAction,
+        help="fleet black-box event journal "
+             "(/debug/journal + /debug/bundle)")
 
 
 def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
@@ -1271,6 +1311,8 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
     set_if(("tpu", "platform"), args.tpu_platform)
     set_if(("tpu", "fleet_backend"), args.tpu_fleet_backend)
     set_if(("telemetry", "enabled"), args.telemetry_enable)
+    if args.telemetry_journal_enable is not None:
+        cfg.telemetry.journal.enabled = args.telemetry_journal_enable
     return cfg
 
 

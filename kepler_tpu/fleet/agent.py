@@ -69,6 +69,7 @@ import uuid
 from typing import Any, Callable, Sequence
 
 from kepler_tpu import fault, telemetry
+from kepler_tpu.fleet import journal
 from kepler_tpu.fleet.delivery import keyframe_wanted
 from kepler_tpu.fleet.ring import coerce_epoch, sanitize_peer
 from kepler_tpu.fleet.spool import Spool, SpoolRecord
@@ -636,12 +637,24 @@ class FleetAgent:
         return pending
 
     def collect(self):
-        """prometheus_client custom-collector hook: spool durability
-        metrics (registered only when a spool is configured)."""
+        """prometheus_client custom-collector hook: the breaker-state
+        gauge (always) plus spool durability metrics (only when a spool
+        is configured)."""
         from prometheus_client.core import (
             CounterMetricFamily,
             GaugeMetricFamily,
         )
+        breaker = GaugeMetricFamily(
+            "kepler_fleet_agent_breaker_state",
+            "Send circuit-breaker state as an enum gauge: exactly one "
+            "of the three state labels is 1 at any scrape (alert on "
+            'kepler_fleet_agent_breaker_state{state="open"} == 1)',
+            labels=["state"])
+        for state in (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN):
+            breaker.add_metric([state],
+                               1.0 if self._breaker_state == state
+                               else 0.0)
+        yield breaker
         if self._spool is None:
             return
         stats = self._spool.stats()
@@ -888,6 +901,8 @@ class FleetAgent:
         """The aggregator responded — close the breaker, reset schedules."""
         if self._breaker_state != BREAKER_CLOSED:
             log.info("circuit breaker closed: aggregator recovered")
+            journal.emit("breaker.close", target=self._target.display,
+                         failures=self._consecutive_failures)
         self._breaker_state = BREAKER_CLOSED
         self._consecutive_failures = 0
         self._breaker_backoff = self._breaker_cooldown
@@ -936,6 +951,10 @@ class FleetAgent:
             self._breaker_open_until = (self._monotonic()
                                         + self._breaker_backoff)
             self._stats["breaker_opens"] += 1
+            journal.emit("breaker.open", target=self._target.display,
+                         failures=self._consecutive_failures,
+                         cooldown_s=round(self._breaker_backoff, 3),
+                         probe_failed=half_open)
             # shed the in-flight IN-MEMORY sample — by reopen time it is
             # stale. A spooled record is NOT shed: it stays durably
             # unacked and replays after the cooldown (losing it would
@@ -1016,6 +1035,8 @@ class FleetAgent:
         rewound = self._spool.rewind(self._handoff_replay)
         if rewound:
             self._stats["handoffs"] += 1
+            journal.emit("spool.rewind", records=rewound,
+                         target=self._target.display)
             # an in-flight peek predates the rewound cursor (its ack
             # would no-op anyway) — drop it so the drain restarts from
             # the rewound tail in order
@@ -1254,6 +1275,15 @@ class FleetAgent:
             # the next attempt
             self._close_conn()
             raise
+        jnl = journal.active()
+        if jnl.enabled:
+            # merge the replica's HLC piggyback (EVERY response carries
+            # it when its journal is on — accepts, 421 redirects, 409
+            # needs-keyframe, 429 sheds) so this agent's breaker/spool
+            # events order causally after the replica's state changes.
+            # A hostile stamp is laundered away; a vaulted one is
+            # clamped (observe_text → parse_hlc + drift clamp).
+            jnl.observe_text(resp.headers.get("X-Kepler-HLC"))
         if fault.fire("net.partition") is not None:
             # one-way partition: the replica processed the report but
             # its response never made it back — the agent must treat

@@ -34,6 +34,7 @@ the batched analog of the reference's per-zone skip-on-error.
 from __future__ import annotations
 
 import collections
+import hashlib
 import json
 import logging
 import queue
@@ -56,6 +57,11 @@ from kepler_tpu.fleet.delivery import (
     delta_base_matches,
     reseed_on_ownership_return,
     seed_fresh_tracker,
+)
+from kepler_tpu.fleet.journal import (
+    EventJournal,
+    canonical_json,
+    make_journal_handler,
 )
 from kepler_tpu.fleet.membership import (
     AutoscaleDecision,
@@ -462,6 +468,8 @@ class Aggregator:
         base_row_cache: int = 1024,
         clock: Callable[[], float] | None = None,
         mesh: Any = None,
+        journal: EventJournal | None = None,
+        hlc_max_drift: float = 60.0,
     ) -> None:
         self._server = server
         self._interval = interval
@@ -475,6 +483,29 @@ class Aggregator:
         # 0.5% accuracy budget is validated under); bf16 = throughput mode
         self._accuracy_mode = accuracy_mode
         self._clock = clock or _time.time
+        # fleet black box: every state transition below goes through the
+        # journal chokepoint; the default is a disabled per-instance
+        # journal (one attribute check per emission) on this replica's
+        # clock seam, so library/test construction costs nothing and
+        # chaos replicas never share clocks
+        self._journal = journal if journal is not None else EventJournal(
+            enabled=False, node=str(self_peer or ""), clock=self._clock,
+            max_drift_s=hlc_max_drift)
+        # admission-shed ONSET edge (False→True) is a journal event; the
+        # return to admitting resets the edge detector — steady-state
+        # shedding emits nothing (the journal records transitions, rates
+        # live in the admission controller's own counters)
+        self._shedding = False  # keplint: guarded-by=_lock
+        # /debug/bundle stamps a config fingerprint so two bundles from
+        # "the same fleet" are checkably from the same rollout
+        self._config_fingerprint = hashlib.sha256(canonical_json({
+            "self_peer": str(self_peer or ""),
+            "interval": float(interval),
+            "stale_after": float(stale_after),
+            "model_mode": str(model_mode or ""),
+            "multihost": bool(multihost_enabled),
+            "hlc_max_drift": float(hlc_max_drift),
+        })).hexdigest()[:16]
         self._mesh = mesh
         # aggregator.meshShape/meshAxes: the device mesh the packed
         # window path actually runs on ([] = all devices, 1-D node axis
@@ -857,6 +888,16 @@ class Aggregator:
                               "consistent-hash ingest ring: membership "
                               "epoch, peers, ownership share, redirect "
                               "counters", self._handle_ring_debug)
+        self._server.register("/debug/journal", "Fleet black box",
+                              "HLC-stamped causal event journal "
+                              "(?since=<hlc cursor>&limit=N paginates)",
+                              make_journal_handler(self._journal))
+        self._server.register("/debug/bundle", "Incident bundle",
+                              "one-shot incident snapshot: journal + "
+                              "rung timeline + scoreboard + ring + "
+                              "config fingerprint (canonical JSON — "
+                              "feed to python -m kepler_tpu.blackbox)",
+                              self._handle_bundle_debug)
         if self._ring is not None:
             self._server.register("/v1/membership", "Elastic membership",
                                   "POST apply/join/leave membership "
@@ -1036,6 +1077,7 @@ class Aggregator:
         worker, self._fetch_worker = self._fetch_worker, None
         if worker is not None:
             worker.stop()
+        self._journal.close()
 
     # -- ingest ------------------------------------------------------------
 
@@ -1048,6 +1090,8 @@ class Aggregator:
             ctrl = self._admission
             if request.command != "POST":
                 return self._ingest_report(request)
+            if not self._observe_request_hlc(request):
+                return self._bad_hlc_response()
             # ONE header parse per record, carried from the admission
             # peek through _ingest_payload (v1 used to re-parse the
             # same JSON up to four times; v2 makes this a struct read)
@@ -1061,6 +1105,7 @@ class Aggregator:
             retry = ctrl.admit(self._priority_of(request.body, parsed))
             if retry is not None:
                 return self._throttle_response(retry)
+            self._note_admitted()
             t0 = _time.perf_counter()
             try:
                 return self._ingest_report(request, parsed)
@@ -1080,6 +1125,8 @@ class Aggregator:
         with telemetry.span("aggregator.ingest"):
             if request.command != "POST":
                 return 405, {"Content-Type": "text/plain"}, b"POST only\n"
+            if not self._observe_request_hlc(request):
+                return self._bad_hlc_response()
             if fault.fire("replica.down") is not None:
                 return (503, {"Content-Type": "text/plain"},
                         b"replica down (fault injection)\n")
@@ -1105,9 +1152,11 @@ class Aggregator:
                     retry = ctrl.admit(self._priority_of(body, parsed))
                     if retry is not None:
                         shed_retry = retry
+                        self._note_shed_onset(retry)
                         results.append({"status": 429,
                                         "retry_after": retry})
                         continue
+                    self._note_admitted()
                 t0 = _time.perf_counter()
                 try:
                     status, resp_headers, resp_body = \
@@ -1141,10 +1190,28 @@ class Aggregator:
 
     def _throttle_response(
             self, retry: float) -> tuple[int, dict[str, str], bytes]:
+        self._note_shed_onset(retry)
         body = json.dumps({"retry_after": retry}).encode()
         return (429, {"Content-Type": "application/json",
                       "Retry-After": f"{retry:g}",
                       **self._epoch_headers()}, body)
+
+    def _note_shed_onset(self, retry: float) -> None:
+        """Journal the admission-shed ONSET (False→True edge only —
+        steady-state shedding is a rate, not an event)."""
+        with self._lock:
+            onset = not self._shedding
+            self._shedding = True
+        if onset:
+            self._journal.emit("admission.shed",
+                               retry_after=round(float(retry), 3))
+
+    def _note_admitted(self) -> None:
+        """An admitted request closes the shed episode: the NEXT shed
+        is a fresh onset."""
+        if self._shedding:
+            with self._lock:
+                self._shedding = False
 
     def _priority_of(self, body: bytes,
                      parsed: "ParsedHeader | None" = None) -> int:
@@ -1519,11 +1586,40 @@ class Aggregator:
 
     def _epoch_headers(self) -> dict[str, str]:
         """Accepts advertise the ring epoch so settled agents notice a
-        membership bump lazily (no extra round-trips)."""
+        membership bump lazily (no extra round-trips); with the journal
+        enabled they ALSO carry this replica's HLC stamp, so agents'
+        clocks chain causally to the aggregator's (piggyback — never an
+        extra round-trip, absent entirely when the journal is off)."""
+        headers: dict[str, str] = {}
+        hlc_text = self._journal.header()
+        if hlc_text is not None:
+            headers["X-Kepler-HLC"] = hlc_text
         ring = self._ring
-        if ring is None:
-            return {}
-        return {"X-Kepler-Epoch": str(ring.epoch)}
+        if ring is not None:
+            headers["X-Kepler-Epoch"] = str(ring.epoch)
+        return headers
+
+    def _observe_request_hlc(self, request: Any) -> bool:
+        """Merge an inbound ``X-Kepler-HLC`` stamp into this replica's
+        clock. Returns False ONLY for a present-but-hostile stamp (the
+        caller answers 400) — absent headers and chaos/test stand-in
+        requests without a ``headers`` attribute are fine. The clamp
+        in :meth:`HlcClock.observe` bounds how far a valid-but-vaulted
+        stamp can advance us (KTL112: laundered, never trusted)."""
+        headers = getattr(request, "headers", None)
+        if headers is None:
+            return True
+        raw = headers.get("X-Kepler-HLC")
+        if raw is None:
+            return True
+        return self._journal.observe_text(raw)
+
+    def _bad_hlc_response(self) -> tuple[int, dict[str, str], bytes]:
+        with self._lock:
+            self._stats["rejected_total"] += 1
+            self._stats["malformed_total"] += 1
+        return (400, {"Content-Type": "text/plain"},
+                b"malformed X-Kepler-HLC header\n")
 
     # -- ingest ring (HA ingest tier) --------------------------------------
 
@@ -1627,6 +1723,14 @@ class Aggregator:
             self._last_membership_at = self._clock()
             self._membership_applied[source] = \
                 self._membership_applied.get(source, 0) + 1
+        # black box: the apply and the lock-step lease adopt are TWO
+        # events — timeline readers correlate successions across
+        # replicas by the adopt, membership churn by the apply
+        self._journal.emit("membership.apply", epoch=ep,
+                           peers=sorted(new.peers), source=source,
+                           dropped=len(dropped), retired=retired)
+        self._journal.emit("lease.adopt", holder=who, epoch=ep,
+                           source=source)
         if self._multihost_enabled:
             # elastic rebuild, the PR-6 ladder-reset invariant: sticky
             # maps cleared, rings re-seeded — the next window does a
@@ -1799,6 +1903,11 @@ class Aggregator:
             "issuer": issuer, "mesh": bool(mesh)}
         if self._lease is not None:
             payload["lease"] = self._lease.lease_id
+        hlc_text = self._journal.header()
+        if hlc_text is not None:
+            # the HLC piggyback: receivers' journals order their apply
+            # AFTER the issuer's (causal chain through the broadcast)
+            payload["hlc"] = hlc_text
         for peer in sorted(set(peers) | set(extra)):
             if peer == self._self_peer:
                 continue
@@ -1876,7 +1985,12 @@ class Aggregator:
             try:
                 # an equal-epoch replay above skips the lease adopt —
                 # take the incumbent from the reply explicitly
+                before = (self._lease.holder, self._lease.epoch)
                 self._lease.adopt(granted_holder, epoch)
+                if (self._lease.holder, self._lease.epoch) != before:
+                    self._journal.emit("lease.adopt",
+                                       holder=granted_holder,
+                                       epoch=epoch, source="join_reply")
             except MembershipError:
                 pass  # a fresher lease was already adopted locally
         return reply
@@ -1980,6 +2094,10 @@ class Aggregator:
             cleaned = validate_membership_payload(raw)
         except MembershipError as err:
             return self._membership_reject(400, err.reason, str(err))
+        if "hlc" in cleaned:
+            # already laundered to an HLC by the validator; the observe
+            # clamps a vaulted physical clock (KTL112)
+            self._journal.observe(cleaned["hlc"])
         op = cleaned.get("op")
         if op == "apply":
             if "peers" not in cleaned or "epoch" not in cleaned:
@@ -2109,6 +2227,11 @@ class Aggregator:
         epoch = ring.epoch + 1
         self.apply_membership(peers, epoch, source="autoscale",
                               issuer=self._self_peer)
+        changed = sorted(set(peers) ^ current)
+        self._journal.emit("autoscale.enact",
+                           direction=decision.direction, epoch=epoch,
+                           peer=changed[0] if changed else "",
+                           replicas=len(peers), reason=decision.reason)
         self._broadcast_membership(peers, epoch, extra=extra)
 
     def ring_health(self) -> dict:
@@ -2222,6 +2345,10 @@ class Aggregator:
         node = node[:self._degraded_name_cap]
         entry = self._degraded.get(node)
         if entry is None:
+            # black box: ONSET only — the node ENTERING the degraded
+            # set is the event; per-report charges are counters
+            self._journal.emit("quarantine.onset", node=node,
+                               reason=reason)
             if len(self._degraded) >= self._degraded_cap:
                 oldest = min(self._degraded,
                              key=lambda n: self._degraded[n]["last_at"])
@@ -2347,16 +2474,28 @@ class Aggregator:
         time orders transitions across wall-clock steps; wall time
         anchors them for humans. ``from_name`` overrides the from-rung
         display for the mesh demotion, whose from/to share rung 0."""
-        self._rung_timeline.append({
+        rung_name = self._rung_display(rung)
+        from_rung_name = from_name or self._rung_display(prev)
+        stamp = self._journal.emit(
+            "rung.transition", rung=rung, rung_name=rung_name,
+            from_rung=prev, from_rung_name=from_rung_name,
+            reason=reason)
+        entry: dict[str, Any] = {
             "rung": rung,
-            "rung_name": self._rung_display(rung),
+            "rung_name": rung_name,
             "from_rung": prev,
-            "from_rung_name": from_name or self._rung_display(prev),
+            "from_rung_name": from_rung_name,
             "reason": reason,
             "wall_time": self._clock(),
             "monotonic_s": _time.monotonic(),
             "windows_at_prev_rung": self._windows_at_rung,
-        })
+        }
+        if stamp is not None:
+            # the journal's HLC stamp, when enabled — lets /debug/window
+            # rows line up against the merged fleet timeline (wall +
+            # monotonic stay: humans and single-process ordering)
+            entry["hlc"] = stamp.to_dict()
+        self._rung_timeline.append(entry)
         self._windows_at_rung = 0
 
     def _handle_device_failure(self, err: Exception) -> None:
@@ -3310,6 +3449,53 @@ class Aggregator:
         return (200, {"Content-Type": "application/json"},
                 json.dumps(snap).encode())
 
+    def _handle_bundle_debug(self, request: Any) -> tuple[int,
+                                                          dict[str, str],
+                                                          bytes]:
+        """``GET /debug/bundle``: the one-shot incident snapshot —
+        journal + rung timeline + scoreboard + ring view + config
+        fingerprint, as CANONICAL JSON (sorted keys, no whitespace) so
+        two captures of the same state are byte-identical. Feed the
+        file straight to ``python -m kepler_tpu.blackbox``."""
+        return (200, {"Content-Type": "application/json"},
+                canonical_json(self.bundle()) + b"\n")
+
+    def bundle(self) -> dict[str, Any]:
+        """The incident-bundle document (kepler-bundle/v1). Pure state
+        capture — safe to call from tests and the chaos conductor."""
+        now = self._clock()
+        ring = self._ring
+        lease = self._lease
+        with self._lock:
+            scoreboard = self._scoreboard.snapshot(now, self._stale_after)
+            stats = dict(self._stats)
+        with self._results_lock:
+            timeline = list(self._rung_timeline)
+            rung = self._rung
+        ring_view: dict[str, Any] = {
+            "enabled": ring is not None,
+            "epoch": ring.epoch if ring is not None else 0,
+            "peers": list(ring.peers) if ring is not None else [],
+            "holder": lease.holder if lease is not None else "",
+        }
+        if ring is not None:
+            ring_view["digest"] = ring.membership_digest
+        return {
+            "schema": "kepler-bundle/v1",
+            "node": self._journal.node or self._self_peer,
+            "captured_hlc": (self._journal.hlc.now().to_dict()
+                             if self._journal.enabled else None),
+            "journal": self._journal.snapshot(),
+            "journal_stats": self._journal.stats(),
+            "rung": rung,
+            "rung_timeline": timeline,
+            "scoreboard": scoreboard,
+            "ring": ring_view,
+            "stats": {k: stats[k] for k in sorted(stats)
+                      if isinstance(stats[k], (int, float, str))},
+            "config_fingerprint": self._config_fingerprint,
+        }
+
     # -- prometheus (cluster-level families) -------------------------------
 
     def collect(self) -> "Iterator[Any]":
@@ -3318,6 +3504,10 @@ class Aggregator:
             CounterMetricFamily,
             GaugeMetricFamily,
         )
+        # black-box families ride the aggregator's registration (the
+        # binary registers ONE collector; the journal's events/HLC
+        # families must not need a second)
+        yield from self._journal.collect()
         with self._results_lock:
             results = self._results
             stats = dict(self._stats)

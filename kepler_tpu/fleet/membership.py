@@ -48,6 +48,7 @@ from kepler_tpu.fleet.ring import (
     coerce_epoch,
     sanitize_peer,
 )
+from kepler_tpu.telemetry.hlc import parse_hlc
 
 __all__ = [
     "AutoscaleDecision",
@@ -345,6 +346,17 @@ def validate_membership_payload(payload: object) -> dict:
             raise MembershipError("bad_lease",
                                   f"invalid lease id {raw_lease!r}")
         out["lease"] = lease
+    raw_hlc = payload.get("hlc")
+    if raw_hlc is not None:
+        # the black-box HLC piggyback: laundered to a parsed stamp (the
+        # observer's drift clamp bounds it further); a malformed stamp
+        # rejects the payload like every other hostile field
+        hlc = parse_hlc(raw_hlc)
+        if hlc is None:
+            raise MembershipError(
+                "bad_payload",
+                f"invalid membership hlc stamp {raw_hlc!r:.64}")
+        out["hlc"] = hlc
     # a bool flag, clamped (any other JSON type reads as absent/false —
     # it steers only whether a mesh restore is ATTEMPTED, which is
     # further gated on local topology state)

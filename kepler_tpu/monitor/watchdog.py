@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import logging
 import time as _time
-from typing import Callable
+from typing import Any, Callable
 
 from kepler_tpu import telemetry
 from kepler_tpu.monitor.monitor import PowerMonitor
@@ -38,11 +38,16 @@ class MonitorWatchdog:
         stall_after: float | None = None,
         check_every: float | None = None,
         monotonic: Callable[[], float] | None = None,
+        journal: Any = None,
     ) -> None:
         """``interval`` is the monitor's refresh interval; ``stall_after``
         defaults to 3 intervals (the ISSUE's convergence budget),
-        ``check_every`` to one interval."""
+        ``check_every`` to one interval. ``journal`` is an optional
+        fleet black-box :class:`~kepler_tpu.fleet.journal.EventJournal`
+        — passed as an INSTANCE (never imported here) so the monitor
+        binary stays jax-free when the journal is off."""
         self._monitor = monitor
+        self._journal = journal
         self._interval = max(interval, 1e-3)
         self._stall_after = (stall_after if stall_after is not None
                              else 3.0 * self._interval)
@@ -105,6 +110,14 @@ class MonitorWatchdog:
                           "stale%s", self._age(), self._stall_after,
                           f" (stuck in {self._stuck_stage})"
                           if self._stuck_stage else "")
+                if self._journal is not None:
+                    # black box: FIRST detection only — the per-check
+                    # repeat while still stalled is not a new event
+                    self._journal.emit(
+                        "watchdog.stall",
+                        age_s=round(self._age(), 3),
+                        threshold_s=round(self._stall_after, 3),
+                        stuck_stage=self._stuck_stage)
             self._monitor.mark_stalled(True)
         return stalled
 

@@ -82,6 +82,11 @@ class DebugService:
             "stats (aggregator role)</li>"
             '<li><a href="/debug/fleet">fleet</a> — per-node scoreboard '
             "(aggregator role)</li>"
+            '<li><a href="/debug/journal">journal</a> — fleet black box: '
+            "HLC-stamped causal event journal (?since=&lt;cursor&gt; "
+            "paginates)</li>"
+            '<li><a href="/debug/bundle">bundle</a> — one-shot incident '
+            "snapshot (feed to python -m kepler_tpu.blackbox)</li>"
             "</ul></body></html>"
         ).encode()
         return 200, {"Content-Type": "text/html"}, body

@@ -1,0 +1,228 @@
+"""Blackbox reader: merge, findings, renders, CLI determinism.
+
+The reconstruction contract: same input journals (in any order, via any
+source shape) → the same causally-ordered timeline → the same SHA-256.
+The findings scan must name split-brain and flap patterns without wall
+clock reads, so every test here is exact, not approximate.
+"""
+
+import json
+
+import pytest
+
+from kepler_tpu.blackbox import (
+    SCHEMA,
+    analyze,
+    chrome_trace,
+    load_source,
+    merge_events,
+    render_text,
+    timeline_sha256,
+)
+from kepler_tpu.blackbox.__main__ import main as blackbox_main
+from kepler_tpu.fleet.journal import EventJournal
+
+
+def ev(phys_us, logical, node, kind, **fields):
+    return {"hlc": {"phys_us": phys_us, "logical": logical,
+                    "node": node},
+            "kind": kind, "fields": fields}
+
+
+class TestMerge:
+    def test_orders_across_journals_by_hlc(self):
+        a = [ev(3_000_000, 0, "r1", "rung.transition", rung=1),
+             ev(1_000_000, 0, "r1", "lease.adopt", holder="r1")]
+        b = [ev(2_000_000, 0, "r2", "membership.apply", epoch=2)]
+        merged = merge_events([a, b])
+        assert [e["kind"] for e in merged] == [
+            "lease.adopt", "membership.apply", "rung.transition"]
+
+    def test_ties_break_on_logical_then_node(self):
+        merged = merge_events([[ev(1, 1, "a", "breaker.open"),
+                                ev(1, 0, "b", "breaker.close"),
+                                ev(1, 0, "a", "lease.adopt")]])
+        assert [(e["hlc"]["logical"], e["hlc"]["node"])
+                for e in merged] == [(0, "a"), (0, "b"), (1, "a")]
+
+    def test_dedupes_same_event_from_two_sources(self):
+        e = ev(1_000_000, 0, "r1", "lease.adopt", holder="r1")
+        merged = merge_events([[e], [dict(e)]])
+        assert len(merged) == 1
+
+    def test_skips_stampless_garbage(self):
+        merged = merge_events([[{"kind": "x"}, "nope",
+                                ev(1, 0, "r1", "lease.adopt")]])
+        assert len(merged) == 1
+
+
+class TestLoadSource:
+    def test_bare_list_bundle_journal_and_kepj(self, tmp_path):
+        events = [ev(1_000_000, 0, "r1", "lease.adopt", holder="r1")]
+        bare = tmp_path / "bare.json"
+        bare.write_text(json.dumps(events))
+        bundle = tmp_path / "bundle.json"
+        bundle.write_text(json.dumps({"schema": "kepler-bundle/v1",
+                                      "journal": events}))
+        dump = tmp_path / "journal.json"
+        dump.write_text(json.dumps({"node": "r1", "events": events}))
+        jnl = EventJournal(enabled=True, node="r1", dir=str(tmp_path),
+                           clock=lambda: 1.0)
+        jnl.emit("lease.adopt", holder="r1")
+        jnl.close()
+        kepj = next(tmp_path.glob("*.kepj"))
+        for path in (bare, bundle, dump, kepj):
+            [journal] = load_source(str(path))
+            assert journal[0]["kind"] == "lease.adopt", path
+
+    def test_unrecognized_shape_raises(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"what": "ever"}')
+        with pytest.raises(ValueError, match="not a bundle"):
+            load_source(str(bad))
+
+
+class TestAnalyze:
+    def test_clean_timeline_has_no_findings(self):
+        merged = [ev(1_000_000, 0, "r1", "lease.adopt",
+                     holder="r1", epoch=2),
+                  ev(2_000_000, 0, "r2", "lease.adopt",
+                     holder="r1", epoch=2),
+                  ev(3_000_000, 0, "r1", "membership.apply",
+                     epoch=3, peers=["r1", "r2"]),
+                  ev(4_000_000, 0, "r2", "membership.apply",
+                     epoch=3, peers=["r2", "r1"])]    # order-insensitive
+        assert analyze(merged) == []
+
+    def test_split_brain_lease(self):
+        merged = [ev(1_000_000, 0, "r1", "lease.adopt",
+                     holder="r1", epoch=5),
+                  ev(1_500_000, 0, "r2", "lease.adopt",
+                     holder="r2", epoch=5)]
+        [finding] = analyze(merged)
+        assert finding["finding"] == "split_brain_lease"
+        assert finding["epoch"] == 5
+        assert finding["holders"] == {"r1": "r1", "r2": "r2"}
+
+    def test_split_brain_membership(self):
+        merged = [ev(1_000_000, 0, "r1", "membership.apply",
+                     epoch=4, peers=["r1"]),
+                  ev(1_100_000, 0, "r2", "membership.apply",
+                     epoch=4, peers=["r1", "r2"])]
+        [finding] = analyze(merged)
+        assert finding["finding"] == "split_brain_membership"
+
+    def test_breaker_flap_inside_window(self):
+        merged = [ev(i * 1_000_000, 0, "agent-1",
+                     "breaker.open" if i % 2 else "breaker.close")
+                  for i in range(4)]
+        [finding] = analyze(merged)
+        assert finding["finding"] == "breaker_flap"
+        assert finding["node"] == "agent-1"
+
+    def test_slow_breaker_cycle_is_not_a_flap(self):
+        merged = [ev(i * 200_000_000, 0, "agent-1",
+                     "breaker.open" if i % 2 else "breaker.close")
+                  for i in range(6)]
+        assert analyze(merged) == []
+
+    def test_rung_flap(self):
+        merged = [ev(i * 2_000_000, 0, "r1", "rung.transition",
+                     rung=i % 2) for i in range(5)]
+        findings = [f["finding"] for f in analyze(merged)]
+        assert findings == ["rung_flap"]
+
+
+class TestRenders:
+    MERGED = [ev(10_000_000, 0, "r1", "lease.adopt",
+                 holder="r1", epoch=2),
+              ev(11_000_000, 1, "r2", "membership.apply",
+                 epoch=3, peers=["r1"])]
+
+    def test_text_render(self):
+        text = render_text(self.MERGED, analyze(self.MERGED))
+        lines = text.splitlines()
+        assert "[r1] lease.adopt epoch=2 holder=r1" in lines[0]
+        assert lines[0].startswith("+     0.000s")
+        assert lines[1].startswith("+     1.000s")
+        assert "-- 2 events, 0 findings" in text
+
+    def test_text_render_lists_findings(self):
+        merged = [ev(1_000_000, 0, "r1", "lease.adopt",
+                     holder="r1", epoch=5),
+                  ev(1_500_000, 0, "r2", "lease.adopt",
+                     holder="r2", epoch=5)]
+        text = render_text(merged, analyze(merged))
+        assert "!! split_brain_lease" in text
+
+    def test_chrome_trace_shape(self):
+        doc = chrome_trace(self.MERGED)
+        events = doc["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        inst = [e for e in events if e["ph"] == "i"]
+        assert {m["args"]["name"] for m in meta} == {"r1", "r2"}
+        assert all(e["s"] == "p" for e in inst)
+        assert [e["ts"] for e in inst] == [10_000_000, 11_000_000]
+        # one track per node
+        assert len({m["pid"] for m in meta}) == 2
+
+    def test_sha_is_deterministic_and_sensitive(self):
+        findings = analyze(self.MERGED)
+        assert (timeline_sha256(self.MERGED, findings)
+                == timeline_sha256(list(self.MERGED), list(findings)))
+        mutated = [dict(self.MERGED[0], kind="breaker.open"),
+                   self.MERGED[1]]
+        assert (timeline_sha256(mutated, findings)
+                != timeline_sha256(self.MERGED, findings))
+
+
+class TestCli:
+    def write_sources(self, tmp_path):
+        a = tmp_path / "r1.json"
+        a.write_text(json.dumps({"events": [
+            ev(2_000_000, 0, "r1", "membership.apply",
+               epoch=3, peers=["r1"]),
+            ev(1_000_000, 0, "r1", "lease.adopt",
+               holder="r1", epoch=2)]}))
+        b = tmp_path / "r2.json"
+        b.write_text(json.dumps({"journal": [
+            ev(1_500_000, 0, "r2", "rung.transition", rung=1)]}))
+        return a, b
+
+    def test_text_output_is_merged_timeline(self, tmp_path, capsys):
+        a, b = self.write_sources(tmp_path)
+        assert blackbox_main([str(a), str(b)]) == 0
+        out = capsys.readouterr().out
+        kinds = [line.split("] ")[1].split()[0]
+                 for line in out.splitlines() if line.startswith("+")]
+        assert kinds == ["lease.adopt", "rung.transition",
+                         "membership.apply"]
+
+    def test_json_output_is_canonical(self, tmp_path, capsys):
+        a, b = self.write_sources(tmp_path)
+        assert blackbox_main([str(a), str(b), "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["schema"] == SCHEMA
+        assert len(doc["events"]) == 3
+        assert doc["findings"] == []
+
+    def test_sha_is_source_order_invariant(self, tmp_path, capsys):
+        a, b = self.write_sources(tmp_path)
+        assert blackbox_main([str(a), str(b), "--sha"]) == 0
+        first = capsys.readouterr().out.strip()
+        assert blackbox_main([str(b), str(a), "--sha"]) == 0
+        assert capsys.readouterr().out.strip() == first
+        assert len(first) == 64
+
+    def test_trace_output_loads_as_json(self, tmp_path, capsys):
+        a, b = self.write_sources(tmp_path)
+        assert blackbox_main([str(a), "--format", "trace"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["displayTimeUnit"] == "ms"
+        assert any(e["ph"] == "i" for e in doc["traceEvents"])
+
+    def test_bad_source_is_error_not_traceback(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"nope": 1}')
+        assert blackbox_main([str(bad)]) == 1
+        assert "error:" in capsys.readouterr().err

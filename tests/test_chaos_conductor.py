@@ -22,8 +22,8 @@ import pytest
 
 from kepler_tpu.chaos.invariants import (
     MembershipView, RowRecord, RunRecord, WindowRecord, check_all,
-    check_conservation, check_convergence, check_ladder,
-    check_no_duplicates, check_no_fabricated_loss)
+    check_conservation, check_convergence, check_journal_vs_schedule,
+    check_ladder, check_no_duplicates, check_no_fabricated_loss)
 from kepler_tpu.chaos.schedule import (
     FAULT_POOL, LADDER_SITES, MAX_LADDER_EVENTS, ChaosEvent, Schedule,
     compile_fault_specs, ddmin, generate)
@@ -274,6 +274,110 @@ class TestInvariantTeeth:
         out = check_convergence(clean_record(
             membership={}, alive=frozenset()))
         assert any("no live member" in v.detail for v in out)
+
+
+def jev(phys_us: int, logical: int, node: str, kind: str,
+        **fields) -> dict:
+    return {"hlc": {"phys_us": phys_us, "logical": logical,
+                    "node": node},
+            "kind": kind, "fields": fields}
+
+
+class TestJournalInvariantTeeth:
+    """Invariant 6 (journal vs schedule): every checker path fires on a
+    hand-built lying journal and stays quiet on an honest one."""
+
+    KILL = {"op": "kill", "peer": R2, "t_us": 1_000_000,
+            "epoch_before": 2}
+
+    def witness(self, phys_us: int = 1_000_000) -> dict:
+        # the survivors' succession apply: R2 gone, epoch advanced
+        return jev(phys_us, 0, R1, "membership.apply",
+                   epoch=3, peers=[R1], source="succession")
+
+    def test_honest_journal_passes(self):
+        rec = clean_record(
+            journals={f"{R1}#0": [jev(500_000, 0, R1, "lease.adopt",
+                                      holder=R1, epoch=2),
+                                  self.witness()]},
+            schedule_ops=[dict(self.KILL)])
+        assert check_journal_vs_schedule(rec) == []
+        assert check_all(rec) == []
+
+    def test_missing_witness_fires(self):
+        # an apply that still NAMES the killed peer is not a witness
+        rec = clean_record(
+            journals={f"{R1}#0": [jev(1_000_000, 0, R1,
+                                      "membership.apply", epoch=3,
+                                      peers=[R1, R2])]},
+            schedule_ops=[dict(self.KILL)])
+        out = check_journal_vs_schedule(rec)
+        assert any("no witnessing event" in v.detail for v in out)
+
+    def test_epoch_not_advanced_is_no_witness(self):
+        rec = clean_record(
+            journals={f"{R1}#0": [jev(1_000_000, 0, R1,
+                                      "membership.apply", epoch=2,
+                                      peers=[R1])]},
+            schedule_ops=[dict(self.KILL)])
+        out = check_journal_vs_schedule(rec)
+        assert any("no witnessing event" in v.detail for v in out)
+
+    def test_empty_journal_with_ops_fires(self):
+        out = check_journal_vs_schedule(clean_record(
+            journals={}, schedule_ops=[dict(self.KILL)]))
+        assert any("merged journal is empty" in v.detail for v in out)
+
+    def test_non_monotonic_hlc_fires(self):
+        rec = clean_record(
+            journals={f"{R1}#0": [self.witness(2_000_000),
+                                  jev(1_500_000, 0, R1, "lease.adopt",
+                                      holder=R1, epoch=3)]},
+            schedule_ops=[])
+        out = check_journal_vs_schedule(rec)
+        assert any("strictly HLC-increasing" in v.detail for v in out)
+        # equal stamps are a violation too (strict order)
+        rec = clean_record(
+            journals={f"{R1}#0": [self.witness(), self.witness()]})
+        out = check_journal_vs_schedule(rec)
+        assert any("strictly HLC-increasing" in v.detail for v in out)
+
+    def test_witness_predating_its_cause_fires(self):
+        # conductor says the kill happened at t=1s; the only witness
+        # claims an earlier physical time — the journal is lying
+        rec = clean_record(
+            journals={f"{R1}#0": [self.witness(900_000)]},
+            schedule_ops=[dict(self.KILL)])
+        out = check_journal_vs_schedule(rec)
+        assert any("before the op's virtual time" in v.detail
+                   for v in out)
+
+    def test_autoscale_evidence_requires_epoch_bump(self):
+        op = {"op": "autoscale", "peer": "", "t_us": 1_000_000,
+              "epoch_before": 2}
+        stale = clean_record(
+            journals={f"{R1}#0": [jev(1_000_000, 0, R1,
+                                      "autoscale.enact", epoch=2,
+                                      direction="up")]},
+            schedule_ops=[dict(op)])
+        assert any("no witnessing event" in v.detail
+                   for v in check_journal_vs_schedule(stale))
+        good = clean_record(
+            journals={f"{R1}#0": [jev(1_000_000, 0, R1,
+                                      "autoscale.enact", epoch=3,
+                                      direction="up")]},
+            schedule_ops=[dict(op)])
+        assert check_journal_vs_schedule(good) == []
+
+    def test_restart_witnessed_by_inclusive_apply(self):
+        op = {"op": "restart", "peer": R2, "t_us": 1_000_000,
+              "epoch_before": 3}
+        rec = clean_record(
+            journals={f"{R2}#1": [jev(1_000_000, 1, R2,
+                                      "membership.apply", epoch=4,
+                                      peers=[R1, R2], source="join")]},
+            schedule_ops=[dict(op)])
+        assert check_journal_vs_schedule(rec) == []
 
 
 # -- conductor runs (real fleet, virtual clock) ------------------------------

@@ -523,6 +523,20 @@ FIELD_MATRIX = [
               "service: {restartBackoffInitial: 250ms}", 0.25),
     FieldCase("service.restart_backoff_max",
               "service: {restartBackoffMax: 10s}", 10.0),
+    # fleet black box (ISSUE 19): the enable switch has a flag; ring /
+    # spool sizing and the drift clamp are YAML-only tuning knobs
+    FieldCase("telemetry.journal.enabled",
+              "telemetry: {journal: {enabled: true}}", True,
+              ["--no-telemetry.journal.enable"], False),
+    FieldCase("telemetry.journal.ring_size",
+              "telemetry: {journal: {ringSize: 64}}", 64),
+    FieldCase("telemetry.journal.dir",
+              "telemetry: {journal: {dir: /var/lib/kepler/journal}}",
+              "/var/lib/kepler/journal"),
+    FieldCase("telemetry.journal.max_bytes",
+              "telemetry: {journal: {maxBytes: 8192}}", 8192),
+    FieldCase("aggregator.hlc_max_drift",
+              "aggregator: {hlcMaxDrift: 30s}", 30.0),
     FieldCase("fault.enabled", "fault: {enabled: true}", True),
     FieldCase("fault.seed", "fault: {seed: 42}", 42),
     FieldCase("fault.specs",
@@ -655,6 +669,7 @@ class TestYAMLSpellings:
         "ringSize": "telemetry",
         "stageBuckets": "telemetry",
         "deliveryBuckets": "telemetry",
+        "hlcMaxDrift": "aggregator",
     }
     VALUE_OF = {
         "configFile": ("/tmp/x", "/tmp/x"),
@@ -736,6 +751,7 @@ class TestYAMLSpellings:
         "ringSize": ("16", 16),
         "stageBuckets": ("[0.001, 0.1]", [0.001, 0.1]),
         "deliveryBuckets": ("[1, 60]", [1, 60]),
+        "hlcMaxDrift": ("30s", 30.0),
     }
 
     @pytest.mark.parametrize("camel", sorted(_CANONICAL_YAML_KEYS))
@@ -915,6 +931,15 @@ class TestValidationMatrix:
          lambda c: (setattr(c.fault, "enabled", True),
                     setattr(c.fault, "specs", [{"site": "bogus.site"}])),
          "unknown site"),
+        ("telemetry.journal.ringSize",
+         lambda c: setattr(c.telemetry.journal, "ring_size", 0),
+         "journal.ringSize"),
+        ("telemetry.journal.maxBytes",
+         lambda c: setattr(c.telemetry.journal, "max_bytes", 1024),
+         "journal.maxBytes"),
+        ("aggregator.hlcMaxDrift",
+         lambda c: setattr(c.aggregator, "hlc_max_drift", 0),
+         "hlcMaxDrift"),
     ]
 
     @pytest.mark.parametrize("case", CASES, ids=[c[0] for c in CASES])

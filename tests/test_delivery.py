@@ -1640,3 +1640,113 @@ agent: {spool: {fsync: sometimes, maxBytes: 0}}
         assert cfg.monitor.state_path == "/tmp/state.json"
         assert cfg.agent.spool.dir == "/tmp/spool"
         assert cfg.aggregator.dedup_window == 99
+
+
+class TestHlcHeaderCoercion:
+    """Satellite (ISSUE 19): the ``X-Kepler-HLC`` stamp is wire input —
+    hardened exactly like run/seq and the ring headers. Hostile text is
+    a 400 charged as malformed, never a 500 and NEVER a poisoned clock;
+    a *valid* but future-vaulted stamp is clamped by
+    ``aggregator.hlcMaxDrift`` (KTL112: laundered, bounded, counted)."""
+
+    @staticmethod
+    def post_with_hlc(server, body, hlc_text):
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/report", data=body, method="POST",
+            headers={"X-Kepler-HLC": hlc_text})
+        return urllib.request.urlopen(req, timeout=5)
+
+    @staticmethod
+    def make_journaled_agg(server, **kw):
+        from kepler_tpu.fleet.journal import EventJournal
+        jnl = EventJournal(enabled=True, node="agg-hlc",
+                           max_drift_s=kw.pop("max_drift_s", 60.0))
+        return make_agg(server, journal=jnl), jnl
+
+    @pytest.mark.parametrize("hostile", [
+        "garbage", "True", "1:2", "::", "-1:0:n", "1.5:0:n",
+        "1:-1:n", "1:+1:n", "999999999999999999:0:n",   # 18-digit phys
+        "1:0:" + "x" * 200,                             # overlong node
+        "1:0:a b",                                      # space in node
+    ])
+    def test_hostile_stamp_is_400_never_500(self, server, hostile):
+        agg, jnl = self.make_journaled_agg(server)
+        before = jnl.hlc.now()
+        blob = encode_report(make_report("hlc-node"),
+                             ["package", "dram"], seq=1, run="r1")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self.post_with_hlc(server, blob, hostile)
+        assert err.value.code == 400
+        assert b"X-Kepler-HLC" in err.value.read()
+        assert agg._stats["malformed_total"] == 1
+        assert "hlc-node" not in agg._reports           # nothing ingested
+        # the clock never merged the hostile stamp
+        assert jnl.hlc.clamped_total() == 0
+        assert jnl.hlc.now().phys_us - before.phys_us < 10_000_000
+
+    def test_future_vaulted_stamp_is_clamped_not_trusted(self, server):
+        agg, jnl = self.make_journaled_agg(server, max_drift_s=60.0)
+        blob = encode_report(make_report("vault"),
+                             ["package", "dram"], seq=1, run="r1")
+        vaulted = f"{10**16}:0:evil"                    # ~year 2286
+        resp = self.post_with_hlc(server, blob, vaulted)
+        assert resp.status == 204                       # valid shape: accepted
+        assert "vault" in agg._reports
+        assert jnl.hlc.clamped_total() == 1
+        # the local clock advanced by at most the drift bound
+        assert jnl.hlc.now().phys_us < time.time() * 1e6 + 61 * 1e6
+        # the hostile offset is visible for alerting
+        assert jnl.hlc.drift_seconds() > 1e6
+
+    def test_valid_stamp_merges_and_reply_carries_hlc(self, server):
+        agg, jnl = self.make_journaled_agg(server)
+        blob = encode_report(make_report("chain"),
+                             ["package", "dram"], seq=1, run="r1")
+        peer_us = int(time.time() * 1e6) + 1_000_000    # 1s ahead: legal
+        resp = self.post_with_hlc(server, blob, f"{peer_us}:3:peer-a")
+        assert resp.status == 204
+        assert jnl.hlc.clamped_total() == 0
+        assert jnl.hlc.drift_seconds() == pytest.approx(1.0, abs=0.5)
+        # accept replies piggyback this replica's stamp for the agent
+        got = resp.headers.get("X-Kepler-HLC")
+        assert got is not None
+        from kepler_tpu.telemetry.hlc import parse_hlc
+        stamp = parse_hlc(got)
+        assert stamp is not None and stamp.node == "agg-hlc"
+        assert stamp.phys_us >= peer_us                 # causally after
+        assert "chain" in agg._reports
+
+    def test_absent_header_is_fine(self, server):
+        agg, jnl = self.make_journaled_agg(server)
+        blob = encode_report(make_report("plain"),
+                             ["package", "dram"], seq=1, run="r1")
+        assert post_raw(server, blob).status == 204
+        assert agg._stats["malformed_total"] == 0
+
+    def test_disabled_journal_ignores_even_hostile_stamps(self, server):
+        """Journal off (the default): the HLC seam must cost nothing —
+        no parse, no 400, no header on the reply."""
+        agg = make_agg(server)
+        blob = encode_report(make_report("off"),
+                             ["package", "dram"], seq=1, run="r1")
+        resp = self.post_with_hlc(server, blob, "total garbage")
+        assert resp.status == 204
+        assert resp.headers.get("X-Kepler-HLC") is None
+        assert "off" in agg._reports
+
+    def test_batch_path_rejects_hostile_stamp(self, server):
+        from kepler_tpu.fleet.wire import encode_report_batch
+
+        agg, jnl = self.make_journaled_agg(server)
+        blob = encode_report_batch([
+            encode_report(make_report("b1"), ["package", "dram"],
+                          seq=1, run="r1")])
+        host, port = server.addresses[0]
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/reports", data=blob,
+            method="POST", headers={"X-Kepler-HLC": "evil"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=5)
+        assert err.value.code == 400
+        assert "b1" not in agg._reports

@@ -1,0 +1,125 @@
+"""Hybrid Logical Clock: laundering, merge semantics, drift clamp.
+
+The HLC is wire-facing (X-Kepler-HLC header, membership ``hlc`` field),
+so ``parse_hlc`` is a KTL112 laundering seam: hostile text must come
+back ``None`` — never an exception, never a poisoned stamp. The clamp
+is the clock's threat boundary: a peer claiming a far-future physical
+time advances the local clock by at most ``max_drift_s``.
+"""
+
+import pytest
+
+from kepler_tpu.telemetry.hlc import (
+    DEFAULT_MAX_DRIFT_S,
+    HLC,
+    MAX_NODE_LEN,
+    HlcClock,
+    parse_hlc,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class TestParse:
+    def test_round_trip(self):
+        stamp = HLC(1_234_567, 5, "10.0.0.1:28283")
+        assert parse_hlc(stamp.encode()) == stamp
+
+    def test_node_may_contain_colons(self):
+        # encode uses ':' separators AND node ids are host:port — the
+        # parse must split from the left, keeping the node intact
+        stamp = parse_hlc("1000:0:host:28283")
+        assert stamp == HLC(1000, 0, "host:28283")
+
+    @pytest.mark.parametrize("bad", [
+        None, True, False, 7, 1.5, b"1:2:n",       # non-strings
+        "", "1:2", "::",                            # wrong field count
+        "-1:0:n", "1.5:0:n", " 1:0:n",              # signed/float/space
+        "1:-1:n", "1:+1:n",                         # signed logical
+        "9" * 18 + ":0:n",                          # phys overlong
+        "1:" + "9" * 10 + ":n",                     # logical overlong
+        f"1:{(1 << 20) + 1}:n",                     # logical above cap
+        "1:0:" + "x" * (MAX_NODE_LEN + 1),          # node overlong
+        "1:0:a b",                                  # space in node
+        "1:0:a\x00b",                               # control char
+    ])
+    def test_hostile_input_is_none(self, bad):
+        assert parse_hlc(bad) is None
+
+    def test_boundary_values_accepted(self):
+        assert parse_hlc("9" * 17 + ":0:n") is not None
+        assert parse_hlc(f"1:{1 << 20}:n") is not None
+        assert parse_hlc("1:0:" + "x" * MAX_NODE_LEN) is not None
+
+
+class TestOrdering:
+    def test_tuple_order_is_total(self):
+        a = HLC(1, 0, "a")
+        assert a < HLC(2, 0, "a") < HLC(2, 1, "a") < HLC(2, 1, "b")
+
+
+class TestClock:
+    def test_now_advances_with_wall(self):
+        clk = FakeClock(1.0)
+        hlc = HlcClock("n1", clock=clk)
+        first = hlc.now()
+        clk.t = 2.0
+        second = hlc.now()
+        assert second > first
+        assert second == HLC(2_000_000, 0, "n1")
+
+    def test_stalled_wall_bumps_logical(self):
+        hlc = HlcClock("n1", clock=FakeClock(1.0))
+        stamps = [hlc.now() for _ in range(3)]
+        assert stamps == sorted(stamps)
+        assert [s.logical for s in stamps] == [0, 1, 2]
+        assert len(set(stamps)) == 3
+
+    def test_observe_remote_ahead_within_drift(self):
+        hlc = HlcClock("n1", clock=FakeClock(1.0))
+        merged = hlc.observe(HLC(5_000_000, 3, "n2"))
+        # adopts the remote physical time, logical one past the remote
+        assert merged == HLC(5_000_000, 4, "n1")
+        assert hlc.clamped_total() == 0
+        assert hlc.drift_seconds() == pytest.approx(4.0)
+
+    def test_observe_remote_behind_keeps_local(self):
+        clk = FakeClock(10.0)
+        hlc = HlcClock("n1", clock=clk)
+        hlc.now()
+        merged = hlc.observe(HLC(1_000_000, 9, "n2"))
+        assert merged.phys_us == 10_000_000
+        assert hlc.drift_seconds() == pytest.approx(-9.0)
+
+    def test_local_never_runs_backwards_after_observe(self):
+        clk = FakeClock(1.0)
+        hlc = HlcClock("n1", clock=clk)
+        high = hlc.observe(HLC(30_000_000, 0, "n2"))
+        nxt = hlc.now()
+        assert nxt > high
+        assert nxt.phys_us == 30_000_000      # wall still behind: ties
+
+    def test_drift_clamp_bounds_a_vaulted_peer(self):
+        clk = FakeClock(1.0)
+        hlc = HlcClock("n1", clock=clk, max_drift_s=60.0)
+        vaulted = HLC(10**15, 0, "evil")
+        merged = hlc.observe(vaulted)
+        limit_us = 1_000_000 + 60 * 1_000_000
+        assert merged.phys_us == limit_us
+        assert hlc.clamped_total() == 1
+        # the recorded drift names the hostile offset (alerting signal)
+        assert hlc.drift_seconds() == pytest.approx((10**15 - 1e6) / 1e6)
+        # repeated vaults stay pinned at the advancing limit
+        clk.t = 2.0
+        again = hlc.observe(vaulted)
+        assert again.phys_us == 2_000_000 + 60 * 1_000_000
+        assert hlc.clamped_total() == 2
+
+    def test_default_drift_is_60s(self):
+        assert DEFAULT_MAX_DRIFT_S == 60.0

@@ -1,0 +1,280 @@
+"""Fleet black-box journal: chokepoint, ring, spool, surfaces, cost.
+
+Covers the ``EventJournal`` contract (closed kind registry, bounded
+ring, durable CRC-framed spool with torn-tail recovery), the module
+install plumbing both binaries use, the ``/debug/journal`` handler's
+wire hygiene (bad cursors are 400s, never 500s), the Prometheus
+families, and the disabled-path cost pin — the same < 1 µs/event
+contract ``telemetry.span`` holds.
+"""
+
+import json
+import time
+
+import pytest
+
+from kepler_tpu.fleet import journal as journal_mod
+from kepler_tpu.fleet.journal import (
+    KNOWN_KINDS,
+    EventJournal,
+    canonical_json,
+    install_from_config,
+    installed,
+    make_journal_handler,
+    read_frames,
+)
+from kepler_tpu.telemetry.hlc import HLC
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        self.t += 0.001
+        return self.t
+
+
+def make_journal(**kw) -> EventJournal:
+    kw.setdefault("enabled", True)
+    kw.setdefault("node", "r1")
+    kw.setdefault("clock", FakeClock())
+    return EventJournal(**kw)
+
+
+class _Req:
+    command = "GET"
+
+    def __init__(self, path: str = "/debug/journal") -> None:
+        self.path = path
+
+
+class TestChokepoint:
+    def test_disabled_is_inert(self):
+        jnl = EventJournal(enabled=False, node="r1")
+        assert jnl.emit("lease.adopt", holder="x") is None
+        assert jnl.header() is None
+        assert jnl.observe(HLC(1, 0, "n")) is None
+        assert jnl.snapshot() == []
+        # disabled + hostile text: True (nothing to poison, no 400)
+        assert jnl.observe_text("garbage") is True
+
+    def test_emit_returns_stamp_and_records(self):
+        jnl = make_journal()
+        stamp = jnl.emit("lease.adopt", holder="r1", epoch=3)
+        assert stamp is not None and stamp.node == "r1"
+        [entry] = jnl.snapshot()
+        assert entry["kind"] == "lease.adopt"
+        assert entry["fields"] == {"holder": "r1", "epoch": 3}
+        assert entry["hlc"] == stamp.to_dict()
+        assert jnl.counts()["lease.adopt"] == 1
+
+    def test_unknown_kind_raises(self):
+        jnl = make_journal()
+        with pytest.raises(ValueError, match="not in KIND_CATALOG"):
+            jnl.emit("not.a.kind")
+
+    def test_ring_is_bounded(self):
+        jnl = make_journal(ring_size=4)
+        for i in range(10):
+            jnl.emit("rung.transition", rung=i)
+        entries = jnl.snapshot()
+        assert len(entries) == 4
+        assert [e["fields"]["rung"] for e in entries] == [6, 7, 8, 9]
+        assert jnl.counts()["rung.transition"] == 10   # counts survive
+
+    def test_snapshot_cursor_is_strictly_after(self):
+        jnl = make_journal()
+        stamps = [jnl.emit("rung.transition", rung=i) for i in range(5)]
+        after = jnl.snapshot(since=stamps[2])
+        assert [e["fields"]["rung"] for e in after] == [3, 4]
+        assert jnl.snapshot(since=stamps[-1]) == []
+        assert len(jnl.snapshot(limit=2)) == 2
+
+    def test_observe_text_launders(self):
+        jnl = make_journal()
+        assert jnl.observe_text(None) is True          # absent: fine
+        assert jnl.observe_text("5000000:1:peer") is True
+        assert jnl.observe_text("gibberish") is False  # present+hostile
+        assert jnl.observe_text(True) is False
+
+
+class TestSpool:
+    def test_round_trip(self, tmp_path):
+        jnl = make_journal(dir=str(tmp_path))
+        jnl.emit("breaker.open", target="agg", failures=3)
+        jnl.emit("breaker.close", target="agg", failures=0)
+        jnl.close()
+        files = list(tmp_path.glob("*.kepj"))
+        assert len(files) == 1
+        entries = read_frames(str(files[0]))
+        assert [e["kind"] for e in entries] == ["breaker.open",
+                                               "breaker.close"]
+        assert entries[0]["fields"]["failures"] == 3
+
+    def test_torn_tail_reads_clean_prefix(self, tmp_path):
+        jnl = make_journal(dir=str(tmp_path))
+        for i in range(4):
+            jnl.emit("rung.transition", rung=i)
+        jnl.close()
+        path = next(tmp_path.glob("*.kepj"))
+        data = path.read_bytes()
+        path.write_bytes(data[:-7])     # kill -9 mid-append
+        entries = read_frames(str(path))
+        assert [e["fields"]["rung"] for e in entries] == [0, 1, 2]
+
+    def test_rotation_caps_disk(self, tmp_path):
+        jnl = make_journal(dir=str(tmp_path), max_bytes=4096)
+        for i in range(100):
+            jnl.emit("rung.transition", rung=i, pad="x" * 64)
+        jnl.close()
+        main = next(tmp_path.glob("*.kepj"))
+        rotated = tmp_path / (main.name + ".1")
+        assert rotated.exists()
+        assert main.stat().st_size <= 4096
+        assert rotated.stat().st_size <= 4096
+        assert jnl.stats()["write_errors"] == 0
+
+    def test_unwritable_dir_degrades_to_ring(self, tmp_path):
+        target = tmp_path / "nope"
+        target.touch()                  # a FILE where a dir must go
+        jnl = make_journal(dir=str(target))
+        assert jnl.emit("lease.adopt", holder="r1") is not None
+        assert len(jnl.snapshot()) == 1
+        assert jnl.stats()["write_errors"] == 1
+
+
+class TestModulePlumbing:
+    def test_default_active_is_disabled(self):
+        assert journal_mod.active().enabled is False
+        assert journal_mod.emit("lease.adopt", holder="x") is None
+
+    def test_installed_restores(self):
+        jnl = make_journal()
+        with installed(jnl):
+            assert journal_mod.active() is jnl
+            assert journal_mod.emit("lease.adopt", holder="r1")
+        assert journal_mod.active() is not jnl
+        assert jnl.counts()["lease.adopt"] == 1
+
+    def test_install_from_config(self, tmp_path):
+        from kepler_tpu.config.config import TelemetryConfig
+
+        cfg = TelemetryConfig()
+        cfg.journal.enabled = True
+        cfg.journal.ring_size = 7
+        cfg.journal.dir = str(tmp_path)
+        prev = journal_mod.active()
+        try:
+            jnl = install_from_config(cfg, node="n1", max_drift_s=5.0)
+            assert journal_mod.active() is jnl
+            assert jnl.enabled and jnl.node == "n1"
+            assert jnl._ring.maxlen == 7
+            jnl.emit("watchdog.stall", age_s=9.0)
+            jnl.close()
+            assert list(tmp_path.glob("*.kepj"))
+        finally:
+            journal_mod.install(prev)
+
+    def test_collector_follows_installed(self):
+        coll = journal_mod.collector()
+        jnl = make_journal()
+        jnl.emit("lease.adopt", holder="r1")
+        with installed(jnl):
+            fams = {f.name for f in coll.collect()}
+        assert "kepler_fleet_journal_events" in fams
+        assert "kepler_fleet_hlc_drift_seconds" in fams
+        assert "kepler_fleet_hlc_clamped" in fams
+
+
+class TestMetrics:
+    def test_events_family_is_zero_filled(self):
+        jnl = make_journal()
+        jnl.emit("breaker.open", target="a", failures=1)
+        fams = list(jnl.collect())
+        events = next(f for f in fams
+                      if f.name == "kepler_fleet_journal_events")
+        by_kind = {s.labels["kind"]: s.value for s in events.samples
+                   if s.name.endswith("_total")}
+        assert set(by_kind) == set(KNOWN_KINDS)
+        assert by_kind["breaker.open"] == 1
+        assert by_kind["lease.adopt"] == 0
+
+    def test_drift_and_clamp_families(self):
+        jnl = make_journal(max_drift_s=1.0)
+        jnl.observe_text(f"{10**15}:0:evil")
+        fams = {f.name: f for f in jnl.collect()}
+        assert fams["kepler_fleet_hlc_clamped"].samples[0].value == 1
+        assert fams["kepler_fleet_hlc_drift_seconds"].samples[0].value > 0
+
+
+class TestHandler:
+    def test_basic_page_shape(self):
+        jnl = make_journal()
+        jnl.emit("lease.adopt", holder="r1", epoch=2)
+        status, headers, body = make_journal_handler(jnl)(_Req())
+        assert status == 200
+        assert headers["Content-Type"] == "application/json"
+        doc = json.loads(body)
+        assert doc["node"] == "r1" and doc["enabled"] is True
+        assert [e["kind"] for e in doc["events"]] == ["lease.adopt"]
+        assert doc["cursor"]
+        assert doc["stats"]["events_total"] == 1
+
+    def test_cursor_pagination_walks_everything(self):
+        jnl = make_journal()
+        for i in range(7):
+            jnl.emit("rung.transition", rung=i)
+        handler = make_journal_handler(jnl)
+        seen, cursor = [], ""
+        for _ in range(10):
+            path = "/debug/journal?limit=3"
+            if cursor:
+                path += f"&since={cursor}"
+            _, _, body = handler(_Req(path))
+            doc = json.loads(body)
+            if not doc["events"]:
+                break
+            seen.extend(e["fields"]["rung"] for e in doc["events"])
+            cursor = doc["cursor"]
+        assert seen == list(range(7))
+
+    @pytest.mark.parametrize("path", [
+        "/debug/journal?since=garbage",
+        "/debug/journal?since=True",
+        "/debug/journal?since=-1:0:n",
+        "/debug/journal?limit=bananas",
+    ])
+    def test_bad_query_is_400_never_500(self, path):
+        handler = make_journal_handler(make_journal())
+        status, _, body = handler(_Req(path))
+        assert status == 400
+        assert b"error" in body
+
+    def test_handler_follows_installed_when_unbound(self):
+        jnl = make_journal()
+        jnl.emit("lease.adopt", holder="r1")
+        with installed(jnl):
+            _, _, body = make_journal_handler()(_Req())
+        assert json.loads(body)["stats"]["events_total"] == 1
+
+
+class TestCanonicalJson:
+    def test_sorted_and_compact(self):
+        assert canonical_json({"b": 1, "a": [1, 2]}) == b'{"a":[1,2],"b":1}'
+
+
+class TestDisabledCost:
+    def test_disabled_emit_under_1us(self):
+        """Same contract as the disabled telemetry.span pin: the journal
+        is OFF by default, so every emission point in ingest/send paths
+        must cost one global read + one attribute check."""
+        assert journal_mod.active().enabled is False
+        n = 3000
+        best = float("inf")
+        for _ in range(7):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                journal_mod.emit("lease.adopt", holder="x", epoch=1)
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, f"disabled emit cost {best * 1e9:.0f}ns/call"

@@ -1,0 +1,138 @@
+"""Journal-kind fence: the black-box registry and the code never drift.
+
+``fleet.journal.KIND_CATALOG`` is the single source of truth for
+black-box event kinds — the docs table (hack/gen_journal_docs.py) and
+emit-time validation derive from it. This module walks the package's
+AST for literal ``journal.emit("...")`` / ``self._journal.emit("...")``
+call sites (the chokepoint receivers) and pins the fence in BOTH
+directions:
+
+- every emitted kind is cataloged (an uncataloged kind would be
+  invisible to docs and to the zero-filled metric family), and
+- every cataloged kind is actually emitted somewhere (a dead catalog
+  entry documents a transition that is no longer journaled).
+
+Mirrors tests/test_fault_fence.py for ``fault.SITE_CATALOG``.
+"""
+
+import ast
+import importlib.util
+import os
+import pathlib
+
+from kepler_tpu.fleet.journal import KIND_CATALOG, KNOWN_KINDS
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "kepler_tpu"
+
+# receivers that ARE the chokepoint: the module-level forwarder
+# (``journal.emit``) and an injected EventJournal instance
+# (``self._journal.emit`` / ``_journal.emit``)
+_RECEIVERS = frozenset({"journal", "_journal"})
+
+
+def load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_journal_docs",
+        os.path.join(REPO, "hack", "gen_journal_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _receiver_name(fn: ast.expr) -> str:
+    """Terminal name of an ``<recv>.emit`` receiver: ``journal.emit``
+    -> "journal", ``self._journal.emit`` -> "_journal"."""
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def emitted_kinds() -> dict[str, list[str]]:
+    """kind -> ["relpath:lineno", ...] for every literal emit("...")
+    through a journal receiver in the package (journal.py itself is
+    the chokepoint, not an emit site)."""
+    kinds: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        if path == PKG / "fleet" / "journal.py":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
+                continue
+            if _receiver_name(fn.value) not in _RECEIVERS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                where = f"{path.relative_to(REPO)}:{node.lineno}"
+                kinds.setdefault(arg.value, []).append(where)
+    return kinds
+
+
+class TestKindFence:
+    def test_every_emitted_kind_is_cataloged(self):
+        known = set(KNOWN_KINDS)
+        rogue = {k: w for k, w in emitted_kinds().items()
+                 if k not in known}
+        assert not rogue, (
+            f"journal emit sites not in journal.KIND_CATALOG: {rogue} — "
+            "add them to kepler_tpu/fleet/journal.py (and run "
+            "python hack/gen_journal_docs.py)")
+
+    def test_every_cataloged_kind_is_emitted(self):
+        emitted = set(emitted_kinds())
+        dead = [k for k in KNOWN_KINDS if k not in emitted]
+        assert not dead, (
+            f"KIND_CATALOG entries with no emit() call site: {dead} — "
+            "the transition is no longer journaled; retire the row")
+
+    def test_catalog_is_well_formed(self):
+        kinds = [k for k, _, _ in KIND_CATALOG]
+        assert kinds == sorted(kinds), (
+            f"KIND_CATALOG must stay sorted by kind: {kinds}")
+        assert len(kinds) == len(set(kinds)), (
+            f"duplicate catalog kinds: {kinds}")
+        for kind, layer, desc in KIND_CATALOG:
+            assert "." in kind, kind
+            assert layer.strip(), f"{kind}: empty layer"
+            assert desc.strip(), f"{kind}: empty description"
+        assert tuple(kinds) == KNOWN_KINDS
+
+    def test_uncataloged_kind_raises_at_emit(self):
+        import pytest
+
+        from kepler_tpu.fleet.journal import EventJournal
+
+        jnl = EventJournal(enabled=True, node="t", clock=lambda: 1.0)
+        with pytest.raises(ValueError, match="not in KIND_CATALOG"):
+            jnl.emit("definitely.not.a.kind")
+
+
+class TestGenJournalDocs:
+    def test_doc_is_fresh(self):
+        gen = load_generator()
+        current = gen.DOC.read_text()
+        assert gen.updated_doc(current) == current, (
+            "docs/developer/observability.md journal-kind table is "
+            "stale; run: python hack/gen_journal_docs.py")
+
+    def test_every_kind_has_a_table_row(self):
+        gen = load_generator()
+        block = gen.render()
+        for kind in KNOWN_KINDS:
+            assert f"| `{kind}` |" in block
+
+    def test_missing_markers_fail_loudly(self):
+        gen = load_generator()
+        try:
+            gen.updated_doc("no markers here")
+        except SystemExit as err:
+            assert "marker block not found" in str(err)
+        else:
+            raise AssertionError("marker-less doc did not fail")
