@@ -77,12 +77,14 @@ DETAIL_PATH = os.environ.get("KEPLER_BENCH_DETAIL_PATH",
 GATE_KEYS = ("accuracy_ok", "e2e_pipeline_ok", "soak_ok",
              "aggwin_within_budget", "aggwin_pipeline_ok",
              "aggwin_sharded_ok", "aggwin_multihost_ok",
+             "aggwin_fused_ok",
              "node_scrape_ok", "ingest_ok", "ingest_zero_copy_ok")
 # an errored leg (subprocess died, no row, timeout) fails these gates
 LEG_ERROR_GATES = {
     "node_scrape_error": ("node_scrape_ok",),
     "aggwin_error": ("aggwin_within_budget", "aggwin_pipeline_ok",
-                     "aggwin_sharded_ok", "aggwin_multihost_ok"),
+                     "aggwin_sharded_ok", "aggwin_multihost_ok",
+                     "aggwin_fused_ok"),
     "soak_error": ("soak_ok",),
     "ingest_error": ("ingest_ok", "ingest_zero_copy_ok"),
 }
@@ -166,7 +168,43 @@ def evaluate_gates(result: dict, on_tpu: bool) -> tuple[bool, list]:
             f"{result.get('aggwin_multihost_capacity_ratio')}x "
             f"(gate >= {result.get('aggwin_multihost_capacity_budget')}x)")
         failed = True
+    if (result.get("aggwin_fused_ok") is False
+            and "aggwin_fused_ok" not in forced):
+        messages.append(
+            f"GATE: fused window loop (K="
+            f"{result.get('aggwin_fused_k')}) device leg "
+            f"{result.get('aggwin_fused_device_p50_ms')} ms is "
+            f"{result.get('aggwin_fused_ratio')}x the unfused "
+            f"{result.get('aggwin_unfused_device_p50_ms')} ms (budget "
+            f"{result.get('aggwin_fused_ratio_budget')}x) or "
+            f"bit-inconsistent "
+            f"({result.get('aggwin_fused_bit_consistent')})")
+        failed = True
     return failed, messages
+
+
+def _provenance_fields() -> dict:
+    """jax/jaxlib versions + the device the measurements actually ran
+    on. Best-effort: provenance must never fail a capture."""
+    out: dict = {}
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+        try:
+            import jaxlib
+
+            out["jaxlib_version"] = jaxlib.__version__
+        except Exception:
+            pass
+        devs = jax.devices()
+        if devs:
+            out["device_kind"] = devs[0].device_kind
+            out["device_platform"] = devs[0].platform
+            out["device_count"] = len(devs)
+    except Exception:
+        pass
+    return out
 
 
 def build_headline(result: dict, detail_path: str) -> str:
@@ -527,6 +565,10 @@ def main() -> None:
         "platform": platform,
         "backend": backend,
         "cpu_fallback": bool(os.environ.get("KEPLER_BENCH_CPU_FALLBACK")),
+        # toolchain + device provenance: perf numbers are only
+        # comparable across capture rounds when the stack that produced
+        # them is pinned in the row itself
+        **_provenance_fields(),
     }
     result.update({k: (round(v, 8) if isinstance(v, float) else v)
                    for k, v in acc_fields.items()})

@@ -241,6 +241,16 @@ AGG_PIPELINE_RATIO_BUDGET = float(os.environ.get(
 # XLA_FLAGS=--xla_force_host_platform_device_count on CPU hosts).
 AGG_SHARDED_RATIO_BUDGET = float(os.environ.get(
     "KEPLER_AGG_SHARDED_RATIO_BUDGET", "0.6"))
+# the ISSUE-20 tentpole gate: the fused device-resident window loop
+# (fusedWindowK=4, one donated lax.scan dispatch + one batched fetch
+# per 4 intervals) must cut the PER-CALL device-leg p50 to ≤ this
+# fraction of the unfused packed-pipelined path on the same seeded
+# fleet and device. K−1 of every K calls have NO device leg at all —
+# that per-call p50 collapse IS the amortization being gated (the
+# averaged per-window figure rides alongside as
+# aggwin_fused_sync_per_window_ms). A same-host ratio: gates on CPU.
+AGG_FUSED_RATIO_BUDGET = float(os.environ.get(
+    "KEPLER_AGG_FUSED_RATIO_BUDGET", "0.5"))
 # the ISSUE-15 tentpole gate: node capacity (bucket rows hosted) must
 # scale ≥ this factor from 1 host to 2 virtual hosts of the same
 # per-host device count, with published windows bit-identical to the
@@ -595,6 +605,85 @@ def _sharded_window_fields(iters: int, n_nodes: int, w: int,
     }
 
 
+def _fused_window_fields(iters: int, n_nodes: int, w: int) -> dict:
+    """The ``fused_*`` leg (ISSUE 20): the fused device-resident window
+    loop at K=4 vs the unfused packed-pipelined path, same seeded fleet
+    pinned to ONE device (same-host ratio — it gates on CPU capture
+    hosts). The fused aggregator pays its whole device leg once per K
+    ``aggregate_once`` calls (one donated ``lax.scan`` dispatch + one
+    batched K-window fetch); the other K−1 calls have NO device leg, so
+    the per-call device-leg p50 collapses — that collapse is the gated
+    ratio. The batch-averaged figure rides along as
+    ``fused_sync_per_window_ms``, and the final published windows must
+    stay bit-consistent with the unfused reference."""
+    import time
+
+    import jax
+
+    from kepler_tpu.fleet.aggregator import Aggregator
+    from kepler_tpu.parallel.mesh import make_mesh
+    from kepler_tpu.server.http import APIServer
+
+    k = 4
+    n_calls = max(100, iters) + 2
+
+    def drive(agg, warm):
+        now = time.time() + 1e9
+        dev = []
+        last = None
+        for it in range(n_calls):
+            _seed_fleet_reports(agg, n_nodes, w, seq=it + 1,
+                                received=now)
+            published = agg.aggregate_once()
+            if published is not None:
+                last = published
+            if it >= warm:
+                s = agg._stats
+                dev.append(s["last_dispatch_ms"] + s["last_wait_ms"])
+        # the drain publishes whatever is still staged/in flight, so
+        # BOTH runs' ``last`` is the final interval's window and the
+        # bit comparison is window-for-window
+        tail = agg._drain_pipeline()
+        if tail is not None:
+            last = tail
+        stats = dict(agg._stats)
+        agg.shutdown()
+        dev.sort()
+        return dev, stats, last
+
+    mesh1 = make_mesh([1], devices=jax.devices()[:1])
+    ref = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
+                     workload_bucket=128, stale_after=1e9,
+                     pipeline_depth=2)
+    ref._mesh = mesh1
+    ref_dev, _, ref_last = drive(ref, warm=2)
+
+    fused = Aggregator(APIServer(), model_mode="mlp", node_bucket=64,
+                       workload_bucket=128, stale_after=1e9,
+                       pipeline_depth=1, fused_window_k=k)
+    fused._mesh = make_mesh([1], devices=jax.devices()[:1])
+    # warm = k: the first flush (the cold lax.scan compile) stays
+    # untimed, mirroring the compile-skipping warmup of the other legs
+    fused_dev, fused_s, fused_last = drive(fused, warm=k)
+
+    fused_p50 = fused_dev[len(fused_dev) // 2]
+    ref_p50 = ref_dev[len(ref_dev) // 2]
+    ratio = fused_p50 / max(ref_p50, 1e-9)
+    bit = _windows_bit_equal(fused_last, ref_last)
+    ok = bool(bit and ratio <= AGG_FUSED_RATIO_BUDGET)
+    return {
+        "fused_k": k,
+        "fused_device_p50_ms": round(fused_p50, 3),
+        "fused_sync_per_window_ms": round(
+            float(fused_s.get("last_sync_per_window_ms", 0.0)), 3),
+        "unfused_device_p50_ms": round(ref_p50, 3),
+        "fused_ratio": round(ratio, 3),
+        "fused_ratio_budget": AGG_FUSED_RATIO_BUDGET,
+        "fused_bit_consistent": bit,
+        "fused_ok": ok,
+    }
+
+
 def _multihost_window_fields() -> dict:
     """The ``multihost_*`` leg (ISSUE 15): two VIRTUAL hosts in this
     process (half the devices each, wired through a HostLocalFabric —
@@ -737,6 +826,7 @@ def run_aggregator_window_scenario(iters: int) -> dict:
     shard_fields = _sharded_window_fields(iters, n_nodes, w, dev_ms,
                                           host_s, host_last)
     multihost_fields = _multihost_window_fields()
+    fused_fields = _fused_window_fields(iters, n_nodes, w)
 
     # introspection evidence (detail row only — headline stays core):
     # compiled window-program cost, sticky-map skew, and ladder-timeline
@@ -786,6 +876,7 @@ def run_aggregator_window_scenario(iters: int) -> dict:
             and _pctl(host_ms, 0.99) <= AGG_HOST_P99_BUDGET_MS),
         **shard_fields,
         **multihost_fields,
+        **fused_fields,
     }
 
 
@@ -853,6 +944,15 @@ def main() -> None:
                   f"{row.get('unsharded_device_p50_ms')} ms (budget "
                   f"{row.get('sharded_ratio_budget')}x), bit_consistent="
                   f"{row.get('sharded_bit_consistent')}", file=sys.stderr)
+            failed = True
+        if row.get("fused_ok") is False:
+            print(f"BUDGET VIOLATION: fused window loop (K="
+                  f"{row.get('fused_k')}) device leg "
+                  f"{row.get('fused_device_p50_ms')} ms is "
+                  f"{row.get('fused_ratio')}x the unfused "
+                  f"{row.get('unfused_device_p50_ms')} ms (budget "
+                  f"{row.get('fused_ratio_budget')}x), bit_consistent="
+                  f"{row.get('fused_bit_consistent')}", file=sys.stderr)
             failed = True
         if failed:
             sys.exit(1)
@@ -969,6 +1069,15 @@ def main() -> None:
             f"{AGG_SHARDED_RATIO_BUDGET}x on "
             f"{agg_row.get('sharded_devices')} devices), "
             f"bit_consistent={agg_row.get('sharded_bit_consistent')}")
+    if agg_row.get("fused_ok") is False:
+        failures.append(
+            f"aggregator-window: fused window loop failed its gate — "
+            f"K={agg_row.get('fused_k')} device leg "
+            f"{agg_row.get('fused_device_p50_ms')} ms is "
+            f"{agg_row.get('fused_ratio')}x the unfused "
+            f"{agg_row.get('unfused_device_p50_ms')} ms (budget "
+            f"{AGG_FUSED_RATIO_BUDGET}x), bit_consistent="
+            f"{agg_row.get('fused_bit_consistent')}")
 
     ingest_row = run_ingest_scenario(args.iters)
     ingest_row.update({"platform": platform})

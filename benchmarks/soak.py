@@ -143,6 +143,12 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                          stale_after=interval * 3,
                          model_mode=model_mode, node_bucket=64,
                          workload_bucket=128, pipeline_depth=2,
+                         # the diurnal leg soaks the fused window loop
+                         # (ISSUE 20) under live scale events: K=4
+                         # amortizes the host sync and the zero-windows-
+                         # lost gate below must still hold across every
+                         # join/leave (pending-snapshot replay included)
+                         fused_window_k=4 if diurnal else 1,
                          # the diurnal gate reconstructs the scale story
                          # from the merged black-box journals; the pure
                          # latency soaks keep the journal at its
@@ -621,6 +627,22 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     duration = time.monotonic() - t_start
     if killer is not None:
         killer.cancel()  # no-op when it already fired
+    # stop the loops and DRAIN before the stats snapshot: the fused
+    # ring (diurnal, fusedWindowK=4) holds up to K-1 staged intervals
+    # whose publish would otherwise be missing from the final figures —
+    # last_batch_nodes would read a stale mid-scale window. The run()
+    # threads drain on exit, so JOIN them before snapshotting (a cancel
+    # alone races their exit-drain) — then shutdown() idempotently
+    # covers a thread that never got to run
+    for ctx in ctxs:
+        ctx.cancel()
+    for i in sorted(live):
+        servers[i].shutdown()
+    for i in sorted(live):
+        for t in replica_threads[i]:
+            t.join(timeout=30)
+    for i in sorted(live):
+        aggs[i].shutdown()
     # surviving-replica stats: counters sum, per-window last_* figures
     # take the max (summing latencies across replicas would be a lie)
     live_aggs = [aggs[i] for i in sorted(live)]
@@ -635,10 +657,6 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
                 stats[k] = max(cur, v)
             else:
                 stats[k] = cur + v
-    for ctx in ctxs:
-        ctx.cancel()
-    for i in sorted(live):
-        servers[i].shutdown()
     rss_end = rss_mib()
 
     all_samples = [tv for lat in latencies for tv in lat]
@@ -677,6 +695,10 @@ def run_soak(n_agents: int = 1000, seconds: float = 60.0,
     if diurnal:
         out.update({
             "soak_diurnal": True,
+            # amortized host↔device sync cost of the last fused flush
+            # (batch device ms / K) — the figure the fused loop shrinks
+            "soak_sync_per_window_ms": round(
+                stats.get("last_sync_per_window_ms", 0.0), 2),
             # enacted membership transitions: (peak-1) joins on the way
             # up plus (peak-2) leaves on the way down
             "soak_scale_events": int(scale_events[0]),
@@ -848,7 +870,10 @@ def main() -> None:
                         "peak -> 2 replica schedule under live load "
                         "driven through /v1/membership join/leave; "
                         "agents speak wire v2 (deltas + 409 keyframe "
-                        "recovery); emits soak_scale_events / "
+                        "recovery); the replicas run the fused window "
+                        "loop (fusedWindowK=4, ISSUE 20) and emit "
+                        "soak_sync_per_window_ms; emits "
+                        "soak_scale_events / "
                         "soak_rejoin_replays / soak_keyframe_requests "
                         "and gates ZERO windows lost plus a BOUNDED "
                         "post-rebalance keyframe burst (<= 4x the "

@@ -191,6 +191,17 @@ DESCRIPTIONS = {
                                  "most `pipelineDepth−1` intervals "
                                  "stale; shutdown drains in-flight "
                                  "windows deterministically.",
+    "aggregator.fused_window_k": "Aggregator: intervals batched into "
+                                 "one fused device scan at rung 0's top "
+                                 "tier. `1` (default) = unfused "
+                                 "per-window dispatch; `K>1` stages "
+                                 "delta rows host-side and pays the "
+                                 "host↔device sync once per K windows "
+                                 "(one `lax.scan` dispatch + one "
+                                 "batched fetch) — results are at most "
+                                 "`fusedWindowK−1` intervals stale. See "
+                                 "observability.md \"Fused window "
+                                 "loop\".",
     "aggregator.bucket_shrink_after": "Aggregator: consecutive windows "
                                       "at under half bucket occupancy "
                                       "before a padded batch bucket "
@@ -506,6 +517,7 @@ FLAG_OF = {
         "--aggregator.training-dump-max-files",
     "aggregator.dedup_window": "--aggregator.dedup-window",
     "aggregator.pipeline_depth": "--aggregator.pipeline-depth",
+    "aggregator.fused_window_k": "--aggregator.fused-window-k",
     "aggregator.bucket_shrink_after": "--aggregator.bucket-shrink-after",
     "aggregator.fallback_enabled":
         "--aggregator.fallback-enabled / --no-aggregator.fallback-enabled",

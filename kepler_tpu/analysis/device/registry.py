@@ -179,6 +179,27 @@ def _build_window_update(case: ProgramCase) -> tuple:
                 _sds((db,), _I32))
 
 
+def _build_fused(case: ProgramCase) -> tuple:
+    from kepler_tpu.parallel.packed import (make_fused_window_program,
+                                            packed_width)
+
+    d = case.dims
+    nb, wb, z, k, db = d["n"], d["w"], d["z"], d["k"], d["db"]
+    mb = d.get("m")
+    model_mode = d.get("model_mode")
+    mesh = _mesh(d.get("devices", 8))
+    fn = make_fused_window_program(
+        mesh, n_workloads=wb, n_zones=z, model_mode=model_mode,
+        backend=d.get("backend", "einsum"), model_bucket=mb)
+    params = _mlp_avals(z) if model_mode else _sds((), _F32)
+    width = packed_width(wb, z)
+    avals: list = [params, _sds((nb, width), _F32),
+                   _sds((k, db, width), _F32), _sds((k, db), _I32)]
+    if mb is not None:
+        avals.append(_sds((k, mb), _I32))
+    return fn, tuple(avals)
+
+
 def _build_fleet(case: ProgramCase) -> tuple:
     from kepler_tpu.parallel.aggregator_core import (
         make_fleet_program, make_temporal_fleet_program)
@@ -406,6 +427,62 @@ DEVICE_PROGRAMS: tuple[ProgramSpec, ...] = (
         ),
         allowed_half_casts=_F16_OUT,
         require_shard_map=True,
+    ),
+    ProgramSpec(
+        name="window.fused_ratio",
+        source="kepler_tpu/parallel/packed.py",
+        description="fused device-resident window loop, ratio-only: one "
+                    "donated lax.scan applies K intervals' delta rows "
+                    "and emits K packed f16 outputs per dispatch — the "
+                    "per-window host↔device sync amortized K× (zero "
+                    "collectives: the only cross-shard step stays the "
+                    "caller's batched publish fetch)",
+        build=_build_fused,
+        cases=(
+            ProgramCase("n16_w8_z2_k4_d8",
+                        dims={"n": 16, "w": 8, "z": 2, "k": 4, "db": 8}),
+            ProgramCase("pad_n8_w1_z1_k2_d1", "minimal fused rung: "
+                        "steady fleet, one delta row per interval",
+                        dims={"n": 8, "w": 1, "z": 1, "k": 2, "db": 1}),
+        ),
+        donates=(1,),
+        allowed_half_casts=_F16_OUT,
+    ),
+    ProgramSpec(
+        name="window.fused_sparse_mlp",
+        source="kepler_tpu/parallel/packed.py",
+        description="fused window loop, sparse MODE_MODEL variant: each "
+                    "scan step gathers the interval's model rows "
+                    "(replicated indices, single-device engine path) "
+                    "through the mlp estimator — f32 accumulators, f16 "
+                    "only at the packed output boundary",
+        build=_build_fused,
+        cases=(
+            ProgramCase("n8_w8_z2_m4_k2_d4",
+                        dims={"n": 8, "w": 8, "z": 2, "m": 4, "k": 2,
+                              "db": 4, "model_mode": "mlp",
+                              "devices": 1}),
+        ),
+        n_devices=1,
+        donates=(1,),
+        allowed_half_casts=_F16_OUT,
+    ),
+    ProgramSpec(
+        name="window.fused_pallas",
+        source="kepler_tpu/ops/pallas_attribution.py",
+        description="fused window mega-kernel scan (single-device "
+                    "pallas path): scatter + unpack + ratio attribution "
+                    "as ONE kernel body per scan step, interpret mode "
+                    "off-TPU",
+        build=_build_fused,
+        cases=(
+            ProgramCase("n16_w8_z2_k2_d4",
+                        dims={"n": 16, "w": 8, "z": 2, "k": 2, "db": 4,
+                              "backend": "pallas", "devices": 1}),
+        ),
+        n_devices=1,
+        donates=(1,),
+        allowed_half_casts=_F16_OUT,
     ),
     ProgramSpec(
         name="window.update",

@@ -103,6 +103,7 @@ def main(argv: Sequence[str] | None = None) -> int:
         dedup_window=cfg.aggregator.dedup_window,
         delivery_buckets=cfg.telemetry.delivery_buckets or None,
         pipeline_depth=cfg.aggregator.pipeline_depth,
+        fused_window_k=cfg.aggregator.fused_window_k,
         bucket_shrink_after=cfg.aggregator.bucket_shrink_after,
         fallback_enabled=cfg.aggregator.fallback_enabled,
         repromote_after=cfg.aggregator.repromote_after,

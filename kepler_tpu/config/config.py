@@ -476,6 +476,14 @@ class AggregatorConfig:
     # window N+1's assembly+dispatch — published results are at most
     # pipelineDepth−1 intervals stale, shutdown drains deterministically
     pipeline_depth: int = 2
+    # fused window loop (rung 0's top tier): batch this many intervals'
+    # delta rows host-side and run them as ONE donated lax.scan dispatch
+    # + ONE batched K-window fetch — the host↔device sync cost is paid
+    # once per K windows instead of once per window. Published results
+    # are at most fusedWindowK−1 intervals stale (the flush publishes
+    # all K at once, oldest first). 1 (the default) keeps the unfused
+    # per-window dispatch exactly as before
+    fused_window_k: int = 1
     # bucket hysteresis: padded batch shapes grow geometrically on
     # demand but only SHRINK after this many consecutive windows at
     # under half occupancy — a fleet hovering at a bucket edge never
@@ -640,6 +648,11 @@ class Config:
             # beyond a few intervals of staleness the "latest" results
             # stop meaning anything; 8 is already generous
             errs.append("aggregator.pipelineDepth must be in [1, 8]")
+        if not 1 <= self.aggregator.fused_window_k <= 8:
+            # same staleness argument as pipelineDepth: a flush that
+            # publishes more than a handful of windows at once makes
+            # "latest" meaningless
+            errs.append("aggregator.fusedWindowK must be in [1, 8]")
         if self.aggregator.bucket_shrink_after < 1:
             errs.append("aggregator.bucketShrinkAfter must be >= 1")
         if self.aggregator.repromote_after < 1:
@@ -871,6 +884,7 @@ _CANONICAL_YAML_KEYS: dict[str, str] = {
     "stateMaxAge": "state_max_age",
     "dedupWindow": "dedup_window",
     "pipelineDepth": "pipeline_depth",
+    "fusedWindowK": "fused_window_k",
     "bucketShrinkAfter": "bucket_shrink_after",
     "fallbackEnabled": "fallback_enabled",
     "repromoteAfter": "repromote_after",
@@ -1054,6 +1068,10 @@ def register_flags(parser: argparse.ArgumentParser) -> None:
     add("--aggregator.pipeline-depth", dest="aggregator_pipeline_depth",
         default=None, type=int,
         help="in-flight fleet windows (1 = serial, 2 = double-buffered)")
+    add("--aggregator.fused-window-k", dest="aggregator_fused_window_k",
+        default=None, type=int,
+        help="intervals batched into one fused device scan (1 = unfused "
+             "per-window dispatch; K>1 syncs the host once per K windows)")
     add("--aggregator.bucket-shrink-after",
         dest="aggregator_bucket_shrink_after", default=None, type=int,
         help="consecutive under-half windows before a batch bucket shrinks")
@@ -1248,6 +1266,8 @@ def apply_flags(cfg: Config, args: argparse.Namespace) -> Config:
            args.aggregator_dump_max_files)
     set_if(("aggregator", "dedup_window"), args.aggregator_dedup_window)
     set_if(("aggregator", "pipeline_depth"), args.aggregator_pipeline_depth)
+    set_if(("aggregator", "fused_window_k"),
+           args.aggregator_fused_window_k)
     set_if(("aggregator", "bucket_shrink_after"),
            args.aggregator_bucket_shrink_after)
     set_if(("aggregator", "fallback_enabled"),

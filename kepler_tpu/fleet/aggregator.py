@@ -88,7 +88,8 @@ from kepler_tpu.fleet.wire import (
     try_parse_header,
 )
 from kepler_tpu.fleet.scoreboard import STATE_NAMES, FleetScoreboard
-from kepler_tpu.fleet.window import (DeviceWindowError,
+from kepler_tpu.fleet.window import (DeviceWindowError, FusedFlush,
+                                     FusedWindowEngine,
                                      MultiHostWindowEngine,
                                      PackedWindowEngine, RowInput,
                                      ShardedWindowEngine, WindowMeta,
@@ -146,6 +147,12 @@ RUNG_NAME_SHARDED = "packed-sharded-pipelined"
 # dead jax.distributed peer cannot rejoin a running job)
 RUNG_NAME_MULTIHOST = "packed-multihost-pipelined"
 RUNG_NAME_MESH_DEGRADED = "packed-sharded-mesh-minus-host"
+# rung 0's name when the fused device-resident window loop is active
+# (FusedWindowEngine, aggregator.fusedWindowK > 1): one lax.scan
+# dispatch + one fetch per K windows. A device failure at this tier
+# demotes WITHIN rung 0 to the packed-pipelined engine (the fused flag
+# flips, like the mesh demotion) before the ordinary ladder applies.
+RUNG_NAME_FUSED = "packed-fused-scan"
 
 # per-mode checkpoint layout: required keys, and which key's last axis is
 # the zone count Z. Temporal params serve through the dedicated history
@@ -198,13 +205,16 @@ def _primary_introspect(snap: Mapping[str, dict]) -> dict | None:
     rung-0 engine reads empty until re-promotion, so preferring it
     unconditionally would blank the flight recorder exactly while the
     plane is degraded."""
+    fused = snap.get("fused")
     pipelined = snap.get("pipelined")
     serial = snap.get("serial")
+    if fused and fused["resident"]["rows"]:
+        return fused
     if pipelined and pipelined["resident"]["rows"]:
         return pipelined
     if serial and serial["resident"]["rows"]:
         return serial
-    return pipelined or serial
+    return fused or pipelined or serial
 
 
 def _report_power_w(report: NodeReport) -> float:
@@ -248,6 +258,13 @@ class _Pending:
     # per-shard addressable fetch (owned shards only on the multi-host
     # engine). None = np.asarray of the whole output.
     fetch: Callable | None = None
+    # fused path (kind "fused"): `out` is already a HOST slice of the
+    # batch fetch. The whole batch's device cost is carried by its LAST
+    # window (`dispatch_ms`; earlier windows publish with 0 — the K−1
+    # free rides are the amortization), and sync_per_window_ms is the
+    # honest averaged figure (−1 on non-fused windows).
+    sync_per_window_ms: float = -1.0
+    fused_fetch_ms: float = 0.0
     # legacy path extras (training dump + dense scatter)
     batch: object = None
     aligned: list | None = None
@@ -433,6 +450,7 @@ class Aggregator:
         dedup_window: int = 1024,
         delivery_buckets: Sequence[float] | None = None,
         pipeline_depth: int = 1,
+        fused_window_k: int = 1,
         bucket_shrink_after: int = 16,
         fallback_enabled: bool = True,
         repromote_after: int = 8,
@@ -718,6 +736,10 @@ class Aggregator:
                        # publish-fetch leg alone (per-shard addressable
                        # D2H materialization inside the pipeline wait)
                        "last_fetch_ms": 0.0,
+                       # fused tier: device sync cost averaged over the
+                       # windows of the last flushed batch (0 until the
+                       # fused tier publishes)
+                       "last_sync_per_window_ms": 0.0,
                        "last_h2d_rows": 0,
                        # sharded window: device shards the last window ran
                        # over (1 = unsharded engine or demoted rung) and
@@ -768,6 +790,25 @@ class Aggregator:
         self._engine: PackedWindowEngine | None = None
         self._engine_serial: PackedWindowEngine | None = None
         self._shard_count = 1  # set in init() from the mesh shape
+        # -- fused device-resident window loop (aggregator.fusedWindowK):
+        # K > 1 replaces the rung-0 tier with the FusedWindowEngine —
+        # host-only staging per interval, ONE lax.scan dispatch + one
+        # batched fetch per K windows. Published windows stay within the
+        # ladder's ≤ depth−1 staleness contract with K as the depth.
+        # Single-host only: the multi-host tier has its own ring story.
+        self._fused_window_k = max(1, int(fused_window_k))
+        self._engine_fused: FusedWindowEngine | None = None
+        # a device failure at the fused tier flips this (rung 0 stays,
+        # its engine drops to packed-pipelined — the mesh demotion's
+        # shape); repromote_after clean windows at rung 0 clear it
+        self._fused_degraded = False  # keplint: guarded-by=_results_lock
+        # per-un-flushed-window aggregation snapshots, oldest first,
+        # parallel to the fused engine's pending ring: (stored_sorted,
+        # zone_names, now, t_win). Popped as the flush publishes; after
+        # a failure resets the engine these are ORPHANED and
+        # _replay_fused_pending republishes them at the demoted tier —
+        # the zero-gaps invariant. Aggregation-loop-only state.
+        self._fused_pending: list[tuple] = []
         # -- device-plane degradation ladder (fleet.window faults) ---------
         # state is written only by the aggregation loop; reads from the
         # probe/metrics threads snapshot under _results_lock
@@ -2396,9 +2437,18 @@ class Aggregator:
             if self._multihost_active():
                 return (RUNG_NAME_MESH_DEGRADED if self._mesh_degraded
                         else RUNG_NAME_MULTIHOST)
+            if self._fused_tier_active():
+                return RUNG_NAME_FUSED
             if self._shard_count > 1:
                 return RUNG_NAME_SHARDED
         return RUNG_NAMES[rung]
+
+    def _fused_tier_active(self) -> bool:
+        """Whether rung 0 currently runs the fused device-resident
+        window loop (aggregator.fusedWindowK > 1, packed path, single
+        host, not demoted within rung 0)."""
+        return (self._fused_window_k > 1 and not self._fused_degraded
+                and not self._multihost_enabled and self._use_packed())
 
     def window_health(self) -> dict:
         """``fleet-window`` probe for /healthz: degraded while the device
@@ -2427,6 +2477,27 @@ class Aggregator:
             }
             if self._last_window_failure:
                 out["last_failure"] = self._last_window_failure
+            if self._fused_window_k > 1:
+                eng = self._engine_fused
+                out["fused"] = {
+                    "k": self._fused_window_k,
+                    "active": (self._rung == RUNG_PIPELINED
+                               and self._fused_tier_active()),
+                    "degraded": self._fused_degraded,
+                    # host-ring occupancy: intervals staged, not yet
+                    # flushed (the next flush publishes this many + 1)
+                    "pending_windows": len(self._fused_pending),
+                    "sync_per_window_ms":
+                        self._stats["last_sync_per_window_ms"],
+                }
+                if eng is not None:
+                    out["fused"]["ring_occupancy"] = \
+                        eng.pending_occupancy()
+                if self._fused_degraded:
+                    # fused is rung 0's healthy tier when configured —
+                    # running packed-pipelined instead IS degraded
+                    # service, mirrored on the probe like _mesh_degraded
+                    out["ok"] = False
             if self._multihost_enabled:
                 from kepler_tpu.parallel.mesh import multihost_status
 
@@ -2517,6 +2588,12 @@ class Aggregator:
             self._engine.reset()
         if self._engine_serial is not None:
             self._engine_serial.reset()
+        if self._engine_fused is not None:
+            # the fused ring is poisoned like any other: reset drops its
+            # device block AND the host pending ring — the orphaned
+            # windows republish from _fused_pending snapshots at the
+            # demoted tier (zero gaps)
+            self._engine_fused.reset()
         self._program = None  # a failed serial program recompiles fresh
         # a failure at the MULTI-HOST rung demotes to "mesh minus one
         # host" first: rung 0 is kept, but its engine becomes the
@@ -2525,12 +2602,25 @@ class Aggregator:
         mesh_demotion = (self._multihost_active()
                          and not self._mesh_degraded
                          and self._rung == RUNG_PIPELINED)
+        # likewise a failure at the FUSED tier demotes WITHIN rung 0
+        # first — the fused flag flips and rung 0's engine becomes the
+        # ordinary packed-pipelined one; the next failure walks the
+        # ladder. Checked under _results_lock below via the same
+        # rung-0 gate the dispatch path used.
+        fused_demotion = (not mesh_demotion
+                          and self._rung == RUNG_PIPELINED
+                          and self._fused_tier_active())
         with self._results_lock:
             prev = self._rung
+            prev_name = self._rung_display(prev)  # before any flag flip
             from_name = ""
             if mesh_demotion:
-                from_name = self._rung_display(prev)  # before the flag
+                from_name = prev_name
                 self._mesh_degraded = True
+                rung = prev  # rung 0 stays; its engine changes tier
+            elif fused_demotion:
+                from_name = RUNG_NAME_FUSED
+                self._fused_degraded = True
                 rung = prev  # rung 0 stays; its engine changes tier
             else:
                 self._rung = min(prev + 1, RUNG_NUMPY)
@@ -2555,7 +2645,7 @@ class Aggregator:
         log.error("fleet window device leg failed (%s) at rung %s; "
                   "demoting to %s, %d in-flight window(s) abandoned, "
                   "resident ring re-seeded: %s", reason,
-                  self._rung_display(prev), self._rung_display(rung),
+                  from_name or prev_name, self._rung_display(rung),
                   abandoned, err)
 
     def _ladder_window_ok(self) -> None:
@@ -2588,6 +2678,24 @@ class Aggregator:
                     promoted = self._rung
                     self._record_rung_transition_locked(
                         self._rung + 1, self._rung, "repromoted")
+            elif self._fused_degraded and self._fused_window_k > 1:
+                # within-rung-0 probe back to the fused tier: same
+                # clean-window hysteresis as the ladder proper. The
+                # fused engine re-seeds its ring from scratch on the
+                # next interval (its reset survived with program caches
+                # intact), so the probe costs one full re-pack.
+                self._clean_windows += 1
+                needed = self._repromote_after * self._probe_penalty
+                if self._clean_windows >= needed:
+                    from_name = self._rung_display(RUNG_PIPELINED)
+                    self._fused_degraded = False
+                    self._clean_windows = 0
+                    self._just_promoted = True
+                    self._stats["window_repromotions_total"] += 1
+                    promoted = RUNG_PIPELINED
+                    self._record_rung_transition_locked(
+                        RUNG_PIPELINED, RUNG_PIPELINED, "repromoted",
+                        from_name=from_name)
         if promoted is not None:
             log.info("fleet window ladder: clean-window threshold met — "
                      "re-promoted to rung %d (%s)", promoted,
@@ -2687,6 +2795,11 @@ class Aggregator:
             # failures re-raise (a NumPy bug is a bug, not degradation).
             while True:
                 try:
+                    # republish windows a fused-tier failure orphaned
+                    # (no-op while the fused ring is intact or empty);
+                    # a failure HERE re-enters the same demote+retry
+                    # loop with the un-replayed snapshots preserved
+                    self._replay_fused_pending()
                     return self._window_step(stored_sorted, zone_names,
                                              now, t_win)
                 except Exception as err:
@@ -2705,6 +2818,12 @@ class Aggregator:
         elif rung >= RUNG_EINSUM or not self._use_packed():
             pending = self._dispatch_legacy(stored_sorted, zone_names,
                                             now, t_win)
+        elif rung == RUNG_PIPELINED and self._fused_tier_active():
+            # the fused tier publishes on its own cadence (K windows
+            # per flush, all inside the flush call) — it never enters
+            # the per-window pipeline deque below
+            return self._window_step_fused(stored_sorted, zone_names,
+                                           now, t_win)
         else:
             pending = self._dispatch_packed(stored_sorted, zone_names,
                                             now, t_win, rung)
@@ -2739,6 +2858,23 @@ class Aggregator:
     def _drain_pipeline(self) -> "FleetResults | None":
         published = None
         failure: Exception | None = None
+        eng = self._engine_fused
+        if eng is not None and eng.pending_occupancy():
+            # reports stopped arriving (or shutdown): force-flush the
+            # fused ring so its staged windows publish instead of
+            # rotting host-side — results never rot in flight, fused
+            # tier included
+            try:
+                zones = self._fused_pending[-1][1]
+                params = self._params_for_zones(len(zones))
+                if params is None:
+                    params = np.zeros((), np.float32)
+                flush = eng.flush(params)
+                if flush is not None:
+                    published = self._dispatch_fused_flush(eng, flush,
+                                                           0.0)
+            except Exception as err:
+                failure = err
         with self._pipeline_lock:
             while self._inflight:
                 try:
@@ -2753,9 +2889,169 @@ class Aggregator:
             if not self._fallback_enabled:
                 raise failure
             self._handle_device_failure(failure)
+            # windows a failed fused flush orphaned republish at the
+            # demoted tier right away (a drain has no next interval to
+            # carry them); repeated failures walk the ladder like the
+            # aggregate_once retry loop, and the bottom rung re-raises
+            while True:
+                try:
+                    published = self._replay_fused_pending() or published
+                    break
+                except Exception as err:
+                    if (not self._fallback_enabled
+                            or self._rung >= RUNG_NUMPY):
+                        raise
+                    self._handle_device_failure(err)
         return published
 
     # -- dispatch half ------------------------------------------------------
+
+    def _fused_engine(self) -> FusedWindowEngine:
+        """Rung 0's fused-tier engine (lazy, like the packed engines).
+        Runs on the FULL configured mesh — the resident block and scan
+        operands are global arrays with node-axis shardings, so XLA
+        shards the scan body exactly like the unfused packed program."""
+        if self._engine_fused is None:
+            self._engine_mesh = self._mesh
+            self._engine_fused = FusedWindowEngine(
+                self._mesh, backend=self._backend,
+                model_mode=self._model_mode,
+                node_bucket=self._node_bucket,
+                workload_bucket=self._workload_bucket,
+                shrink_after=self._bucket_shrink_after,
+                fused_k=self._fused_window_k)
+        return self._engine_fused
+
+    def _window_step_fused(self, stored_sorted: list,
+                           zone_names: list[str], now: float,
+                           t_win: float) -> "FleetResults | None":
+        """One interval at the fused tier: HOST-ONLY staging, and — on
+        every K-th interval (or a forced shape-change flush) — one
+        device dispatch + one batched fetch publishing all pending
+        windows. Non-flush intervals return None (the ring is filling,
+        same contract as a filling pipeline) and cost no device sync at
+        all: that is the amortization this tier exists for."""
+        engine = self._fused_engine()
+        rows = [
+            RowInput(name=s.report.node_name, report=s.report,
+                     zone_names=s.zone_names,
+                     # content identity, as on the packed path: a v2
+                     # FLAG_SAME delta stages zero rows end to end
+                     ident=((s.run, s.content_seq or s.seq)
+                            if s.run and s.seq > 0 else None))
+            for s in stored_sorted]
+        params = self._params_for_zones(len(zone_names))
+        if params is None:
+            params = np.zeros((), np.float32)  # ratio-only: unused leaf
+        # snapshot BEFORE staging: if anything below fails, the ladder
+        # retry recomputes THIS interval itself, so only the snapshot is
+        # popped back off; EARLIER snapshots stay until their windows
+        # actually publish (the zero-gaps invariant)
+        self._fused_pending.append((stored_sorted, zone_names, now,
+                                    t_win))
+        try:
+            with telemetry.span("window.h2d_delta"):
+                _meta, flush = engine.stage(rows, zone_names, params)
+            t_staged = _time.perf_counter()
+            # consulted AFTER the host staging, covering both flush and
+            # accumulate intervals — a mid-scan fault abandons the ring
+            # and the pending windows republish at the demoted tier
+            if fault.fire("device.dispatch_error") is not None:
+                raise DeviceWindowError(
+                    "dispatch_error",
+                    "injected dispatch failure (fused window scan)")
+        except BaseException:
+            self._fused_pending.pop()
+            raise
+        stage_ms = (t_staged - t_win) * 1e3
+        if flush is None:
+            # ring filling: no device leg this interval. The per-call
+            # leg stats say so honestly (the previous flush's batch
+            # cost must not read as THIS interval's device time).
+            with self._results_lock:
+                self._stats["last_assembly_ms"] = stage_ms
+                self._stats["last_dispatch_ms"] = 0.0
+                self._stats["last_wait_ms"] = 0.0
+                self._stats["last_fetch_ms"] = 0.0
+                self._stats["last_device_ms"] = 0.0
+                self._stats["last_h2d_rows"] = 0
+            return None
+        published = self._dispatch_fused_flush(engine, flush, stage_ms)
+        if published is not None:
+            self._ladder_window_ok()
+        return published
+
+    def _dispatch_fused_flush(self, engine: FusedWindowEngine,
+                              flush: FusedFlush,
+                              stage_ms: float) -> "FleetResults | None":
+        """Dispatch one fused batch, fetch ALL its outputs in one
+        transfer, publish every live window oldest-first. The batch's
+        whole device cost lands on its LAST window's stats sample
+        (earlier windows ride free — that is the measured amortization);
+        ``sync_per_window_ms`` carries the averaged per-window figure."""
+        t0 = _time.perf_counter()
+        with telemetry.span("window.fused_scan"):
+            if flush.cold:
+                # first dispatch of this (buckets, zones, mode, K, DB)
+                # key blocks on trace + XLA compile
+                with telemetry.span("window.compile"):
+                    outs = engine.dispatch(flush)
+            else:
+                outs = engine.dispatch(flush)
+        t_disp = _time.perf_counter()
+        fetch_box = [0.0]
+
+        def _materialize() -> np.ndarray:
+            with telemetry.span("window.publish_fetch"):
+                t_f = _time.perf_counter()
+                plane = np.asarray(outs)
+                fetch_box[0] = (_time.perf_counter() - t_f) * 1e3
+            return plane
+
+        with telemetry.span("window.pipeline_wait"):
+            plane = self._fetch_device(_materialize)
+        t_done = _time.perf_counter()
+        batch_ms = (t_done - t0) * 1e3
+        spw = batch_ms / max(1, flush.k_live)
+        published = None
+        with self._pipeline_lock:
+            for j, meta in enumerate(flush.metas):
+                # each published window keeps ITS OWN interval's clock
+                # (snapshotted at stage time) — staleness is visible in
+                # the timestamps, exactly like pipeline-depth staleness
+                _, _, w_now, _ = self._fused_pending[0]
+                last = j == len(flush.metas) - 1
+                published = self._publish(_Pending(
+                    kind="fused", out=plane[j], meta=meta, now=w_now,
+                    assembly_ms=stage_ms if last else 0.0,
+                    dispatch_ms=batch_ms if last else 0.0,
+                    h2d_rows=flush.h2d_rows if last else 0,
+                    compiled=flush.cold and last,
+                    sync_per_window_ms=spw,
+                    fused_fetch_ms=fetch_box[0] if last else 0.0))
+                self._fused_pending.pop(0)
+        return published
+
+    def _replay_fused_pending(self) -> "FleetResults | None":
+        """Republish windows ORPHANED by a fused-tier failure: the
+        engine reset dropped its ring, so every remaining snapshot in
+        ``_fused_pending`` is a staged-but-never-published window.
+        Peek-publish-pop, oldest first — a snapshot is only popped
+        after its window published, so a failure mid-replay (this
+        raises; the caller demotes and retries) loses nothing. No-op
+        while the fused ring is intact (its snapshots are live, not
+        orphaned) or when there is nothing pending."""
+        if not self._fused_pending:
+            return None
+        eng = self._engine_fused
+        if eng is not None and eng.pending_occupancy():
+            return None
+        published = None
+        while self._fused_pending:
+            snap = self._fused_pending[0]
+            published = self._window_step(*snap) or published
+            self._fused_pending.pop(0)
+        return published
 
     def _packed_engine(self, rung: int) -> PackedWindowEngine:
         """The packed engine for ``rung``: the sharded engine owns rung 0
@@ -3002,11 +3298,15 @@ class Aggregator:
             fetch_ms = nonlocal_box[0]
             t_fetched = _time.perf_counter()
             results = self._scatter_packed(p, packed)
-        elif p.kind == "numpy":
+        elif p.kind in ("numpy", "fused"):
             # host rung: the "fetch" is a no-op — p.out is already a host
             # array (and consulting the stall site would be a lie: there
-            # is no device leg to hang)
+            # is no device leg to hang). Fused windows look the same by
+            # the time they publish: the flush materialized the whole
+            # K-batch in one transfer and sliced this window's plane out
+            # host-side (the batched fetch cost rides in fused_fetch_ms).
             t_fetched = _time.perf_counter()
+            fetch_ms = p.fused_fetch_ms
             results = self._scatter_packed(p, p.out)
         else:
             result = p.out
@@ -3041,10 +3341,14 @@ class Aggregator:
             self._stats["last_h2d_rows"] = p.h2d_rows
             self._stats["window_shards"] = p.shards
             self._stats["last_h2d_shards"] = list(p.h2d_shards)
-            if self._engine is not None:
+            if p.sync_per_window_ms >= 0.0:
+                self._stats["last_sync_per_window_ms"] = (
+                    p.sync_per_window_ms)
+            engines_all = (self._engine, self._engine_serial,
+                           self._engine_fused)
+            if any(e is not None for e in engines_all):
                 self._stats["window_compiles_total"] = sum(
-                    e.compile_count
-                    for e in (self._engine, self._engine_serial)
+                    e.compile_count for e in engines_all
                     if e is not None)
             # per-window engine introspection snapshot: computed HERE
             # (the only thread that owns engine state) so /debug/window
@@ -3052,7 +3356,8 @@ class Aggregator:
             # touching live engine internals
             engines: dict[str, dict] = {}
             for label, eng in (("pipelined", self._engine),
-                               ("serial", self._engine_serial)):
+                               ("serial", self._engine_serial),
+                               ("fused", self._engine_fused)):
                 if eng is not None:
                     engines[label] = eng.introspect()
             primary = _primary_introspect(engines)
@@ -3373,7 +3678,8 @@ class Aggregator:
                 "engines": self._introspect_cache,
                 "stats": {k: self._stats[k] for k in (
                     "last_assembly_ms", "last_dispatch_ms",
-                    "last_wait_ms", "last_fetch_ms", "last_scatter_ms",
+                    "last_wait_ms", "last_fetch_ms",
+                    "last_sync_per_window_ms", "last_scatter_ms",
                     "last_attribution_ms", "last_h2d_rows",
                     "last_h2d_shards", "window_shards", "shard_skew",
                     "window_compiles_total", "window_rung",
@@ -3381,6 +3687,16 @@ class Aggregator:
                     "window_repromotions_total", "last_batch_nodes",
                     "last_batch_workloads")},
             }
+            if self._fused_window_k > 1:
+                eng = self._engine_fused
+                payload["fused"] = {
+                    "k": self._fused_window_k,
+                    "active": self._fused_tier_active(),
+                    "degraded": self._fused_degraded,
+                    "pending_windows": len(self._fused_pending),
+                    "ring_occupancy": (eng.pending_occupancy()
+                                       if eng is not None else 0),
+                }
             if self._last_window_failure:
                 payload["last_failure"] = self._last_window_failure
         return (200, {"Content-Type": "application/json"},
@@ -3554,6 +3870,15 @@ class Aggregator:
             "scales with owned rows, not fleet size)")
         fetch_ms.add_metric([], stats["last_fetch_ms"])
         yield fetch_ms
+        sync_pw = GaugeMetricFamily(
+            "kepler_fleet_window_sync_per_window_ms",
+            "Amortized host↔device sync cost per published window at "
+            "the fused tier: the last fused flush's whole device leg "
+            "(dispatch + scan + batched K-window fetch) divided by the "
+            "windows it published; 0.0 until a fused flush has run "
+            "(fusedWindowK=1 or unfused rungs never set it)")
+        sync_pw.add_metric([], stats["last_sync_per_window_ms"])
+        yield sync_pw
         shards = GaugeMetricFamily(
             "kepler_fleet_window_shards",
             "Device shards the last fleet window ran over (node-axis "
@@ -3622,8 +3947,13 @@ class Aggregator:
         if introspect_snap:
             seen_programs: set[str] = set()
             for eng in introspect_snap.values():
-                for kind in ("programs", "updates"):
-                    for prog in eng.get(kind, ()):
+                prog_lists = [eng.get(kind, ())
+                              for kind in ("programs", "updates")]
+                fused_sub = eng.get("fused")
+                if fused_sub:
+                    prog_lists.append(fused_sub.get("programs", ()))
+                for progs in prog_lists:
+                    for prog in progs:
                         cost = prog.get("cost")
                         if not cost or "flops" not in cost:
                             continue

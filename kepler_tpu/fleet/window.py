@@ -51,6 +51,8 @@ log = logging.getLogger("kepler.fleet.window")
 __all__ = [
     "BucketLadder",
     "DeviceWindowError",
+    "FusedFlush",
+    "FusedWindowEngine",
     "HostLocalFabric",
     "MultiHostWindowEngine",
     "PackedWindowEngine",
@@ -761,6 +763,340 @@ class PackedWindowEngine:
             resident = update(resident, rows_dev, idx_dev)
         self._buffers[self._buf_i] = resident
         return n_stage
+
+
+@dataclass
+class FusedFlush:
+    """One dispatchable fused batch: program + args for a single donated
+    ``lax.scan`` call that replays every pending interval's delta rows
+    against the resident block and returns all their packed outputs in
+    one ``[K, N, W+2, Z]`` f16 array (one device sync per K windows)."""
+
+    program: Callable
+    args: tuple  # (params, resident, rows_dev, idx_dev[, model_rows_dev])
+    cold: bool  # True → dispatching compiles (time it as window.compile)
+    metas: list[WindowMeta]  # pending windows, oldest first (len = k_live)
+    k: int  # compiled scan depth (k_live padded with no-op intervals)
+    k_live: int  # real windows in this batch
+    h2d_rows: int  # delta rows staged across the whole batch
+    # False when the ring was rebuilt AFTER this flush was cut (shape
+    # change): the donated scan still runs — its carry is the retired
+    # old-shape block and is dropped instead of rebound
+    rebind: bool = True
+
+
+class FusedWindowEngine(PackedWindowEngine):
+    """Device-resident window LOOP — one host↔device sync per K windows.
+
+    The packed engines above dispatch one program (plus one donated
+    scatter-update) per window; at fleet scale the fixed per-dispatch
+    host sync dwarfs the ~0.1 ms of attribution math (ROADMAP item 2's
+    sync floor). This engine severs that: :meth:`stage` is HOST-ONLY —
+    it runs the same delta-sync bookkeeping as the base engine but
+    accretes the interval's packed delta rows into a host-side pending
+    ring instead of uploading them. Every K-th interval
+    (``aggregator.fusedWindowK``) it cuts a :class:`FusedFlush`: one
+    donated ``lax.scan`` program (:func:`make_fused_window_program`)
+    replays the K delta sets against the device-resident block and
+    returns all K packed watts planes in one array, so dispatch, sync,
+    and publish fetch each happen once per K windows.
+
+    Staleness: windows 1..K−1 of a batch publish when window K flushes —
+    at most K−1 intervals late, the ladder's existing ≤ depth−1
+    staleness contract with K as the depth.
+
+    Single resident buffer, no ping-pong: the flush is synchronous (the
+    publish fetch drains the scan before the next stage), so a donated
+    update never targets a buffer with outstanding readers. Failure
+    story: a failed flush abandons the ring wholesale — :meth:`reset`
+    drops the pending host ring too, the aggregator demotes one rung and
+    republishes the pending windows from its own report snapshots (zero
+    gaps), and re-seeds this ring on re-promotion.
+    """
+
+    def __init__(self, mesh: Any, backend: str = "einsum",
+                 model_mode: str | None = None,
+                 node_bucket: int = 8, workload_bucket: int = 256,
+                 shrink_after: int = 16, fused_k: int = 4) -> None:
+        super().__init__(mesh, backend=backend, model_mode=model_mode,
+                         node_bucket=node_bucket,
+                         workload_bucket=workload_bucket,
+                         shrink_after=shrink_after)
+        self.fused_k = max(1, int(fused_k))
+        # ONE resident buffer and ONE (vestigial) staging slot: the
+        # synchronous flush means donation never races an in-flight
+        # reader, so the ping-pong ring collapses — _rebuild sizes the
+        # device ring from the slot count
+        self._stages = [np.zeros((0, 0), np.float32)]
+        self._fused_programs: dict[tuple, list] = {}
+        # host-side pending ring, oldest first: (rows [n, width] f32,
+        # idx [n] i32, model_idx i32 | None, meta)
+        self._pending: list[tuple] = []
+
+    # -- interval staging --------------------------------------------------
+
+    def stage(self, rows: Sequence[RowInput], zone_names: Sequence[str],
+              params: Any) -> tuple[WindowMeta, FusedFlush | None]:
+        """Account one interval host-side and return ``(meta, flush)``;
+        ``flush`` is non-None when the pending ring reached K — or when a
+        shape change forced the old-shape batch out early — and the
+        caller must dispatch it (then publish ``flush.metas``)."""
+        self._window_seq += 1
+        zones_t = tuple(zone_names)
+        need_w = max((len(r.report.cpu_deltas) for r in rows), default=1)
+        prev_nb, prev_wb = self._ladder_n.bucket, self._ladder_w.bucket
+        wb = self._ladder_w.fit(need_w)
+        nb = self._ladder_n.fit(len(rows))
+        if self._buffers and (nb > prev_nb or wb > prev_wb):
+            if fault.fire("device.oom_on_grow") is not None:
+                raise DeviceWindowError(
+                    "oom_on_grow",
+                    f"injected OOM growing buckets ({prev_nb}, {prev_wb})"
+                    f" → ({nb}, {wb})")
+        key = (nb, wb, zones_t)
+        flush: FusedFlush | None = None
+        if key != self._key or not self._buffers:
+            # shape change: the pending windows were staged against the
+            # OLD resident shape — cut their flush FIRST (against the old
+            # key/buffer), marked no-rebind since the rebuild below
+            # retires that buffer's shape. At most one flush per stage()
+            # call: with K=1 the ring never holds a window across calls,
+            # and with K>1 this interval leaves the fresh ring at
+            # occupancy 1 < K.
+            if self._pending:
+                flush = self._make_flush(params)
+                flush.rebind = False
+            self._rebuild(rows, nb, wb, zones_t)
+            width = self._empty_row.shape[0]
+            staged = (np.zeros((0, width), np.float32),
+                      np.zeros(0, np.int32))
+        else:
+            staged = self._stage_delta(rows, zones_t)
+        self._buf_served[0] = self._window_seq
+        meta = WindowMeta(
+            zones=list(zones_t),
+            names=[r.name for r in rows],
+            rows=dict(self._row_of),
+            mode=np.asarray(self._mode, np.int32),
+            dt=np.asarray(self._dt, np.float32),
+            counts=list(self._counts),
+            ids=list(self._ids),
+            kinds=list(self._kinds),
+            n_live=len(rows),
+            n_rows=nb,
+        )
+        model_idx = None
+        if self._sparse:
+            model_idx = np.flatnonzero(
+                np.asarray(self._mode, np.int32) == MODE_MODEL
+            ).astype(np.int32)
+        self._pending.append((staged[0], staged[1], model_idx, meta))
+        if flush is None and len(self._pending) >= self.fused_k:
+            flush = self._make_flush(params)
+        return meta, flush
+
+    def _stage_delta(self, rows: Sequence[RowInput],
+                     zones_t: tuple[str, ...]) -> tuple[np.ndarray,
+                                                        np.ndarray]:
+        """HOST-ONLY delta accounting: the base engine's live-set prune /
+        join / content-identity bookkeeping, but the changed and cleared
+        rows land in a FRESH host array that joins the pending ring — no
+        device traffic until the flush replays the whole batch through
+        the fused scan. Content identity advances at stage time: each
+        interval's delta is computed against the state the PREVIOUS
+        pending interval will have written, which is exactly what the
+        in-order scan replay produces. (A failed flush never leaks
+        staged-but-unapplied identity: :meth:`reset` discards it
+        wholesale and the next stage full-rebuilds.)"""
+        nb, wb, _ = self._key  # type: ignore[misc]
+        live = {r.name for r in rows}
+        content = self._content[0]  # single buffer → single identity plane
+        for name, i in list(self._row_of.items()):
+            if name not in live:
+                del self._row_of[name]
+                self._names[i] = None
+                self._mode[i] = 0
+                self._dt[i] = 0.0
+                self._counts[i] = 0
+                self._ids[i] = []
+                self._kinds[i] = None
+                self._free.append(i)
+        changed: list[tuple[int, RowInput]] = []
+        for r in rows:
+            i = self._row_of.get(r.name)
+            if i is None:
+                i = self._free.pop()
+                self._row_of[r.name] = i
+                self._names[i] = r.name
+                # no _DIRTY cross-marking: there are no other buffers
+            elif (r.ident is not None and content[i] is not _EMPTY
+                    and content[i] is not _DIRTY and content[i] == r.ident):
+                continue
+            self._mode[i] = r.report.mode
+            self._dt[i] = r.report.dt_s
+            self._counts[i] = len(r.report.cpu_deltas)
+            self._ids[i] = r.report.workload_ids
+            self._kinds[i] = r.report.workload_kinds
+            content[i] = r.ident
+            changed.append((i, r))
+        changed_rows = {i for i, _ in changed}
+        cleared = [i for i in range(nb)
+                   if self._names[i] is None and content[i] is not _EMPTY
+                   and i not in changed_rows]
+        for i in cleared:
+            content[i] = _EMPTY
+        n_stage = len(changed) + len(cleared)
+        width = self._empty_row.shape[0]
+        stage = np.zeros((n_stage, width), np.float32)
+        idx = np.empty(n_stage, np.int32)
+        if changed:
+            from kepler_tpu.parallel.packed import pack_reports_into
+
+            reports = [r.report for _, r in changed]
+            zd, zv = align_zone_matrices(
+                reports, [r.zone_names for _, r in changed], zones_t)
+            pack_reports_into(stage, reports, zd, zv, wb)
+            idx[:len(changed)] = [i for i, _ in changed]
+        for k, i in enumerate(cleared):
+            stage[len(changed) + k] = self._empty_row
+            idx[len(changed) + k] = i
+        return stage, idx
+
+    # -- flush building / dispatch -----------------------------------------
+
+    def flush(self, params: Any) -> FusedFlush | None:
+        """Force-flush the pending ring (drain/shutdown, or the
+        aggregator's end-of-batch when reports stop arriving) — None when
+        nothing is pending."""
+        if not self._pending:
+            return None
+        return self._make_flush(params)
+
+    def _make_flush(self, params: Any) -> FusedFlush:
+        """Cut the pending ring into ONE dispatchable batch: pad each
+        interval's delta to a common bucketed width and the batch to the
+        compiled K (no-op tail intervals: zero rows, all-pad indices →
+        scatter-dropped, their outputs never published), so one compiled
+        program per shape key serves every occupancy."""
+        nb, wb, zones_t = self._key  # type: ignore[misc]
+        z = len(zones_t)
+        pending, self._pending = self._pending, []
+        k_live = len(pending)
+        k = self.fused_k
+        # changed+cleared are disjoint subsets of the nb resident rows,
+        # so every per-interval delta fits the nb-capped bucket
+        need_d = max(1, max(len(idx) for _, idx, _, _ in pending))
+        db = min(self._ladder_d.fit(need_d), nb)
+        width = self._empty_row.shape[0]
+        rows_b = np.zeros((k, db, width), np.float32)
+        idx_b = np.full((k, db), nb, np.int32)
+        h2d = 0
+        for j, (stage, idx, _, _) in enumerate(pending):
+            n = len(idx)
+            rows_b[j, :n] = stage
+            idx_b[j, :n] = idx
+            h2d += n
+        jax = self._jax
+        args_tail: list = []
+        mb: int | None = None
+        if self._sparse:
+            need_m = max(1, max(len(mi) for _, _, mi, _ in pending))
+            mb = self._ladder_m.fit(need_m)
+            mrows = np.full((k, mb), nb, np.int32)
+            for j, (_, _, mi, _) in enumerate(pending):
+                mrows[j, :len(mi)] = mi
+            args_tail.append(jax.device_put(mrows, self._sh_repl))
+        entry = self._fused_program_for(nb, wb, z, mb, k, db)
+        program, cold = entry[0], entry[1]
+        args = (params, self._buffers[0],
+                jax.device_put(rows_b, self._sh_repl),
+                jax.device_put(idx_b, self._sh_repl),
+                *args_tail)
+        if cold:
+            self._capture_cost(entry, program, args)
+        entry[1] = False
+        return FusedFlush(program=program, args=args, cold=cold,
+                          metas=[m for _, _, _, m in pending],
+                          k=k, k_live=k_live, h2d_rows=h2d)
+
+    def dispatch(self, flush: FusedFlush) -> Any:
+        """Run one fused batch → the ``[K, N, W+2, Z]`` f16 outputs. The
+        donated scan consumes the resident handle; rebind to the returned
+        carry immediately (KTL110) — unless the ring was rebuilt after
+        this flush was cut (shape change), in which case the old-shape
+        carry is dropped and the rebuilt buffer stays authoritative."""
+        fused = flush.program  # keplint: donates=1
+        params, resident = flush.args[0], flush.args[1]
+        rest = flush.args[2:]
+        pair = fused(params, resident, *rest)
+        resident = pair[0]
+        if flush.rebind:
+            self._buffers[0] = resident
+        return pair[1]
+
+    def _fused_program_for(self, nb: int, wb: int, z: int,
+                           mb: int | None, k: int, db: int) -> list:
+        key = (nb, wb, z, self._model_mode or "", mb, k, db)
+        entry = self._fused_programs.get(key)
+        if entry is None:
+            # fired BEFORE the entry caches (same contract as
+            # _program_for): a failed compile leaves no poisoned entry
+            if fault.fire("device.compile_error") is not None:
+                raise DeviceWindowError(
+                    "compile_error",
+                    f"injected compile failure for fused key {key}")
+            from kepler_tpu.parallel.packed import make_fused_window_program
+
+            program = make_fused_window_program(
+                self._mesh, n_workloads=wb, n_zones=z,
+                model_mode=self._model_mode, backend=self._backend,
+                model_bucket=mb)
+            entry = [program, True, None, self._fused_label(key)]
+            self._fused_programs[key] = entry
+            self.compile_count += 1
+            while len(self._fused_programs) > self._CACHE_CAP:
+                self._fused_programs.pop(next(iter(self._fused_programs)))
+        return entry
+
+    def _fused_label(self, key: tuple) -> str:
+        nb, wb, z, mode, mb, k, db = key
+        label = f"fused_n{nb}_w{wb}_z{z}_{mode or 'ratio'}"
+        if mb is not None:
+            label += f"_m{mb}"
+        return f"{label}_k{k}_d{db}"
+
+    # -- failure recovery / introspection ----------------------------------
+
+    def reset(self) -> None:
+        """Abandon the resident block AND the pending host ring: windows
+        staged but never flushed are re-published by the aggregator from
+        its own report snapshots at the demoted rung (zero gaps), so
+        holding their stale deltas here would only risk replaying them
+        against a rebuilt block."""
+        super().reset()
+        self._pending = []
+
+    def pending_occupancy(self) -> int:
+        """Windows staged but not yet flushed (0 ≤ · < K)."""
+        return len(self._pending)
+
+    def cost_stats(self) -> dict[str, dict]:
+        out = super().cost_stats()
+        for entry in self._fused_programs.values():
+            if entry[2] is not None:
+                out[entry[2]["label"]] = entry[2]
+        return out
+
+    def introspect(self) -> dict:
+        out = super().introspect()
+        out["fused"] = {
+            "k": self.fused_k,
+            "pending": len(self._pending),
+            "programs": [{"key": entry[3],
+                          "cold": bool(entry[1]), "cost": entry[2]}
+                         for entry in self._fused_programs.values()],
+        }
+        return out
 
 
 class ShardedWindowEngine(PackedWindowEngine):

@@ -102,6 +102,113 @@ def outer_product_attribution(
             jnp.transpose(power_znw, (1, 2, 0)))
 
 
+def _fused_window_kernel(res_ref, rows_ref, idx_ref, newres_ref, out_ref,
+                         *, lay, tn):
+    """One grid step of the fused window mega-kernel (node tile ``i``).
+
+    Does the WHOLE rung-0 window for its ``[TN, width]`` resident tile in
+    one pass: scatter the interval's delta rows into the tile, unpack the
+    packed fields, run ratio attribution, and emit the packed f16 watts
+    block (workload rows + node ACTIVE + node TOTAL) — the three device
+    round-trips of the unfused path collapsed into one kernel body.
+
+    The scatter has no in-kernel gather: a ``[DB, TN]`` hit matrix
+    (delta index == global row id) turns row selection into a 0/1 matmul
+    — exact, since delta indices are unique per interval, so every output
+    row sums at most one product. NaN (the invalid-slot encoding in the
+    cpu columns) would poison ``0 × NaN``; the NaN mask rides through a
+    second matmul and is re-applied after.
+    """
+    i = pl.program_id(0)
+    res = res_ref[...]  # [TN, width] f32
+    drows = rows_ref[...]  # [DB, width] f32
+    didx = idx_ref[...]  # [DB, 1] i32 (pad = N: matches no row id)
+    row_ids = i * tn + jax.lax.broadcasted_iota(jnp.int32, (1, tn), 1)
+    hit = didx == row_ids  # [DB, TN]
+    anyhit = jnp.any(hit, axis=0)  # [TN]
+    hitf = hit.astype(jnp.float32)
+    nan_mask = jnp.isnan(drows)
+    sel = jnp.dot(hitf.T, jnp.where(nan_mask, 0.0, drows))  # [TN, width]
+    sel_nan = jnp.dot(hitf.T, nan_mask.astype(jnp.float32))
+    sel = jnp.where(sel_nan > 0.5, jnp.float32(jnp.nan), sel)
+    rows = jnp.where(anyhit[:, None], sel, res)
+    newres_ref[...] = rows
+
+    # unpack (PackedLayout-derived slices, passed in statically) + the
+    # exact ops.attribution formula chain, tile-local
+    cpu_nan = rows[:, lay.cpu]
+    workload_valid = ~jnp.isnan(cpu_nan)
+    cpu = jnp.where(workload_valid, cpu_nan, 0.0)
+    zone = rows[:, lay.zone]
+    zone_valid = rows[:, lay.zone_valid] > 0.5
+    ratio = rows[:, lay.col_ratio]
+    denom = rows[:, lay.col_denom]
+    dt = rows[:, lay.col_dt]
+
+    deltas = jnp.where(zone_valid, zone, 0.0)  # [TN, Z]
+    active = deltas * jnp.clip(ratio, 0.0, 1.0)[:, None]
+    dtc = dt[:, None]
+    safe_dt = jnp.where(dtc > 0.0, dtc, 1.0)
+    total_uw = jnp.where(dtc > 0.0, deltas / safe_dt, 0.0)
+    active_uw = jnp.where(dtc > 0.0, active / safe_dt, 0.0)
+    d = denom[:, None]
+    ratios = jnp.where(d > 0.0, cpu / jnp.maximum(d, 1e-30), 0.0)  # [TN, W]
+    for zi in range(lay.n_zones):  # static unroll (Z is tiny)
+        col_a = active_uw[:, zi][:, None]  # [TN, 1]
+        col_t = total_uw[:, zi][:, None]
+        watts = jnp.concatenate([ratios * col_a, col_a, col_t], axis=1)
+        out_ref[zi] = (watts * 1e-6).astype(jnp.float16)
+
+
+def fused_window_step(
+    resident: jax.Array,  # f32 [N, width] packed resident block
+    delta_rows: jax.Array,  # f32 [DB, width] interval delta rows
+    delta_idx: jax.Array,  # i32 [DB] target rows (pad = N → dropped)
+    lay,  # PackedLayout (static: width + field offsets)
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """One FUSED window step: scatter + unpack + ratio attribution as a
+    single Pallas kernel over the packed resident block.
+
+    → ``(resident' [N, width] f32, packed_watts [N, W+2, Z] f16)`` — the
+    same contract as ``scatter_rows`` followed by the packed ratio
+    program, with zero intermediate device round-trips. Ratio-only by
+    design (the dense-model fused path composes XLA ops instead); used
+    as the ``lax.scan`` body of the pallas-backend fused window program.
+
+    The kernel grid is 1-D over node tiles; the watts output lands as
+    ``[Z, N, W+2]`` (lane-friendly tiles, same trick as
+    ``outer_product_attribution``) and is transposed once on the way out.
+    """
+    n = resident.shape[0]
+    db = delta_rows.shape[0]
+    tn = _tile(n, 512, 8)
+    grid = (n // tn,)
+    kernel = functools.partial(_fused_window_kernel, lay=lay, tn=tn)
+    res_spec = pl.BlockSpec((tn, lay.width), lambda i: (i, 0))
+    out_znw = jax.ShapeDtypeStruct((lay.n_zones, n, lay.n_workloads + 2),
+                                   jnp.float16)
+    newres, watts_znw = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            res_spec,
+            pl.BlockSpec((db, lay.width), lambda i: (0, 0)),
+            pl.BlockSpec((db, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            res_spec,
+            pl.BlockSpec((lay.n_zones, tn, lay.n_workloads + 2),
+                         lambda i: (0, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((n, lay.width), jnp.float32),
+                   out_znw],
+        interpret=interpret,
+    )(resident, delta_rows, delta_idx[:, None])
+    return newres, jnp.transpose(watts_znw, (1, 2, 0))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def attribute_fleet_pallas(
     zone_deltas_uj: jax.Array,  # f32 [N, Z]

@@ -209,33 +209,17 @@ def _pack_watts_f16(res: FleetResult) -> jax.Array:
                            axis=1).astype(jnp.float16)
 
 
-def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
-                              model_mode: str | None = None,
-                              backend: str = "einsum",
-                              model_bucket: int | None = None,
-                              local_model_rows: bool = False) -> Callable:
-    """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+2, Z]``.
+def _window_step_fns(mesh: Mesh, n_workloads: int, n_zones: int,
+                     model_mode: str | None, backend: str,
+                     model_bucket: int | None) -> tuple[
+                         Callable, Callable | None]:
+    """The shared UNJITTED packed window-step bodies → (dense, sparse).
 
-    W and Z are static (they define the packing layout); N stays dynamic
-    per compilation, sharded over the mesh's node axis.
-
-    ``model_bucket``: when given (and ``model_mode`` is set), the program
-    takes a third ``model_rows`` int32 [model_bucket] argument and
-    evaluates the estimator ONLY on those rows (sparse mixed-fleet
-    evaluation; see module docstring). Entries ≥ N are padding: the
-    gather clamps them to a real row whose scatter-back is then dropped.
-
-    ``local_model_rows``: SHARDED sparse evaluation for multi-device
-    meshes. The replicated-``model_rows`` gather above has no shard
-    story — GSPMD would all-gather the whole packed batch to satisfy
-    arbitrary global indices. With ``local_model_rows`` the program runs
-    under ``shard_map`` over the node axis: ``model_rows`` is int32
-    [n_shards × model_bucket] sharded over ``node``, each shard's
-    segment holding SHARD-LOCAL row indices (pad = the shard's local row
-    count, gather-clamped / scatter-dropped per shard). The estimator
-    gather, forward, and scatter-back all stay shard-local; the only
-    cross-shard step left in a window is the caller's result fetch.
-    """
+    ``sparse`` is None unless ``model_bucket`` is set with a model mode
+    (einsum backend required — the row-index gather has no shard story).
+    Both the per-window packed builder (:func:`make_packed_fleet_program`)
+    and the fused K-window scan builder (:func:`make_fused_window_program`)
+    compose these same closures, so the two programs cannot drift."""
     predict_fn = predictor(model_mode) if model_mode else None
     if predict_fn is not None and model_mode != "linear" \
             and mesh.devices.flat[0].platform != "tpu":
@@ -287,6 +271,40 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
         return _pack_watts_f16(mix_model_watts(ratio_res, model_watts,
                                                mode, dt))
 
+    return unpack_and_attribute, (unpack_and_attribute_sparse
+                                  if sparse else None)
+
+
+def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
+                              model_mode: str | None = None,
+                              backend: str = "einsum",
+                              model_bucket: int | None = None,
+                              local_model_rows: bool = False) -> Callable:
+    """→ jitted ``packed_in [N, W+2Z+4] → packed_watts_f16 [N, W+2, Z]``.
+
+    W and Z are static (they define the packing layout); N stays dynamic
+    per compilation, sharded over the mesh's node axis.
+
+    ``model_bucket``: when given (and ``model_mode`` is set), the program
+    takes a third ``model_rows`` int32 [model_bucket] argument and
+    evaluates the estimator ONLY on those rows (sparse mixed-fleet
+    evaluation; see module docstring). Entries ≥ N are padding: the
+    gather clamps them to a real row whose scatter-back is then dropped.
+
+    ``local_model_rows``: SHARDED sparse evaluation for multi-device
+    meshes. The replicated-``model_rows`` gather above has no shard
+    story — GSPMD would all-gather the whole packed batch to satisfy
+    arbitrary global indices. With ``local_model_rows`` the program runs
+    under ``shard_map`` over the node axis: ``model_rows`` is int32
+    [n_shards × model_bucket] sharded over ``node``, each shard's
+    segment holding SHARD-LOCAL row indices (pad = the shard's local row
+    count, gather-clamped / scatter-dropped per shard). The estimator
+    gather, forward, and scatter-back all stay shard-local; the only
+    cross-shard step left in a window is the caller's result fetch.
+    """
+    unpack_and_attribute, unpack_and_attribute_sparse = _window_step_fns(
+        mesh, n_workloads, n_zones, model_mode, backend, model_bucket)
+    sparse = unpack_and_attribute_sparse is not None
     if sparse and local_model_rows:
         from kepler_tpu.parallel.compat import shard_map
 
@@ -320,6 +338,104 @@ def make_packed_fleet_program(mesh: Mesh, n_workloads: int, n_zones: int,
         in_shardings=(NamedSharding(mesh, P()),
                       NamedSharding(mesh, P(NODE_AXIS, None))),
         out_shardings=NamedSharding(mesh, P(NODE_AXIS)),
+    )
+
+
+def make_fused_window_program(mesh: Mesh, n_workloads: int, n_zones: int,
+                              model_mode: str | None = None,
+                              backend: str = "einsum",
+                              model_bucket: int | None = None) -> Callable:
+    """→ jitted DEVICE-RESIDENT window loop: one dispatch per K windows.
+
+    ``fused(params, resident, delta_rows, delta_idx[, model_rows])``:
+
+      resident    f32 [N, width]      — DONATED packed resident block
+      delta_rows  f32 [K, DB, width]  — per-interval staged delta rows
+      delta_idx   i32 [K, DB]         — target rows (pad = N → dropped)
+      model_rows  i32 [K, MB]         — sparse variant only (pad = N)
+
+      → (resident' f32 [N, width], outs f16 [K, N, W+2, Z])
+
+    One ``lax.scan`` applies each interval's delta rows to the resident
+    block and runs the shared packed window body on the result — the
+    host dispatches ONCE per K windows and the publish fetch
+    materializes all K packed outputs in one transfer, amortizing the
+    per-window host↔device sync floor K×. K and DB ride on the argument
+    shapes (static per compilation, bucketed by the window engine).
+
+    The resident block is donated (argnum 1): the scan carry aliases the
+    input buffer, so the device never holds two fleet-sized residents
+    and the caller must rebind its handle to the returned one.
+
+    With ``backend="pallas"`` on a single-device mesh and no model, each
+    scan step runs the fused mega-kernel
+    (``ops.pallas_attribution.fused_window_step``): scatter + unpack +
+    attribution in ONE kernel body. Everywhere else the step composes
+    the drop-mode scatter with the shared window body and XLA fuses the
+    pair per step (still one executable for the whole K-window batch).
+    """
+    dense_fn, sparse_fn = _window_step_fns(
+        mesh, n_workloads, n_zones, model_mode, backend, model_bucket)
+    repl = NamedSharding(mesh, P())
+    by_node = NamedSharding(mesh, P(NODE_AXIS, None))
+    out_shardings = (by_node, NamedSharding(mesh, P(None, NODE_AXIS)))
+
+    if sparse_fn is not None:
+        def fused_scan_sparse(model_params: Any, resident: jax.Array,
+                              delta_rows: jax.Array, delta_idx: jax.Array,
+                              model_rows: jax.Array) -> tuple[
+                                  jax.Array, jax.Array]:
+            def step(res, xs):
+                rows, idx, mrows = xs
+                res = res.at[idx].set(rows, mode="drop")
+                return res, sparse_fn(model_params, res, mrows)
+
+            return jax.lax.scan(step, resident,
+                                (delta_rows, delta_idx, model_rows))
+
+        return jax.jit(
+            fused_scan_sparse,
+            donate_argnums=(1,),
+            in_shardings=(repl, by_node, repl, repl, repl),
+            out_shardings=out_shardings,
+        )
+
+    lay = PackedLayout(n_workloads, n_zones)
+    use_kernel = (backend == "pallas" and model_mode is None
+                  and len(list(mesh.devices.flat)) == 1)
+    if use_kernel:
+        from kepler_tpu.ops.pallas_attribution import fused_window_step
+        interpret = mesh.devices.flat[0].platform != "tpu"
+    body_fn = dense_fn
+    if backend == "pallas" and not use_kernel:
+        # pallas_call has no SPMD rule: the per-step body runs per-shard
+        # (the scatter stays outside — its indices are global row ids)
+        body_fn = shard_by_node(dense_fn, mesh,
+                                in_specs=(P(), P(NODE_AXIS, None)))
+
+    def fused_scan(model_params: Any, resident: jax.Array,
+                   delta_rows: jax.Array,
+                   delta_idx: jax.Array) -> tuple[jax.Array, jax.Array]:
+        def step(res, xs):
+            rows, idx = xs
+            if use_kernel:
+                return fused_window_step(res, rows, idx, lay,
+                                         interpret=interpret)
+            res = res.at[idx].set(rows, mode="drop")
+            return res, body_fn(model_params, res)
+
+        return jax.lax.scan(step, resident, (delta_rows, delta_idx))
+
+    # keep_unused: ratio mode (and the mega-kernel path) never reads
+    # model_params, but pruning it would renumber the flat arguments and
+    # detach the donate_argnums=(1,) contract from the resident block
+    # (KTL121 checks declared vs realized donation by flat position)
+    return jax.jit(
+        fused_scan,
+        donate_argnums=(1,),
+        keep_unused=True,
+        in_shardings=(repl, by_node, repl, repl),
+        out_shardings=out_shardings,
     )
 
 

@@ -64,6 +64,14 @@ def fat_result(**overrides) -> dict:
         "aggwin_multihost_bit_consistent": True,
         "aggwin_multihost_capacity_ratio": 2.0,
         "aggwin_multihost_capacity_budget": 1.8,
+        "aggwin_fused_ok": True,
+        "aggwin_fused_k": 4,
+        "aggwin_fused_device_p50_ms": 0.0,
+        "aggwin_fused_sync_per_window_ms": 4.2,
+        "aggwin_unfused_device_p50_ms": 17.3,
+        "aggwin_fused_ratio": 0.0,
+        "aggwin_fused_ratio_budget": 0.5,
+        "aggwin_fused_bit_consistent": True,
         "ingest_ok": True,
         "ingest_zero_copy_ok": True,
         "ingest_decode_ratio": 4.9,
@@ -281,3 +289,50 @@ class TestErroredLegGates:
         assert result["aggwin_multihost_ok"] is False
         assert result["aggwin_sharded_ok"] is False
         assert sum("aggwin" in m for m in messages) == 1  # the leg error
+
+    def test_fused_violation_gates_and_survives_headline(self):
+        """The ISSUE-20 fused window gate: a measured amortization miss
+        (fused device leg not ≤ budget × unfused) or bit-inconsistency
+        fails the run, lands False in the headline, and the headline
+        still honors the size contract."""
+        result = fat_result(aggwin_fused_ok=False,
+                            aggwin_fused_ratio=0.83,
+                            aggwin_fused_bit_consistent=True)
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert any("fused" in m for m in messages)
+        result["ok"] = not failed
+        line = bench.build_headline(result, "BENCH_DETAIL.json")
+        assert len(line) <= bench.HEADLINE_MAX_CHARS
+        head = json.loads(line)
+        assert head["aggwin_fused_ok"] is False
+        assert head["ok"] is False
+
+    def test_aggwin_error_forces_fused_gate_false(self):
+        """An errored aggwin leg forces the fused gate False too (the
+        fused measurement runs inside that leg) — with no fabricated
+        measured-violation message."""
+        result = fat_result(aggwin_error="TimeoutExpired(900)")
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert failed
+        assert result["aggwin_fused_ok"] is False
+        assert sum("aggwin" in m for m in messages) == 1
+        result["ok"] = not failed
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert head["aggwin_fused_ok"] is False
+        assert "aggwin_error" in head["leg_errors"]
+
+    def test_absent_fused_leg_does_not_gate(self):
+        """A detail row captured before the fused leg existed (or a run
+        with fusedWindowK pinned to 1) has no fused fields — the gate
+        must not fire on absence."""
+        result = fat_result()
+        for key in list(result):
+            if key.startswith("aggwin_fused") or \
+                    key.startswith("aggwin_unfused"):
+                del result[key]
+        failed, messages = bench.evaluate_gates(result, on_tpu=False)
+        assert not failed
+        assert messages == []
+        head = json.loads(bench.build_headline(result, "f.json"))
+        assert "aggwin_fused_ok" not in head

@@ -377,6 +377,10 @@ FIELD_MATRIX = [
     FieldCase("aggregator.pipeline_depth",
               "aggregator: {pipelineDepth: 3}", 3,
               ["--aggregator.pipeline-depth", "1"], 1),
+    # fused device-resident window loop (ISSUE 20)
+    FieldCase("aggregator.fused_window_k",
+              "aggregator: {fusedWindowK: 4}", 4,
+              ["--aggregator.fused-window-k", "2"], 2),
     FieldCase("aggregator.bucket_shrink_after",
               "aggregator: {bucketShrinkAfter: 4}", 4,
               ["--aggregator.bucket-shrink-after", "8"], 8),
@@ -629,6 +633,7 @@ class TestYAMLSpellings:
         "stateMaxAge": "monitor",
         "dedupWindow": "aggregator",
         "pipelineDepth": "aggregator",
+        "fusedWindowK": "aggregator",
         "bucketShrinkAfter": "aggregator",
         "fallbackEnabled": "aggregator",
         "repromoteAfter": "aggregator",
@@ -711,6 +716,7 @@ class TestYAMLSpellings:
         "stateMaxAge": ("2m", 120.0),
         "dedupWindow": ("64", 64),
         "pipelineDepth": ("3", 3),
+        "fusedWindowK": ("4", 4),
         "bucketShrinkAfter": ("4", 4),
         "fallbackEnabled": ("false", False),
         "repromoteAfter": ("4", 4),

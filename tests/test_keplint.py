@@ -937,6 +937,141 @@ class TestDonatedBufferRule:
         assert "resident" in diags[0].message
 
 
+class TestFusedScanFixtures:
+    """ISSUE 20: the fused device-resident window loop's contracts,
+    pinned as fixture pairs — the ``lax.scan`` body stays pure (no host
+    callbacks or wall-clock: KTL107), span-free (spans inside the scan
+    run at trace time only: KTL109), and the flush dispatch follows the
+    donated ring's rebind-after-abandon idiom (KTL110)."""
+
+    REL = "kepler_tpu/parallel/packed.py"
+    ENGINE_REL = "kepler_tpu/fleet/window.py"
+
+    def test_bad_host_print_in_fused_scan_body(self, lint):
+        diags = lint("""
+            import jax
+
+            @jax.jit
+            def fused_scan(params, resident, rows, idx):
+                def step(res, xs):
+                    r, i = xs
+                    print("window", i)  # trace-time only: dead or a bug
+                    res = res.at[i].set(r, mode="drop")
+                    return res, res.sum()
+                return jax.lax.scan(step, resident, (rows, idx))
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL107"]
+
+    def test_bad_wall_clock_in_fused_scan_body(self, lint):
+        diags = lint("""
+            import time
+
+            import jax
+
+            @jax.jit
+            def fused_scan(params, resident, rows, idx):
+                def step(res, xs):
+                    r, i = xs
+                    t0 = time.time()  # never per-window after caching
+                    res = res.at[i].set(r, mode="drop")
+                    return res, res.sum() + t0
+                return jax.lax.scan(step, resident, (rows, idx))
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL107"]
+
+    def test_good_pure_fused_scan_body(self, lint):
+        diags = lint("""
+            import jax
+
+            @jax.jit
+            def fused_scan(params, resident, rows, idx):
+                def step(res, xs):
+                    r, i = xs
+                    res = res.at[i].set(r, mode="drop")
+                    return res, res.sum()
+                return jax.lax.scan(step, resident, (rows, idx))
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_bad_span_inside_fused_scan_body(self, lint):
+        diags = lint("""
+            import jax
+            from kepler_tpu import telemetry
+
+            @jax.jit
+            def fused_scan(params, resident, rows, idx):
+                def step(res, xs):
+                    r, i = xs
+                    with telemetry.span("window.fused_scan"):
+                        res = res.at[i].set(r, mode="drop")
+                    return res, res.sum()
+                return jax.lax.scan(step, resident, (rows, idx))
+        """, rel=self.REL)
+        assert ids(diags) == ["KTL109"]
+
+    def test_good_span_wraps_fused_dispatch_call_site(self, lint):
+        diags = lint("""
+            import jax
+            from kepler_tpu import telemetry
+
+            @jax.jit
+            def fused_scan(params, resident, rows, idx):
+                def step(res, xs):
+                    r, i = xs
+                    res = res.at[i].set(r, mode="drop")
+                    return res, res.sum()
+                return jax.lax.scan(step, resident, (rows, idx))
+
+            def dispatch(params, resident, rows, idx):
+                with telemetry.span("window.fused_scan"):
+                    return fused_scan(params, resident, rows, idx)
+        """, rel=self.REL)
+        assert diags == []
+
+    def test_good_fused_ring_rebind_after_abandon(self, lint):
+        # the engine's flush-dispatch idiom: the donated resident handle
+        # is rebound from the scan's carry output, and the failure path
+        # abandons the ring for fresh buffers — the dead handle is never
+        # read
+        diags = lint("""
+            def dispatch(self, flush):
+                fused = flush.program  # keplint: donates=1
+                params, resident = flush.args[0], flush.args[1]
+                rest = flush.args[2:]
+                try:
+                    pair = fused(params, resident, *rest)
+                except RuntimeError:
+                    self.reset()  # abandon ring, rebind fresh buffers
+                    raise
+                resident = pair[0]
+                if flush.rebind:
+                    self._buffers[0] = resident
+                return pair[1]
+        """, rel=self.ENGINE_REL)
+        assert diags == []
+
+    def test_bad_fused_ring_salvages_donated_handle(self, lint):
+        # the anti-pattern: the failure path "saves" the donated
+        # resident handle back into the ring — a buffer the failed scan
+        # dispatch may already have consumed
+        diags = lint("""
+            def dispatch(self, flush):
+                fused = flush.program  # keplint: donates=1
+                params, resident = flush.args[0], flush.args[1]
+                rest = flush.args[2:]
+                try:
+                    pair = fused(params, resident, *rest)
+                except RuntimeError:
+                    self._buffers[0] = resident  # dead buffer
+                    raise
+                resident = pair[0]
+                self._buffers[0] = resident
+                return pair[1]
+        """, rel=self.ENGINE_REL)
+        assert ids(diags) == ["KTL110"]
+        assert "resident" in diags[0].message
+
+
 class TestBaselineRatchet:
     SOURCE = """
         # keplint: monotonic-only
